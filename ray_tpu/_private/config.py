@@ -1,0 +1,101 @@
+"""Central config registry, env-var overridable.
+
+Equivalent of the reference's `RayConfig` macro registry
+(`src/ray/common/ray_config_def.h` — 216 `RAY_CONFIG(...)` knobs, each
+overridable via a `RAY_<name>` env var). Here every knob is declared once with
+a type and default and can be overridden with `RAY_TPU_<NAME>` env vars or
+programmatically via `ray_tpu.init(_system_config={...})`.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field, fields
+from typing import Any
+
+_ENV_PREFIX = "RAY_TPU_"
+
+
+@dataclass
+class Config:
+    # --- object store / data plane ---
+    # Objects <= this many bytes are returned in-band in the task reply and
+    # live in the owner's in-process memory store (reference:
+    # `max_direct_call_object_size`, ray_config_def.h:206 — 100KB default).
+    max_direct_call_object_size: int = 100 * 1024
+    # Default per-node shared-memory store capacity.
+    object_store_memory: int = 2 * 1024**3
+    # Object-table slots in the shm store header.
+    object_store_table_size: int = 65536
+    # Chunk size for node-to-node object transfer.
+    object_transfer_chunk_bytes: int = 8 * 1024**2
+
+    # --- scheduling ---
+    # Hybrid policy: pack onto the local node until its utilization crosses
+    # this threshold, then spread (reference hybrid_scheduling_policy).
+    scheduler_spread_threshold: float = 0.5
+    # How long a leased worker is kept by a submitter with no queued tasks.
+    idle_lease_keepalive_s: float = 0.2
+    # Max workers a raylet will fork per node by default: num_cpus.
+    maximum_startup_concurrency: int = 8
+    # Worker pool: keep this many idle workers warm.
+    num_prestart_workers: int = 0
+    worker_register_timeout_s: float = 30.0
+
+    # --- health / fault tolerance ---
+    raylet_heartbeat_period_s: float = 0.5
+    health_check_failure_threshold: int = 10
+    actor_max_restarts_default: int = 0
+    task_max_retries_default: int = 3
+    # Lineage: max bytes of task specs retained by an owner for reconstruction.
+    max_lineage_bytes: int = 1024**3
+
+    # --- rpc ---
+    rpc_connect_timeout_s: float = 10.0
+    rpc_max_frame_bytes: int = 512 * 1024**2
+
+    # --- gcs ---
+    gcs_pubsub_batch_ms: float = 5.0
+    resource_broadcast_period_s: float = 0.1
+
+    # --- paths ---
+    session_dir_root: str = "/tmp/ray_tpu"
+
+    def update(self, overrides: dict[str, Any] | None = None) -> "Config":
+        if overrides:
+            for key, value in overrides.items():
+                if not hasattr(self, key):
+                    raise ValueError(f"Unknown config key: {key}")
+                setattr(self, key, value)
+        return self
+
+    @classmethod
+    def from_env(cls) -> "Config":
+        cfg = cls()
+        for f in fields(cls):
+            env = os.environ.get(_ENV_PREFIX + f.name.upper())
+            if env is not None:
+                if f.type in ("int", int):
+                    setattr(cfg, f.name, int(env))
+                elif f.type in ("float", float):
+                    setattr(cfg, f.name, float(env))
+                elif f.type in ("bool", bool):
+                    setattr(cfg, f.name, env.lower() in ("1", "true", "yes"))
+                else:
+                    setattr(cfg, f.name, env)
+        return cfg
+
+
+_global_config: Config | None = None
+
+
+def global_config() -> Config:
+    global _global_config
+    if _global_config is None:
+        _global_config = Config.from_env()
+    return _global_config
+
+
+def set_global_config(cfg: Config) -> None:
+    global _global_config
+    _global_config = cfg

@@ -1,0 +1,215 @@
+"""Python binding for the native shared-memory object store.
+
+The raylet creates one arena per node (`ObjectStore.create`); every worker on
+the node attaches (`ObjectStore.attach`). Reads are zero-copy: Python mmaps
+the same shm file the C++ library manages and returns memoryview slices over
+the data region, so `get` of a numpy array is a view onto shared memory
+(reference: plasma client `src/ray/object_manager/plasma/client.cc` +
+`python/ray/_private/serialization.py` zero-copy reads).
+"""
+
+from __future__ import annotations
+
+import mmap
+import os
+
+from ray_tpu._private import serialization
+from ray_tpu._private.ids import ObjectID
+from ray_tpu.native import load_shm_store
+
+import ctypes
+
+SS_OK = 0
+SS_EXISTS = -1
+SS_NOT_FOUND = -2
+SS_NO_MEMORY = -3
+SS_TABLE_FULL = -4
+SS_TIMEOUT = -5
+SS_NOT_SEALED = -6
+
+
+class ObjectStoreError(Exception):
+    pass
+
+
+class ObjectStoreFullError(ObjectStoreError):
+    pass
+
+
+class ObjectTimeoutError(ObjectStoreError):
+    pass
+
+
+class PlasmaBuffer:
+    """Holds one store reference for the lifetime of its zero-copy views.
+
+    Views are exported through the PEP-688 buffer protocol, so any memoryview
+    slice (and any numpy array reconstructed from one by pickle5) keeps this
+    object alive; when the last view is garbage-collected, __del__ drops the
+    store refcount and the object becomes evictable again. This mirrors the
+    reference's plasma client Buffer semantics
+    (src/ray/object_manager/plasma/client.cc — release-on-buffer-destruction).
+    """
+
+    __slots__ = ("_store", "_id_bytes", "_view", "__weakref__")
+
+    def __init__(self, store: "ObjectStore", id_bytes: bytes, view: memoryview):
+        self._store = store
+        self._id_bytes = id_bytes
+        self._view = view
+
+    def __buffer__(self, flags: int) -> memoryview:
+        return self._view
+
+    @property
+    def nbytes(self) -> int:
+        return self._view.nbytes
+
+    def __del__(self):
+        store = self._store
+        if store is not None and store._h >= 0:
+            store._lib.ss_release(store._h, self._id_bytes)
+
+
+class ObjectStore:
+    def __init__(self, name: str, handle: int, lib):
+        self._name = name
+        self._lib = lib
+        self._h = handle
+        self._data_off = lib.ss_data_offset(handle)
+        map_size = lib.ss_map_size(handle)
+        fd = os.open(f"/dev/shm{name}", os.O_RDWR)
+        try:
+            self._mmap = mmap.mmap(fd, map_size)
+        finally:
+            os.close(fd)
+        self._view = memoryview(self._mmap)
+
+    # -- lifecycle --------------------------------------------------------
+
+    @classmethod
+    def create(cls, name: str, capacity: int, table_size: int = 65536):
+        lib = load_shm_store()
+        h = lib.ss_create_store(name.encode(), capacity, table_size)
+        if h < 0:
+            raise ObjectStoreError(f"failed to create store {name}: {h}")
+        return cls(name, h, lib)
+
+    @classmethod
+    def attach(cls, name: str):
+        lib = load_shm_store()
+        h = lib.ss_attach(name.encode())
+        if h < 0:
+            raise ObjectStoreError(f"failed to attach store {name}: {h}")
+        return cls(name, h, lib)
+
+    def close(self):
+        if self._h >= 0:
+            self._lib.ss_detach(self._h)
+            self._h = -1
+            self._view.release()
+            try:
+                self._mmap.close()
+            except BufferError:
+                # Zero-copy views handed to callers still reference the
+                # mapping; it is reclaimed when they are garbage-collected.
+                pass
+
+    def destroy(self):
+        name = self._name
+        self.close()
+        self._lib.ss_unlink_store(name.encode())
+
+    # -- data plane -------------------------------------------------------
+
+    def _slice(self, offset: int, size: int) -> memoryview:
+        start = self._data_off + offset
+        return self._view[start : start + size]
+
+    def create_buffer(self, object_id: ObjectID, size: int) -> memoryview:
+        off = self._lib.ss_create(self._h, object_id.binary(), size)
+        if off == SS_EXISTS:
+            raise ObjectStoreError(f"object already exists: {object_id}")
+        if off in (SS_NO_MEMORY, SS_TABLE_FULL):
+            raise ObjectStoreFullError(
+                f"object store out of {'memory' if off == SS_NO_MEMORY else 'table slots'}"
+            )
+        if off < 0:
+            raise ObjectStoreError(f"create failed: {off}")
+        return self._slice(off, size)
+
+    def seal(self, object_id: ObjectID):
+        rc = self._lib.ss_seal(self._h, object_id.binary())
+        if rc not in (SS_OK, SS_EXISTS):
+            raise ObjectStoreError(f"seal failed: {rc}")
+
+    def put_serialized(self, object_id: ObjectID, pickled: bytes, buffers) -> int:
+        """Write a framed serialized value; returns stored size."""
+        size = serialization.serialized_size(pickled, buffers)
+        buf = self.create_buffer(object_id, size)
+        serialization.write_to(buf, pickled, buffers)
+        self.seal(object_id)
+        self.release(object_id)
+        return size
+
+    def put_raw(self, object_id: ObjectID, data: bytes | memoryview) -> int:
+        """Store pre-framed bytes verbatim (used by object transfer)."""
+        data = memoryview(data)
+        buf = self.create_buffer(object_id, data.nbytes)
+        buf[:] = data
+        self.seal(object_id)
+        self.release(object_id)
+        return data.nbytes
+
+    def get_buffer(self, object_id: ObjectID, timeout: float | None = -1
+                   ) -> memoryview | None:
+        """Framed bytes of a sealed object as a zero-copy view, or None.
+
+        The returned memoryview holds one store reference (via PlasmaBuffer):
+        the object cannot be evicted until the view — and every view derived
+        from it, including numpy arrays from `get` — is garbage-collected.
+
+        timeout: -1/None = non-blocking; 0 = wait forever; >0 = wait seconds.
+        """
+        size = ctypes.c_uint64()
+        t = -1.0 if timeout is None else float(timeout)
+        off = self._lib.ss_get(self._h, object_id.binary(), ctypes.byref(size), t)
+        if off in (SS_NOT_FOUND, SS_NOT_SEALED):
+            return None
+        if off == SS_TIMEOUT:
+            raise ObjectTimeoutError(f"timed out waiting for {object_id}")
+        if off < 0:
+            raise ObjectStoreError(f"get failed: {off}")
+        raw = self._slice(off, size.value)
+        return memoryview(PlasmaBuffer(self, object_id.binary(), raw))
+
+    def get(self, object_id: ObjectID, timeout: float | None = -1):
+        buf = self.get_buffer(object_id, timeout)
+        if buf is None:
+            return None
+        return serialization.deserialize(buf)
+
+    def contains(self, object_id: ObjectID) -> bool:
+        return self._lib.ss_contains(self._h, object_id.binary()) == 2
+
+    def release(self, object_id: ObjectID):
+        self._lib.ss_release(self._h, object_id.binary())
+
+    def delete(self, object_id: ObjectID):
+        self._lib.ss_delete(self._h, object_id.binary())
+
+    def evict(self, nbytes: int) -> int:
+        return self._lib.ss_evict(self._h, nbytes)
+
+    def stats(self) -> dict:
+        cap = ctypes.c_uint64()
+        alloc = ctypes.c_uint64()
+        n = ctypes.c_uint32()
+        self._lib.ss_stats(
+            self._h, ctypes.byref(cap), ctypes.byref(alloc), ctypes.byref(n)
+        )
+        return {
+            "capacity": cap.value,
+            "allocated": alloc.value,
+            "num_objects": n.value,
+        }

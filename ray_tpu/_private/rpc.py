@@ -1,0 +1,253 @@
+"""Asyncio RPC: length-prefixed msgpack frames over TCP.
+
+This is the control-plane transport equivalent of the reference's gRPC layer
+(`src/ray/rpc/`): every daemon (GCS, raylet, worker) runs an `RpcServer` with
+named async handlers, and holds `RpcClient` connections to its peers. Direct
+worker→worker task push (the reference's `CoreWorkerService.PushTask`) rides
+the same transport. Payloads are msgpack maps; binary blobs (pickled task
+args, serialized objects) are msgpack `bytes` and are never copied through
+JSON/base64.
+
+Frame format:  u32_be length | msgpack [msgid, kind, method, payload]
+kinds: 0=request 1=reply_ok 2=reply_err 3=notify
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import logging
+import traceback
+from typing import Any, Awaitable, Callable, Dict, Optional
+
+import msgpack
+
+logger = logging.getLogger(__name__)
+
+REQUEST, REPLY_OK, REPLY_ERR, NOTIFY = 0, 1, 2, 3
+
+_MAX_FRAME = 1 << 31
+
+
+class RpcError(Exception):
+    """Remote handler raised; carries the remote traceback string."""
+
+
+class ConnectionLost(Exception):
+    pass
+
+
+def _pack(msg) -> bytes:
+    body = msgpack.packb(msg, use_bin_type=True)
+    return len(body).to_bytes(4, "big") + body
+
+
+async def _read_frame(reader: asyncio.StreamReader):
+    header = await reader.readexactly(4)
+    length = int.from_bytes(header, "big")
+    if length > _MAX_FRAME:
+        raise ValueError(f"frame too large: {length}")
+    body = await reader.readexactly(length)
+    return msgpack.unpackb(body, raw=False, strict_map_key=False)
+
+
+class RpcServer:
+    """Serves named async handlers. Handlers: async def h(payload) -> payload."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.host = host
+        self.port = port
+        self._handlers: Dict[str, Callable[[Any], Awaitable[Any]]] = {}
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._conn_tasks: set = set()
+
+    def register(self, method: str, handler: Callable[[Any], Awaitable[Any]]):
+        self._handlers[method] = handler
+
+    def register_all(self, obj, prefix: str = "rpc_"):
+        """Register every `rpc_*` coroutine method of obj under its bare name."""
+        for name in dir(obj):
+            if name.startswith(prefix):
+                self.register(name[len(prefix):], getattr(obj, name))
+
+    async def start(self):
+        self._server = await asyncio.start_server(
+            self._handle_conn, self.host, self.port
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    async def stop(self):
+        if self._server:
+            self._server.close()
+        # Cancel live connection handlers BEFORE wait_closed(): on
+        # Python >= 3.12.1 wait_closed() waits for all handlers, which would
+        # otherwise block forever on connections idling in _read_frame.
+        for t in list(self._conn_tasks):
+            t.cancel()
+        if self._server:
+            await self._server.wait_closed()
+
+    async def _handle_conn(self, reader, writer):
+        write_lock = asyncio.Lock()
+        conn_task = asyncio.current_task()
+        self._conn_tasks.add(conn_task)
+        try:
+            while True:
+                try:
+                    msgid, kind, method, payload = await _read_frame(reader)
+                except (asyncio.IncompleteReadError, ConnectionResetError):
+                    return
+                task = asyncio.ensure_future(
+                    self._dispatch(msgid, kind, method, payload, writer, write_lock)
+                )
+                self._conn_tasks.add(task)
+                task.add_done_callback(self._conn_tasks.discard)
+        except asyncio.CancelledError:
+            pass
+        finally:
+            self._conn_tasks.discard(conn_task)
+            writer.close()
+
+    async def _dispatch(self, msgid, kind, method, payload, writer, write_lock):
+        handler = self._handlers.get(method)
+        try:
+            if handler is None:
+                raise RpcError(f"no handler for method {method!r}")
+            result = await handler(payload)
+            reply = [msgid, REPLY_OK, method, result]
+        except asyncio.CancelledError:
+            raise
+        except BaseException:
+            if kind == NOTIFY:
+                logger.exception("error in notify handler %s", method)
+                return
+            reply = [msgid, REPLY_ERR, method, traceback.format_exc()]
+        if kind == REQUEST:
+            try:
+                async with write_lock:
+                    writer.write(_pack(reply))
+                    await writer.drain()
+            except (ConnectionResetError, BrokenPipeError):
+                pass
+
+
+class RpcClient:
+    """Persistent connection to one RpcServer; safe for concurrent requests."""
+
+    def __init__(self, address: str, connect_timeout: float = 10.0):
+        self.address = address
+        host, port = address.rsplit(":", 1)
+        self._host, self._port = host, int(port)
+        self._timeout = connect_timeout
+        self._reader = None
+        self._writer = None
+        self._pending: Dict[int, asyncio.Future] = {}
+        self._msgid = itertools.count(1)
+        self._read_task = None
+        self._lock = asyncio.Lock()
+        self._closed = False
+
+    async def connect(self):
+        deadline = asyncio.get_event_loop().time() + self._timeout
+        while True:
+            try:
+                self._reader, self._writer = await asyncio.open_connection(
+                    self._host, self._port
+                )
+                break
+            except OSError:
+                if asyncio.get_event_loop().time() > deadline:
+                    raise
+                await asyncio.sleep(0.05)
+        self._read_task = asyncio.ensure_future(self._read_loop())
+        return self
+
+    async def _read_loop(self):
+        try:
+            while True:
+                msgid, kind, method, payload = await _read_frame(self._reader)
+                fut = self._pending.pop(msgid, None)
+                if fut is None or fut.done():
+                    continue
+                if kind == REPLY_OK:
+                    fut.set_result(payload)
+                else:
+                    fut.set_exception(RpcError(payload))
+        except (asyncio.IncompleteReadError, ConnectionResetError, OSError,
+                asyncio.CancelledError):
+            for fut in self._pending.values():
+                if not fut.done():
+                    fut.set_exception(ConnectionLost(self.address))
+            self._pending.clear()
+
+    async def call(self, method: str, payload: Any = None,
+                   timeout: float | None = None) -> Any:
+        if self._writer is None:
+            raise ConnectionLost(f"not connected: {self.address}")
+        msgid = next(self._msgid)
+        fut = asyncio.get_event_loop().create_future()
+        self._pending[msgid] = fut
+        frame = _pack([msgid, REQUEST, method, payload])
+        async with self._lock:
+            self._writer.write(frame)
+            await self._writer.drain()
+        if timeout is None:
+            return await fut
+        return await asyncio.wait_for(fut, timeout)
+
+    async def notify(self, method: str, payload: Any = None):
+        if self._writer is None:
+            raise ConnectionLost(f"not connected: {self.address}")
+        frame = _pack([0, NOTIFY, method, payload])
+        async with self._lock:
+            self._writer.write(frame)
+            await self._writer.drain()
+
+    async def close(self):
+        self._closed = True
+        if self._read_task:
+            self._read_task.cancel()
+        if self._writer:
+            self._writer.close()
+
+    @property
+    def connected(self) -> bool:
+        return self._writer is not None and not self._writer.is_closing()
+
+
+class ClientPool:
+    """Lazily-created, cached RpcClients keyed by address (reference:
+    per-service client pools in `src/ray/rpc/`)."""
+
+    def __init__(self):
+        self._clients: Dict[str, RpcClient] = {}
+        self._locks: Dict[str, asyncio.Lock] = {}
+
+    async def get(self, address: str) -> RpcClient:
+        client = self._clients.get(address)
+        if client is not None and client.connected:
+            return client
+        lock = self._locks.setdefault(address, asyncio.Lock())
+        async with lock:
+            client = self._clients.get(address)
+            if client is not None and client.connected:
+                return client
+            client = RpcClient(address)
+            await client.connect()
+            self._clients[address] = client
+            return client
+
+    def invalidate(self, address: str):
+        client = self._clients.pop(address, None)
+        if client:
+            asyncio.ensure_future(client.close())
+
+    async def close_all(self):
+        for client in self._clients.values():
+            await client.close()
+        self._clients.clear()

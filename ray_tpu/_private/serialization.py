@@ -1,0 +1,123 @@
+"""Object serialization: cloudpickle + pickle-5 out-of-band zero-copy buffers.
+
+Mirrors the reference's split (`python/ray/_private/serialization.py`):
+values are cloudpickled with protocol 5 and large contiguous buffers (numpy
+arrays, arrow buffers, bytes) are captured out-of-band so that storing to the
+shared-memory object store and reading back is zero-copy — on `get`, buffers
+are reconstructed as memoryviews over the store's mmap, and numpy arrays are
+views onto shared memory.
+
+Wire layout of a stored object (64-byte aligned buffers):
+
+    u32 magic | u32 n_buffers | u64 size[n] ... | pad | buf0 | pad | buf1 ...
+
+buf0 is always the pickle stream; buf1.. are the out-of-band buffers.
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+import struct
+from typing import Any, Callable, List, Sequence
+
+import cloudpickle
+
+MAGIC = 0x52415931  # "RAY1"
+_ALIGN = 64
+
+# Registry of custom serializers, mirroring ray.util.register_serializer.
+_custom_serializers: dict[type, tuple[Callable, Callable]] = {}
+
+
+def register_serializer(cls: type, *, serializer: Callable, deserializer: Callable):
+    _custom_serializers[cls] = (serializer, deserializer)
+
+
+def deregister_serializer(cls: type):
+    _custom_serializers.pop(cls, None)
+
+
+class _Pickler(cloudpickle.CloudPickler):
+    def __init__(self, file, buffers: List):
+        super().__init__(
+            file, protocol=5, buffer_callback=lambda b: buffers.append(b.raw())
+        )
+
+    def reducer_override(self, obj):
+        ser = _custom_serializers.get(type(obj))
+        if ser is not None:
+            serializer, deserializer = ser
+            return (_reconstruct_custom, (type(obj), deserializer, serializer(obj)))
+        return super().reducer_override(obj)
+
+
+def _reconstruct_custom(cls, deserializer, payload):
+    return deserializer(payload)
+
+
+def _align(n: int) -> int:
+    return (n + _ALIGN - 1) & ~(_ALIGN - 1)
+
+
+def serialize(value: Any) -> tuple[bytes, List[memoryview]]:
+    """Serialize to (pickle_bytes, out_of_band_buffers)."""
+    buffers: List[memoryview] = []
+    f = io.BytesIO()
+    _Pickler(f, buffers).dump(value)
+    return f.getvalue(), buffers
+
+
+def serialized_size(pickled: bytes, buffers: Sequence[memoryview]) -> int:
+    n = 1 + len(buffers)
+    header = 8 + 8 * n
+    total = _align(header)
+    total += _align(len(pickled))
+    for b in buffers:
+        total += _align(b.nbytes)
+    return total
+
+
+def write_to(dst: memoryview, pickled: bytes, buffers: Sequence[memoryview]) -> int:
+    """Write the framed object into a writable buffer; returns bytes written."""
+    n = 1 + len(buffers)
+    struct.pack_into("<II", dst, 0, MAGIC, n)
+    sizes = [len(pickled)] + [b.nbytes for b in buffers]
+    struct.pack_into(f"<{n}Q", dst, 8, *sizes)
+    off = _align(8 + 8 * n)
+    dst[off : off + len(pickled)] = pickled
+    off += _align(len(pickled))
+    for b in buffers:
+        flat = b.cast("B") if b.ndim != 1 or b.format != "B" else b
+        dst[off : off + flat.nbytes] = flat
+        off += _align(flat.nbytes)
+    return off
+
+
+def pack(pickled: bytes, buffers: Sequence[memoryview]) -> bytes:
+    out = bytearray(serialized_size(pickled, buffers))
+    write_to(memoryview(out), pickled, buffers)
+    return bytes(out)
+
+
+def deserialize(src: memoryview) -> Any:
+    """Reconstruct a value from a framed buffer (zero-copy for oob buffers)."""
+    magic, n = struct.unpack_from("<II", src, 0)
+    if magic != MAGIC:
+        raise ValueError("corrupt object: bad magic")
+    sizes = struct.unpack_from(f"<{n}Q", src, 8)
+    off = _align(8 + 8 * n)
+    views: List[memoryview] = []
+    for size in sizes:
+        views.append(src[off : off + size])
+        off += _align(size)
+    return pickle.loads(views[0], buffers=views[1:])
+
+
+def dumps(value: Any) -> bytes:
+    """One-shot serialize to a self-contained frame (for RPC inlining)."""
+    return pack(*serialize(value))
+
+
+def loads(data: bytes | memoryview) -> Any:
+    return deserialize(memoryview(data))

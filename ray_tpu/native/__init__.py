@@ -1,0 +1,70 @@
+"""Native (C++) components and their ctypes bindings."""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_DIR, "build", "libshm_store.so")
+_build_lock = threading.Lock()
+
+
+def _build() -> None:
+    subprocess.run(
+        ["make", "-s", "-C", _DIR],
+        check=True,
+        capture_output=True,
+    )
+
+
+def load_shm_store() -> ctypes.CDLL:
+    """Load (building on demand) the native shared-memory store library."""
+    with _build_lock:
+        src = os.path.join(_DIR, "shm_store.cc")
+        if not os.path.exists(_SO) or (
+            os.path.exists(src) and os.path.getmtime(src) > os.path.getmtime(_SO)
+        ):
+            _build()
+    lib = ctypes.CDLL(_SO)
+    lib.ss_create_store.argtypes = [ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint32]
+    lib.ss_create_store.restype = ctypes.c_int
+    lib.ss_attach.argtypes = [ctypes.c_char_p]
+    lib.ss_attach.restype = ctypes.c_int
+    lib.ss_create.argtypes = [ctypes.c_int, ctypes.c_char_p, ctypes.c_uint64]
+    lib.ss_create.restype = ctypes.c_int64
+    lib.ss_seal.argtypes = [ctypes.c_int, ctypes.c_char_p]
+    lib.ss_seal.restype = ctypes.c_int
+    lib.ss_get.argtypes = [
+        ctypes.c_int,
+        ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_uint64),
+        ctypes.c_double,
+    ]
+    lib.ss_get.restype = ctypes.c_int64
+    lib.ss_contains.argtypes = [ctypes.c_int, ctypes.c_char_p]
+    lib.ss_contains.restype = ctypes.c_int
+    lib.ss_release.argtypes = [ctypes.c_int, ctypes.c_char_p]
+    lib.ss_release.restype = ctypes.c_int
+    lib.ss_delete.argtypes = [ctypes.c_int, ctypes.c_char_p]
+    lib.ss_delete.restype = ctypes.c_int
+    lib.ss_evict.argtypes = [ctypes.c_int, ctypes.c_uint64]
+    lib.ss_evict.restype = ctypes.c_uint64
+    lib.ss_stats.argtypes = [
+        ctypes.c_int,
+        ctypes.POINTER(ctypes.c_uint64),
+        ctypes.POINTER(ctypes.c_uint64),
+        ctypes.POINTER(ctypes.c_uint32),
+    ]
+    lib.ss_stats.restype = None
+    lib.ss_data_offset.argtypes = [ctypes.c_int]
+    lib.ss_data_offset.restype = ctypes.c_uint64
+    lib.ss_map_size.argtypes = [ctypes.c_int]
+    lib.ss_map_size.restype = ctypes.c_uint64
+    lib.ss_detach.argtypes = [ctypes.c_int]
+    lib.ss_detach.restype = ctypes.c_int
+    lib.ss_unlink_store.argtypes = [ctypes.c_char_p]
+    lib.ss_unlink_store.restype = ctypes.c_int
+    return lib

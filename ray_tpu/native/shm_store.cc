@@ -1,0 +1,564 @@
+// shm_store.cc — per-node shared-memory immutable object store.
+//
+// TPU-native equivalent of the reference's plasma store
+// (src/ray/object_manager/plasma/{store.h,object_lifecycle_manager.h,
+// plasma_allocator.h,eviction_policy.h}), redesigned for simplicity:
+// instead of a store *server* process speaking a unix-socket flatbuffer
+// protocol with fd passing, every process on the node maps one shared
+// memory arena and manipulates the object index directly under a
+// process-shared robust mutex. Object creation/sealing/getting are plain
+// in-memory operations — no RPC in the data path at all. The raylet owns
+// the arena lifecycle; workers attach.
+//
+// Layout of the arena:
+//   [ Header | Slot[table_cap] | data region ... ]
+//
+// - Allocator: address-ordered first-fit free list with coalescing, 64-byte
+//   aligned blocks (plasma uses an embedded dlmalloc; a free list is enough
+//   here because objects are large and few).
+// - Object index: linear-probing open-addressed hash table of fixed slot
+//   count, keyed by 16-byte object ids.
+// - Eviction: LRU over sealed, refcount==0 objects (reference:
+//   eviction_policy.h), triggered automatically when a create fails.
+// - Blocking get: process-shared condvar broadcast on every seal.
+//
+// Build: g++ -O2 -shared -fPIC -o libshm_store.so shm_store.cc -lpthread -lrt
+
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <ctime>
+
+#include <fcntl.h>
+#include <pthread.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint64_t kMagic = 0x52415953544f5245ULL;  // "RAYSTORE"
+constexpr uint32_t kVersion = 1;
+constexpr uint64_t kAlign = 64;
+constexpr uint32_t kIdSize = 16;
+
+// Slot states.
+enum : uint32_t { EMPTY = 0, CREATED = 1, SEALED = 2, TOMB = 3 };
+
+// Error codes (mirrored in the python wrapper).
+enum : int64_t {
+  SS_OK = 0,
+  SS_EXISTS = -1,
+  SS_NOT_FOUND = -2,
+  SS_NO_MEMORY = -3,
+  SS_TABLE_FULL = -4,
+  SS_TIMEOUT = -5,
+  SS_NOT_SEALED = -6,
+  SS_SYS = -7,
+  SS_BAD_HANDLE = -8,
+};
+
+struct Slot {
+  uint8_t id[kIdSize];
+  uint64_t offset;  // data offset relative to data region base
+  uint64_t size;       // user-visible data size
+  uint64_t alloc_size; // actual bytes taken from the allocator (>= size)
+  uint32_t state;
+  uint32_t refcount;
+  // LRU doubly-linked list, values are slot_index + 1 (0 = nil).
+  uint32_t lru_prev;
+  uint32_t lru_next;
+};
+
+struct Header {
+  uint64_t magic;
+  uint32_t version;
+  uint32_t table_cap;
+  uint64_t capacity;   // data region bytes
+  uint64_t allocated;  // bytes currently allocated
+  uint64_t data_off;   // offset of data region from arena base
+  uint32_t num_objects;
+  uint32_t _pad;
+  uint64_t free_head;  // offset (data-relative) of first free block, ~0 = nil
+  uint32_t lru_head;   // most-recently-used, slot_index + 1
+  uint32_t lru_tail;   // least-recently-used
+  pthread_mutex_t mutex;
+  pthread_cond_t sealed_cv;
+};
+
+struct FreeBlock {
+  uint64_t size;
+  uint64_t next;  // data-relative offset of next free block, ~0 = nil
+};
+
+constexpr uint64_t kNil = ~0ULL;
+
+struct Store {
+  uint8_t* base = nullptr;
+  uint64_t map_size = 0;
+  Header* hdr = nullptr;
+  Slot* slots = nullptr;
+  uint8_t* data = nullptr;
+  bool used = false;
+};
+
+constexpr int kMaxHandles = 64;
+Store g_stores[kMaxHandles];
+
+inline uint64_t align_up(uint64_t n) { return (n + kAlign - 1) & ~(kAlign - 1); }
+
+inline FreeBlock* fb(Store* s, uint64_t off) {
+  return reinterpret_cast<FreeBlock*>(s->data + off);
+}
+
+class Guard {
+ public:
+  explicit Guard(Header* h) : h_(h) {
+    int rc = pthread_mutex_lock(&h_->mutex);
+    if (rc == EOWNERDEAD) {
+      // A process died holding the lock; the index may be mid-update but all
+      // mutations below are ordered so partially-applied states are benign
+      // (worst case: a leaked allocation, reclaimed by eviction).
+      pthread_mutex_consistent(&h_->mutex);
+    }
+  }
+  ~Guard() { pthread_mutex_unlock(&h_->mutex); }
+
+ private:
+  Header* h_;
+};
+
+uint64_t hash_id(const uint8_t* id) {
+  uint64_t h;
+  memcpy(&h, id, 8);
+  h ^= h >> 33;
+  h *= 0xff51afd7ed558ccdULL;
+  h ^= h >> 33;
+  return h;
+}
+
+// Find slot holding `id`; returns nullptr if absent. If `insert_pos` is
+// non-null, sets it to the first usable (EMPTY/TOMB) slot on the probe path.
+Slot* find_slot(Store* s, const uint8_t* id, Slot** insert_pos = nullptr) {
+  Header* h = s->hdr;
+  uint32_t cap = h->table_cap;
+  uint64_t idx = hash_id(id) % cap;
+  Slot* first_free = nullptr;
+  for (uint32_t probe = 0; probe < cap; ++probe) {
+    Slot* sl = &s->slots[(idx + probe) % cap];
+    if (sl->state == EMPTY) {
+      if (insert_pos) *insert_pos = first_free ? first_free : sl;
+      return nullptr;
+    }
+    if (sl->state == TOMB) {
+      if (!first_free) first_free = sl;
+      continue;
+    }
+    if (memcmp(sl->id, id, kIdSize) == 0) return sl;
+  }
+  if (insert_pos) *insert_pos = first_free;
+  return nullptr;
+}
+
+// --- LRU list (only sealed objects participate) ---
+
+void lru_unlink(Store* s, Slot* sl) {
+  Header* h = s->hdr;
+  uint32_t self = static_cast<uint32_t>(sl - s->slots) + 1;
+  if (sl->lru_prev)
+    s->slots[sl->lru_prev - 1].lru_next = sl->lru_next;
+  else if (h->lru_head == self)
+    h->lru_head = sl->lru_next;
+  if (sl->lru_next)
+    s->slots[sl->lru_next - 1].lru_prev = sl->lru_prev;
+  else if (h->lru_tail == self)
+    h->lru_tail = sl->lru_prev;
+  sl->lru_prev = sl->lru_next = 0;
+}
+
+void lru_push_front(Store* s, Slot* sl) {
+  Header* h = s->hdr;
+  uint32_t self = static_cast<uint32_t>(sl - s->slots) + 1;
+  sl->lru_prev = 0;
+  sl->lru_next = h->lru_head;
+  if (h->lru_head) s->slots[h->lru_head - 1].lru_prev = self;
+  h->lru_head = self;
+  if (!h->lru_tail) h->lru_tail = self;
+}
+
+// --- allocator ---
+
+// On success returns the block offset and sets *granted to the actual bytes
+// consumed (the whole block when the remainder is too small to split — the
+// caller must record this so the full block is returned on free).
+int64_t alloc_block(Store* s, uint64_t want, uint64_t* granted) {
+  Header* h = s->hdr;
+  want = align_up(want);
+  uint64_t prev = kNil;
+  uint64_t cur = h->free_head;
+  while (cur != kNil) {
+    FreeBlock* blk = fb(s, cur);
+    if (blk->size >= want) {
+      uint64_t remain = blk->size - want;
+      if (remain >= kAlign + sizeof(FreeBlock)) {
+        uint64_t rest = cur + want;
+        FreeBlock* rb = fb(s, rest);
+        rb->size = remain;
+        rb->next = blk->next;
+        if (prev == kNil) h->free_head = rest; else fb(s, prev)->next = rest;
+      } else {
+        if (prev == kNil) h->free_head = blk->next; else fb(s, prev)->next = blk->next;
+        want = blk->size;
+      }
+      h->allocated += want;
+      *granted = want;
+      return static_cast<int64_t>(cur);
+    }
+    prev = cur;
+    cur = blk->next;
+  }
+  return SS_NO_MEMORY;
+}
+
+void free_block(Store* s, uint64_t off, uint64_t size) {
+  Header* h = s->hdr;
+  h->allocated -= size;
+  // Address-ordered insert with neighbor coalescing.
+  uint64_t prev = kNil, cur = h->free_head;
+  while (cur != kNil && cur < off) {
+    prev = cur;
+    cur = fb(s, cur)->next;
+  }
+  uint64_t next = cur;
+  // Merge with next.
+  if (next != kNil && off + size == next) {
+    size += fb(s, next)->size;
+    next = fb(s, next)->next;
+  }
+  // Merge with prev.
+  if (prev != kNil && prev + fb(s, prev)->size == off) {
+    fb(s, prev)->size += size;
+    fb(s, prev)->next = next;
+    return;
+  }
+  FreeBlock* blk = fb(s, off);
+  blk->size = size;
+  blk->next = next;
+  if (prev == kNil) h->free_head = off; else fb(s, prev)->next = off;
+}
+
+// Convert a just-tombstoned slot (and any tombstone run ending at it) back to
+// EMPTY when the next probe slot is EMPTY — bounds probe-path degradation
+// under create/delete churn.
+void scrub_tombstones(Store* s, Slot* sl) {
+  uint32_t cap = s->hdr->table_cap;
+  uint32_t idx = static_cast<uint32_t>(sl - s->slots);
+  if (s->slots[(idx + 1) % cap].state != EMPTY) return;
+  for (uint32_t back = 0; back < cap; ++back) {
+    Slot* cur = &s->slots[(idx + cap - back) % cap];
+    if (cur->state != TOMB) break;
+    cur->state = EMPTY;
+  }
+}
+
+// Evict LRU sealed refcount==0 objects until at least `need` bytes were
+// reclaimed (or nothing evictable remains). Returns bytes evicted.
+uint64_t evict_locked(Store* s, uint64_t need) {
+  Header* h = s->hdr;
+  uint64_t evicted = 0;
+  uint32_t cur = h->lru_tail;
+  while (cur && evicted < need) {
+    Slot* sl = &s->slots[cur - 1];
+    uint32_t next = sl->lru_prev;
+    if (sl->state == SEALED && sl->refcount == 0) {
+      lru_unlink(s, sl);
+      free_block(s, sl->offset, sl->alloc_size);
+      evicted += sl->alloc_size;
+      sl->state = TOMB;
+      scrub_tombstones(s, sl);
+      h->num_objects--;
+    }
+    cur = next;
+  }
+  return evicted;
+}
+
+// Guards the process-local handle table (ctypes calls release the GIL, so
+// two Python threads can attach concurrently).
+pthread_mutex_t g_handle_mutex = PTHREAD_MUTEX_INITIALIZER;
+
+int attach_common(const char* name, bool create, uint64_t capacity,
+                  uint32_t table_cap) {
+  pthread_mutex_lock(&g_handle_mutex);
+  int handle = -1;
+  for (int i = 0; i < kMaxHandles; ++i) {
+    if (!g_stores[i].used) { handle = i; break; }
+  }
+  if (handle >= 0) g_stores[handle].used = true;  // reserve before the slow path
+  pthread_mutex_unlock(&g_handle_mutex);
+  if (handle < 0) return static_cast<int>(SS_BAD_HANDLE);
+  auto fail = [&](int64_t code) {
+    pthread_mutex_lock(&g_handle_mutex);
+    g_stores[handle].used = false;
+    pthread_mutex_unlock(&g_handle_mutex);
+    return static_cast<int>(code);
+  };
+
+  int flags = create ? (O_RDWR | O_CREAT | O_EXCL) : O_RDWR;
+  int fd = shm_open(name, flags, 0600);
+  if (fd < 0) return fail(SS_SYS);
+
+  uint64_t hdr_bytes = align_up(sizeof(Header));
+  uint64_t map_size;
+  if (create) {
+    uint64_t slots_bytes = align_up(sizeof(Slot) * static_cast<uint64_t>(table_cap));
+    map_size = hdr_bytes + slots_bytes + capacity;
+    if (ftruncate(fd, static_cast<off_t>(map_size)) != 0) {
+      close(fd);
+      shm_unlink(name);
+      return fail(SS_SYS);
+    }
+  } else {
+    struct stat st;
+    if (fstat(fd, &st) != 0) { close(fd); return fail(SS_SYS); }
+    map_size = static_cast<uint64_t>(st.st_size);
+  }
+
+  void* base = mmap(nullptr, map_size, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);
+  if (base == MAP_FAILED) return fail(SS_SYS);
+
+  Store* s = &g_stores[handle];
+  s->base = static_cast<uint8_t*>(base);
+  s->map_size = map_size;
+  s->hdr = reinterpret_cast<Header*>(base);
+
+  if (create) {
+    Header* h = s->hdr;
+    memset(h, 0, sizeof(Header));
+    h->magic = kMagic;
+    h->version = kVersion;
+    h->table_cap = table_cap;
+    h->capacity = capacity;
+    h->data_off = hdr_bytes + align_up(sizeof(Slot) * static_cast<uint64_t>(table_cap));
+    h->free_head = 0;
+    h->lru_head = h->lru_tail = 0;
+
+    pthread_mutexattr_t ma;
+    pthread_mutexattr_init(&ma);
+    pthread_mutexattr_setpshared(&ma, PTHREAD_PROCESS_SHARED);
+    pthread_mutexattr_setrobust(&ma, PTHREAD_MUTEX_ROBUST);
+    pthread_mutex_init(&h->mutex, &ma);
+    pthread_mutexattr_destroy(&ma);
+
+    pthread_condattr_t ca;
+    pthread_condattr_init(&ca);
+    pthread_condattr_setpshared(&ca, PTHREAD_PROCESS_SHARED);
+    pthread_condattr_setclock(&ca, CLOCK_MONOTONIC);
+    pthread_cond_init(&h->sealed_cv, &ca);
+    pthread_condattr_destroy(&ca);
+
+    s->slots = reinterpret_cast<Slot*>(s->base + hdr_bytes);
+    memset(s->slots, 0, sizeof(Slot) * table_cap);
+    s->data = s->base + h->data_off;
+    FreeBlock* blk = fb(s, 0);
+    blk->size = capacity;
+    blk->next = kNil;
+  } else {
+    Header* h = s->hdr;
+    if (h->magic != kMagic || h->version != kVersion) {
+      munmap(base, map_size);
+      return fail(SS_SYS);
+    }
+    s->slots = reinterpret_cast<Slot*>(s->base + hdr_bytes);
+    s->data = s->base + h->data_off;
+  }
+  s->used = true;
+  return handle;
+}
+
+Store* get_store(int handle) {
+  if (handle < 0 || handle >= kMaxHandles || !g_stores[handle].used) return nullptr;
+  return &g_stores[handle];
+}
+
+}  // namespace
+
+extern "C" {
+
+// Create a new arena (raylet). Returns handle >= 0 or negative error.
+int ss_create_store(const char* name, uint64_t capacity, uint32_t table_cap) {
+  shm_unlink(name);  // drop any stale arena from a crashed prior session
+  return attach_common(name, /*create=*/true, align_up(capacity), table_cap);
+}
+
+// Attach to an existing arena (worker). Returns handle >= 0 or negative error.
+int ss_attach(const char* name) {
+  return attach_common(name, /*create=*/false, 0, 0);
+}
+
+// Allocate an object buffer. Returns data-region-relative offset, or error.
+// The new object has refcount 1 (the creator) and is invisible to get()
+// until sealed.
+int64_t ss_create(int handle, const uint8_t* id, uint64_t size) {
+  Store* s = get_store(handle);
+  if (!s) return SS_BAD_HANDLE;
+  if (size == 0) size = kAlign;
+  Guard g(s->hdr);
+  Slot* insert = nullptr;
+  if (find_slot(s, id, &insert)) return SS_EXISTS;
+  if (!insert) return SS_TABLE_FULL;
+  uint64_t granted = 0;
+  int64_t off = alloc_block(s, size, &granted);
+  // Evict until the allocation fits (not merely until `size` bytes were
+  // reclaimed): freed blocks may not coalesce into a large-enough run.
+  while (off == SS_NO_MEMORY) {
+    if (evict_locked(s, align_up(size)) == 0) return SS_NO_MEMORY;
+    off = alloc_block(s, size, &granted);
+  }
+  memcpy(insert->id, id, kIdSize);
+  insert->offset = static_cast<uint64_t>(off);
+  insert->size = size;
+  insert->alloc_size = granted;
+  insert->state = CREATED;
+  insert->refcount = 1;
+  insert->lru_prev = insert->lru_next = 0;
+  s->hdr->num_objects++;
+  return off;
+}
+
+// Seal a created object: becomes immutable and visible to get().
+int ss_seal(int handle, const uint8_t* id) {
+  Store* s = get_store(handle);
+  if (!s) return SS_BAD_HANDLE;
+  Guard g(s->hdr);
+  Slot* sl = find_slot(s, id);
+  if (!sl) return SS_NOT_FOUND;
+  if (sl->state == SEALED) return SS_EXISTS;
+  sl->state = SEALED;
+  lru_push_front(s, sl);
+  pthread_cond_broadcast(&s->hdr->sealed_cv);
+  return SS_OK;
+}
+
+// Get a sealed object, incrementing its refcount and bumping LRU.
+// Blocks up to timeout_s (<0: no wait; 0: forever) for the object to appear
+// and be sealed. On success fills *size_out and returns the data offset.
+int64_t ss_get(int handle, const uint8_t* id, uint64_t* size_out,
+               double timeout_s) {
+  Store* s = get_store(handle);
+  if (!s) return SS_BAD_HANDLE;
+  Header* h = s->hdr;
+  struct timespec deadline;
+  if (timeout_s > 0) {
+    clock_gettime(CLOCK_MONOTONIC, &deadline);
+    deadline.tv_sec += static_cast<time_t>(timeout_s);
+    deadline.tv_nsec += static_cast<long>((timeout_s - static_cast<time_t>(timeout_s)) * 1e9);
+    if (deadline.tv_nsec >= 1000000000L) {
+      deadline.tv_sec += 1;
+      deadline.tv_nsec -= 1000000000L;
+    }
+  }
+  Guard g(h);
+  for (;;) {
+    Slot* sl = find_slot(s, id);
+    if (sl && sl->state == SEALED) {
+      sl->refcount++;
+      lru_unlink(s, sl);
+      lru_push_front(s, sl);
+      *size_out = sl->size;
+      return static_cast<int64_t>(sl->offset);
+    }
+    if (timeout_s < 0) return sl ? SS_NOT_SEALED : SS_NOT_FOUND;
+    int rc;
+    if (timeout_s == 0) {
+      rc = pthread_cond_wait(&h->sealed_cv, &h->mutex);
+    } else {
+      rc = pthread_cond_timedwait(&h->sealed_cv, &h->mutex, &deadline);
+    }
+    if (rc == ETIMEDOUT) return SS_TIMEOUT;
+  }
+}
+
+// 0 = absent, 1 = created (unsealed), 2 = sealed.
+int ss_contains(int handle, const uint8_t* id) {
+  Store* s = get_store(handle);
+  if (!s) return SS_BAD_HANDLE;
+  Guard g(s->hdr);
+  Slot* sl = find_slot(s, id);
+  if (!sl) return 0;
+  return sl->state == SEALED ? 2 : 1;
+}
+
+// Drop one reference (creator after seal, or a getter when done).
+int ss_release(int handle, const uint8_t* id) {
+  Store* s = get_store(handle);
+  if (!s) return SS_BAD_HANDLE;
+  Guard g(s->hdr);
+  Slot* sl = find_slot(s, id);
+  if (!sl) return SS_NOT_FOUND;
+  if (sl->refcount > 0) sl->refcount--;
+  return SS_OK;
+}
+
+// Delete an object immediately (abort an unsealed create, or force-remove).
+int ss_delete(int handle, const uint8_t* id) {
+  Store* s = get_store(handle);
+  if (!s) return SS_BAD_HANDLE;
+  Guard g(s->hdr);
+  Slot* sl = find_slot(s, id);
+  if (!sl) return SS_NOT_FOUND;
+  if (sl->state == SEALED) lru_unlink(s, sl);
+  free_block(s, sl->offset, sl->alloc_size);
+  sl->state = TOMB;
+  scrub_tombstones(s, sl);
+  s->hdr->num_objects--;
+  return SS_OK;
+}
+
+// Evict at least `nbytes` of LRU sealed unreferenced data. Returns evicted.
+uint64_t ss_evict(int handle, uint64_t nbytes) {
+  Store* s = get_store(handle);
+  if (!s) return 0;
+  Guard g(s->hdr);
+  return evict_locked(s, nbytes);
+}
+
+void ss_stats(int handle, uint64_t* capacity, uint64_t* allocated,
+              uint32_t* num_objects) {
+  Store* s = get_store(handle);
+  if (!s) { *capacity = *allocated = 0; *num_objects = 0; return; }
+  Guard g(s->hdr);
+  *capacity = s->hdr->capacity;
+  *allocated = s->hdr->allocated;
+  *num_objects = s->hdr->num_objects;
+}
+
+// Byte offset of the data region from the start of the shm file (so Python
+// can mmap the same file and compute zero-copy views).
+uint64_t ss_data_offset(int handle) {
+  Store* s = get_store(handle);
+  return s ? s->hdr->data_off : 0;
+}
+
+uint64_t ss_map_size(int handle) {
+  Store* s = get_store(handle);
+  return s ? s->map_size : 0;
+}
+
+int ss_detach(int handle) {
+  Store* s = get_store(handle);
+  if (!s) return SS_BAD_HANDLE;
+  munmap(s->base, s->map_size);
+  pthread_mutex_lock(&g_handle_mutex);
+  s->base = nullptr;
+  s->used = false;
+  pthread_mutex_unlock(&g_handle_mutex);
+  return SS_OK;
+}
+
+int ss_unlink_store(const char* name) {
+  return shm_unlink(name) == 0 ? SS_OK : static_cast<int>(SS_SYS);
+}
+
+}  // extern "C"

@@ -1,0 +1,39 @@
+"""Test configuration.
+
+JAX-facing tests run on a virtual 8-device CPU mesh (the reference's
+`ray_start_cluster`-style multi-node-on-one-machine testing mechanism,
+adapted to device meshes): set platform/device-count env vars before jax is
+imported anywhere.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def shm_store():
+    """A fresh native shared-memory store, destroyed at teardown."""
+    from ray_tpu._private.object_store import ObjectStore
+
+    name = f"/ray_tpu_test_{os.getpid()}_{os.urandom(4).hex()}"
+    store = ObjectStore.create(name, capacity=64 * 1024 * 1024, table_size=4096)
+    yield store
+    store.destroy()
+
+
+@pytest.fixture
+def ray_start():
+    """Start a single-node ray_tpu cluster for the duration of a test."""
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=4, object_store_memory=128 * 1024 * 1024)
+    yield ray_tpu
+    ray_tpu.shutdown()

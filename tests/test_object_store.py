@@ -1,0 +1,141 @@
+"""Native shm object store: create/seal/get/evict across processes.
+
+Covers the behavior the reference exercises in
+`src/ray/object_manager/plasma/test/` (create/seal/get lifecycle, eviction,
+aborts) plus zero-copy numpy reads.
+"""
+
+import multiprocessing
+import os
+
+import numpy as np
+import pytest
+
+from ray_tpu._private import serialization
+from ray_tpu._private.ids import ObjectID
+from ray_tpu._private.object_store import (
+    ObjectStore,
+    ObjectStoreError,
+    ObjectStoreFullError,
+)
+
+
+def test_create_seal_get_roundtrip(shm_store):
+    oid = ObjectID.from_random()
+    payload = b"hello world" * 100
+    buf = shm_store.create_buffer(oid, len(payload))
+    buf[:] = payload
+    shm_store.seal(oid)
+    out = shm_store.get_buffer(oid)
+    assert bytes(out) == payload
+    assert shm_store.contains(oid)
+
+
+def test_get_missing_returns_none(shm_store):
+    assert shm_store.get_buffer(ObjectID.from_random()) is None
+
+
+def test_unsealed_invisible(shm_store):
+    oid = ObjectID.from_random()
+    shm_store.create_buffer(oid, 128)
+    assert not shm_store.contains(oid)
+    assert shm_store.get_buffer(oid) is None
+    shm_store.seal(oid)
+    assert shm_store.contains(oid)
+
+
+def test_duplicate_create_rejected(shm_store):
+    oid = ObjectID.from_random()
+    shm_store.create_buffer(oid, 64)
+    with pytest.raises(ObjectStoreError):
+        shm_store.create_buffer(oid, 64)
+
+
+def test_serialized_numpy_zero_copy(shm_store):
+    oid = ObjectID.from_random()
+    arr = np.arange(100000, dtype=np.float32)
+    pickled, buffers = serialization.serialize(arr)
+    shm_store.put_serialized(oid, pickled, buffers)
+    out = shm_store.get(oid)
+    np.testing.assert_array_equal(out, arr)
+    # The deserialized array must be a view over shared memory, not a copy.
+    assert not out.flags["OWNDATA"]
+
+
+def test_delete_frees_space(shm_store):
+    oid = ObjectID.from_random()
+    shm_store.create_buffer(oid, 1024 * 1024)
+    shm_store.seal(oid)
+    before = shm_store.stats()["allocated"]
+    shm_store.delete(oid)
+    after = shm_store.stats()["allocated"]
+    assert after < before
+    assert shm_store.get_buffer(oid) is None
+
+
+def test_lru_eviction_on_full(shm_store):
+    # Fill the 64MB store with 8MB objects, then create one more: the least
+    # recently used unreferenced object must be evicted to make room.
+    oids = []
+    for _ in range(7):
+        oid = ObjectID.from_random()
+        buf = shm_store.create_buffer(oid, 8 * 1024 * 1024)
+        buf[:4] = b"abcd"
+        shm_store.seal(oid)
+        shm_store.release(oid)  # creator drops its ref -> evictable
+        oids.append(oid)
+    extra = ObjectID.from_random()
+    shm_store.create_buffer(extra, 16 * 1024 * 1024)
+    shm_store.seal(extra)
+    # The oldest object(s) are gone; the newest survives.
+    assert shm_store.get_buffer(oids[0], timeout=-1) is None
+    assert shm_store.contains(extra)
+
+
+def test_referenced_objects_not_evicted(shm_store):
+    pinned = ObjectID.from_random()
+    buf = shm_store.create_buffer(pinned, 30 * 1024 * 1024)
+    buf[:4] = b"pin!"
+    shm_store.seal(pinned)  # creator still holds a ref
+    with pytest.raises(ObjectStoreFullError):
+        shm_store.create_buffer(ObjectID.from_random(), 50 * 1024 * 1024)
+    assert bytes(shm_store.get_buffer(pinned)[:4]) == b"pin!"
+
+
+def _child_reader(name, oid_bytes, q):
+    store = ObjectStore.attach(name)
+    buf = store.get_buffer(ObjectID(oid_bytes), timeout=10)
+    q.put(bytes(buf[:16]))
+    store.close()
+
+
+def test_cross_process_get():
+    name = f"/ray_tpu_test_xp_{os.getpid()}"
+    store = ObjectStore.create(name, capacity=16 * 1024 * 1024, table_size=256)
+    try:
+        oid = ObjectID.from_random()
+        ctx = multiprocessing.get_context("spawn")
+        q = ctx.Queue()
+        # Reader starts BEFORE the object exists: exercises blocking get.
+        proc = ctx.Process(target=_child_reader, args=(name, oid.binary(), q))
+        proc.start()
+        buf = store.create_buffer(oid, 1024)
+        buf[:16] = b"cross-proc-data!"
+        store.seal(oid)
+        assert q.get(timeout=20) == b"cross-proc-data!"
+        proc.join(timeout=10)
+    finally:
+        store.destroy()
+
+
+def test_coalescing_allocator(shm_store):
+    # Allocate the entire region in chunks, free them all, then allocate one
+    # object nearly the full size: only works if free blocks coalesce.
+    oids = [ObjectID.from_random() for _ in range(8)]
+    for oid in oids:
+        shm_store.create_buffer(oid, 7 * 1024 * 1024)
+    for oid in oids:
+        shm_store.delete(oid)
+    big = ObjectID.from_random()
+    buf = shm_store.create_buffer(big, 55 * 1024 * 1024)
+    assert buf.nbytes == 55 * 1024 * 1024

@@ -1,0 +1,121 @@
+import asyncio
+
+import pytest
+
+from ray_tpu._private.rpc import ClientPool, RpcClient, RpcError, RpcServer
+
+
+@pytest.fixture
+def loop():
+    loop = asyncio.new_event_loop()
+    yield loop
+    loop.close()
+
+
+def test_request_reply(loop):
+    async def main():
+        server = RpcServer()
+
+        async def echo(payload):
+            return {"echoed": payload["msg"]}
+
+        server.register("echo", echo)
+        await server.start()
+        client = await RpcClient(server.address).connect()
+        out = await client.call("echo", {"msg": "hi"})
+        assert out == {"echoed": "hi"}
+        await client.close()
+        await server.stop()
+
+    loop.run_until_complete(main())
+
+
+def test_remote_error_propagates(loop):
+    async def main():
+        server = RpcServer()
+
+        async def boom(payload):
+            raise ValueError("kaboom")
+
+        server.register("boom", boom)
+        await server.start()
+        client = await RpcClient(server.address).connect()
+        with pytest.raises(RpcError, match="kaboom"):
+            await client.call("boom", {})
+        await client.close()
+        await server.stop()
+
+    loop.run_until_complete(main())
+
+
+def test_concurrent_requests_interleave(loop):
+    async def main():
+        server = RpcServer()
+
+        async def slow(payload):
+            await asyncio.sleep(payload["t"])
+            return payload["t"]
+
+        server.register("slow", slow)
+        await server.start()
+        client = await RpcClient(server.address).connect()
+        # Issue slow-then-fast; fast must not be blocked behind slow.
+        results = await asyncio.gather(
+            client.call("slow", {"t": 0.3}), client.call("slow", {"t": 0.01})
+        )
+        assert results == [0.3, 0.01]
+        await client.close()
+        await server.stop()
+
+    loop.run_until_complete(main())
+
+
+def test_binary_payload(loop):
+    async def main():
+        server = RpcServer()
+
+        async def double(payload):
+            return payload + payload
+
+        server.register("double", double)
+        await server.start()
+        client = await RpcClient(server.address).connect()
+        blob = bytes(range(256)) * 100
+        assert await client.call("double", blob) == blob + blob
+        await client.close()
+        await server.stop()
+
+    loop.run_until_complete(main())
+
+
+def test_client_pool_reuses_connections(loop):
+    async def main():
+        server = RpcServer()
+
+        async def ping(payload):
+            return "pong"
+
+        server.register("ping", ping)
+        await server.start()
+        pool = ClientPool()
+        c1 = await pool.get(server.address)
+        c2 = await pool.get(server.address)
+        assert c1 is c2
+        assert await c1.call("ping", {}) == "pong"
+        await pool.close_all()
+        await server.stop()
+
+    loop.run_until_complete(main())
+
+
+def test_ids():
+    from ray_tpu._private.ids import JobID, ObjectID, TaskID
+
+    job = JobID.from_int(1)
+    t = TaskID.for_driver(job)
+    o1 = ObjectID.for_task_return(t, 0)
+    o2 = ObjectID.for_task_return(t, 1)
+    assert o1 != o2
+    assert ObjectID.for_task_return(t, 0) == o1  # deterministic
+    assert len(o1.binary()) == 16
+    assert ObjectID.from_hex(o1.hex()) == o1

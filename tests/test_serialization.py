@@ -1,0 +1,48 @@
+import numpy as np
+
+from ray_tpu._private import serialization
+
+
+def test_roundtrip_basic():
+    for value in [1, "x", [1, 2, {"a": (3, 4)}], None, b"bytes", {1: 2}]:
+        assert serialization.loads(serialization.dumps(value)) == value
+
+
+def test_numpy_out_of_band():
+    arr = np.random.rand(1000, 10)
+    pickled, buffers = serialization.serialize(arr)
+    assert len(buffers) == 1  # array payload captured out-of-band
+    out = serialization.loads(serialization.pack(pickled, buffers))
+    np.testing.assert_array_equal(out, arr)
+
+
+def test_nested_arrays():
+    value = {"a": np.arange(10), "b": [np.ones(5), "text"]}
+    out = serialization.loads(serialization.dumps(value))
+    np.testing.assert_array_equal(out["a"], value["a"])
+    np.testing.assert_array_equal(out["b"][0], value["b"][0])
+    assert out["b"][1] == "text"
+
+
+def test_custom_serializer():
+    class Opaque:
+        def __init__(self, v):
+            self.v = v
+
+    serialization.register_serializer(
+        Opaque,
+        serializer=lambda o: o.v * 2,
+        deserializer=lambda payload: Opaque(payload),
+    )
+    try:
+        out = serialization.loads(serialization.dumps(Opaque(21)))
+        assert out.v == 42
+    finally:
+        serialization.deregister_serializer(Opaque)
+
+
+def test_closures_cloudpickled():
+    x = 10
+    fn = lambda y: x + y  # noqa: E731
+    out = serialization.loads(serialization.dumps(fn))
+    assert out(5) == 15

@@ -1,0 +1,82 @@
+"""Object-store reference lifetime and allocator accounting regressions."""
+
+import gc
+
+import numpy as np
+
+from ray_tpu._private import serialization
+from ray_tpu._private.ids import ObjectID
+
+
+def _put(store, oid, nbytes):
+    buf = store.create_buffer(oid, nbytes)
+    buf[:4] = b"xxxx"
+    store.seal(oid)
+    store.release(oid)  # creator drops its ref
+
+
+def test_reader_ref_released_on_gc(shm_store):
+    """A get() pins the object only while views of it are alive."""
+    oid = ObjectID.from_random()
+    arr = np.zeros(4 * 1024 * 1024, dtype=np.uint8)
+    pickled, bufs = serialization.serialize(arr)
+    shm_store.put_serialized(oid, pickled, bufs)
+
+    out = shm_store.get(oid)
+    assert out is not None
+    del out
+    gc.collect()
+    # With the reader's ref dropped, the object must be evictable.
+    assert shm_store.evict(1) >= 4 * 1024 * 1024
+    assert shm_store.get_buffer(oid) is None
+
+
+def test_live_view_blocks_eviction(shm_store):
+    oid = ObjectID.from_random()
+    arr = np.zeros(4 * 1024 * 1024, dtype=np.uint8)
+    pickled, bufs = serialization.serialize(arr)
+    shm_store.put_serialized(oid, pickled, bufs)
+    out = shm_store.get(oid)  # live numpy view holds a store ref
+    assert shm_store.evict(1) == 0
+    assert out.sum() == 0  # memory still intact
+
+
+def test_allocator_accounting_balances(shm_store):
+    """create/delete churn with odd sizes must return allocated to baseline
+    (regression: whole-block grants used to leak the unsplit remainder)."""
+    baseline = shm_store.stats()["allocated"]
+    for round_ in range(5):
+        oids = [ObjectID.from_random() for _ in range(50)]
+        for i, oid in enumerate(oids):
+            shm_store.create_buffer(oid, 1000 + 37 * i + round_)
+        for oid in oids:
+            shm_store.delete(oid)
+    assert shm_store.stats()["allocated"] == baseline
+
+
+def test_churn_keeps_lookups_fast(shm_store):
+    """Heavy create/delete churn must not degrade absent-id lookups
+    (regression: tombstone accumulation)."""
+    import time
+
+    for _ in range(20):
+        oids = [ObjectID.from_random() for _ in range(100)]
+        for oid in oids:
+            shm_store.create_buffer(oid, 256)
+        for oid in oids:
+            shm_store.delete(oid)
+    start = time.perf_counter()
+    for _ in range(1000):
+        shm_store.contains(ObjectID.from_random())
+    elapsed = time.perf_counter() - start
+    assert elapsed < 0.5, f"absent-id lookups too slow: {elapsed:.3f}s"
+
+
+def test_evict_until_fit(shm_store):
+    # Fill with small objects; a large create must evict as many as needed.
+    oids = [ObjectID.from_random() for _ in range(14)]
+    for oid in oids:
+        _put(shm_store, oid, 4 * 1024 * 1024)
+    big = ObjectID.from_random()
+    buf = shm_store.create_buffer(big, 40 * 1024 * 1024)
+    assert buf.nbytes == 40 * 1024 * 1024
