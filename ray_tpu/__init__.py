@@ -1,0 +1,66 @@
+"""ray_tpu — a TPU-native distributed AI framework.
+
+A brand-new implementation of the reference's capabilities (distributed
+tasks/actors/objects core + Train/Tune/Data/Serve/RLlib AI libraries),
+redesigned TPU-first: JAX/XLA/pjit/pallas for all accelerator compute, XLA
+collectives over ICI instead of NCCL, and a native shared-memory object
+store + asyncio control plane for the runtime.
+"""
+
+from ray_tpu._private.core_worker import (
+    ActorDiedError,
+    GetTimeoutError,
+    RayTaskError,
+)
+from ray_tpu._private.object_ref import ObjectRef
+from ray_tpu._private.worker_api import (
+    ActorClass,
+    ActorHandle,
+    NodeAffinitySchedulingStrategy,
+    PlacementGroup,
+    PlacementGroupSchedulingStrategy,
+    available_resources,
+    cluster_resources,
+    get,
+    get_actor,
+    init,
+    is_initialized,
+    kill,
+    list_actors,
+    nodes,
+    placement_group,
+    put,
+    remote,
+    remove_placement_group,
+    shutdown,
+    wait,
+)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "ActorClass",
+    "ActorDiedError",
+    "ActorHandle",
+    "GetTimeoutError",
+    "NodeAffinitySchedulingStrategy",
+    "ObjectRef",
+    "PlacementGroup",
+    "PlacementGroupSchedulingStrategy",
+    "RayTaskError",
+    "available_resources",
+    "cluster_resources",
+    "get",
+    "get_actor",
+    "init",
+    "is_initialized",
+    "kill",
+    "list_actors",
+    "nodes",
+    "placement_group",
+    "put",
+    "remote",
+    "remove_placement_group",
+    "shutdown",
+    "wait",
+]

@@ -1,0 +1,1056 @@
+"""CoreWorker — the per-process runtime linked into every driver and worker.
+
+Reference: `src/ray/core_worker/core_worker.h:292` and its transport layer —
+task submission with cached worker leases
+(`CoreWorkerDirectTaskSubmitter`, `transport/direct_task_transport.h:75`),
+direct actor transport with per-caller sequence numbers
+(`CoreWorkerDirectActorTaskSubmitter`), the in-process memory store for
+small/in-band objects (`store_provider/memory_store/memory_store.h:43`),
+ownership bookkeeping (`reference_count.h`), task retries (`task_manager.h`),
+and the task-execution callback into user code (`_raylet.pyx execute_task`).
+
+Threading model: all network state lives on a dedicated asyncio loop thread
+(the reference's io_service); the public sync API posts coroutines to it.
+Task execution happens on the process main thread (normal tasks), a thread
+pool (threaded actors), or a dedicated actor event loop (async actors).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import itertools
+import logging
+import os
+import queue as queue_mod
+import threading
+import traceback
+from collections import deque
+from concurrent.futures import Future as SyncFuture
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ray_tpu._private import serialization
+from ray_tpu._private import task as task_mod
+from ray_tpu._private.config import Config
+from ray_tpu._private.ids import ActorID, JobID, ObjectID, TaskID, WorkerID
+from ray_tpu._private.object_ref import ObjectRef, set_core_worker
+from ray_tpu._private.object_store import ObjectStore
+from ray_tpu._private.rpc import ClientPool, ConnectionLost, RpcError, RpcServer
+
+logger = logging.getLogger(__name__)
+
+
+class RayTaskError(Exception):
+    """A task raised; carries the remote traceback (reference:
+    ray.exceptions.RayTaskError)."""
+
+    def __init__(self, message: str, cause: Exception | None = None):
+        super().__init__(message)
+        self.cause = cause
+
+
+class ActorDiedError(RayTaskError):
+    pass
+
+
+class GetTimeoutError(Exception):
+    pass
+
+
+class _MemoryStore:
+    """In-process store for in-band results + object status (owner side)."""
+
+    def __init__(self, loop: asyncio.AbstractEventLoop):
+        self._loop = loop
+        self.values: Dict[bytes, bytes] = {}       # oid -> value frame
+        self.errors: Dict[bytes, bytes] = {}       # oid -> pickled-exc frame
+        self.locations: Dict[bytes, List[str]] = {}  # oid -> raylet addrs
+        self._events: Dict[bytes, asyncio.Event] = {}
+
+    def _event(self, oid: bytes) -> asyncio.Event:
+        ev = self._events.get(oid)
+        if ev is None:
+            ev = asyncio.Event()
+            self._events[oid] = ev
+        return ev
+
+    def ready(self, oid: bytes) -> bool:
+        return oid in self.values or oid in self.errors or oid in self.locations
+
+    def put_value(self, oid: bytes, frame: bytes):
+        self.values[oid] = frame
+        self._event(oid).set()
+
+    def put_error(self, oid: bytes, frame: bytes):
+        self.errors[oid] = frame
+        self._event(oid).set()
+
+    def add_location(self, oid: bytes, raylet_addr: str):
+        self.locations.setdefault(oid, [])
+        if raylet_addr not in self.locations[oid]:
+            self.locations[oid].append(raylet_addr)
+        self._event(oid).set()
+
+    async def wait_ready(self, oid: bytes, timeout: float | None = None):
+        if self.ready(oid):
+            return
+        await asyncio.wait_for(self._event(oid).wait(), timeout)
+
+
+class _KeyState:
+    """Per-scheduling-key submit queue + lease pipeline state."""
+
+    __slots__ = ("queue", "requesting")
+
+    def __init__(self):
+        self.queue: deque = deque()
+        self.requesting = 0
+
+
+class CoreWorker:
+    def __init__(
+        self,
+        mode: str,  # "driver" | "worker"
+        gcs_addr: str,
+        raylet_addr: str | None = None,
+        job_id: JobID | None = None,
+        store: ObjectStore | None = None,
+        node_id_hex: str = "",
+        config: Config | None = None,
+        tpu_chips: tuple = (),
+    ):
+        self.mode = mode
+        self.config = config or Config.from_env()
+        self.worker_id = WorkerID.from_random()
+        self.job_id = job_id or JobID.from_int(0)
+        self.gcs_addr = gcs_addr
+        self.raylet_addr = raylet_addr
+        self.node_id_hex = node_id_hex
+        self.store = store
+        self.tpu_chips = tpu_chips
+        self.current_task_id = TaskID.for_driver(self.job_id)
+        self.current_actor_id: Optional[ActorID] = None
+
+        self._put_counter = itertools.count(1)
+        self._task_counter = itertools.count(1)
+        self._seq_counters: Dict[bytes, itertools.count] = {}
+
+        self._loop = asyncio.new_event_loop()
+        self._loop_thread = threading.Thread(
+            target=self._run_loop, name="ray_tpu-io", daemon=True
+        )
+        self._server = RpcServer()
+        self._clients = ClientPool()
+        self._key_states: Dict[tuple, _KeyState] = {}
+        self._actor_clients: Dict[bytes, dict] = {}  # actor state cache
+        self._actor_events: Dict[bytes, asyncio.Event] = {}
+        self._local_refs: Dict[bytes, int] = {}
+
+        # Executor state (worker mode).
+        self._exec_queue: queue_mod.Queue = queue_mod.Queue()
+        self._actor_instance = None
+        self._actor_threadpool: Optional[ThreadPoolExecutor] = None
+        self._actor_async_loop: Optional[asyncio.AbstractEventLoop] = None
+        self._actor_seq_expect: Dict[bytes, int] = {}
+        self._actor_seq_buffer: Dict[bytes, Dict[int, tuple]] = {}
+        self._function_cache: Dict[bytes, Any] = {}
+        self._shutdown = False
+        self.memory_store: Optional[_MemoryStore] = None
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+
+    def _run_loop(self):
+        asyncio.set_event_loop(self._loop)
+        self._loop.run_forever()
+
+    def start(self):
+        self._loop_thread.start()
+        self._run_sync(self._start_async())
+        set_core_worker(self)
+        return self
+
+    async def _start_async(self):
+        self.memory_store = _MemoryStore(self._loop)
+        self._server.register_all(self)
+        await self._server.start()
+        self.gcs = await self._clients.get(self.gcs_addr)
+        await self.gcs.call("subscribe",
+                            {"channel": "actors", "addr": self._server.address})
+
+    def shutdown(self):
+        if self._shutdown:
+            return
+        self._shutdown = True
+        try:
+            self._run_sync(self._stop_async(), timeout=5)
+        except Exception:
+            pass
+        self._loop.call_soon_threadsafe(self._loop.stop)
+        set_core_worker(None)
+
+    async def _stop_async(self):
+        await self._clients.close_all()
+        await self._server.stop()
+
+    @property
+    def address(self) -> str:
+        return self._server.address
+
+    def _run_sync(self, coro, timeout: float | None = None):
+        fut = asyncio.run_coroutine_threadsafe(coro, self._loop)
+        return fut.result(timeout)
+
+    # ------------------------------------------------------------------
+    # reference registry (local refcounts; reference: reference_count.h)
+    # ------------------------------------------------------------------
+
+    def register_ref(self, ref: ObjectRef):
+        self._local_refs[ref.binary()] = self._local_refs.get(ref.binary(), 0) + 1
+
+    def deregister_ref(self, ref: ObjectRef):
+        n = self._local_refs.get(ref.binary(), 0) - 1
+        if n <= 0:
+            self._local_refs.pop(ref.binary(), None)
+        else:
+            self._local_refs[ref.binary()] = n
+
+    # ------------------------------------------------------------------
+    # function manager (reference: python/ray/_private/function_manager.py)
+    # ------------------------------------------------------------------
+
+    def push_function(self, fn) -> bytes:
+        pickled = serialization.dumps(fn)
+        key = hashlib.sha1(pickled).digest()[:16]
+        self._run_sync(self.gcs.call("kv_put", {
+            "ns": "fn:" + self.job_id.hex(),
+            "key": key,
+            "value": pickled,
+            "overwrite": False,
+        }))
+        return key
+
+    async def _load_function(self, key: bytes):
+        if key in self._function_cache:
+            return self._function_cache[key]
+        reply = await self.gcs.call("kv_get",
+                                    {"ns": "fn:" + self.job_id.hex(), "key": key})
+        if reply["value"] is None:
+            raise RuntimeError(f"function {key.hex()} not found in GCS")
+        fn = serialization.loads(reply["value"])
+        self._function_cache[key] = fn
+        return fn
+
+    # ------------------------------------------------------------------
+    # put / get / wait
+    # ------------------------------------------------------------------
+
+    def put(self, value: Any) -> ObjectRef:
+        oid = ObjectID.for_put(self.current_task_id, next(self._put_counter))
+        pickled, buffers = serialization.serialize(value)
+        size = serialization.serialized_size(pickled, buffers)
+        if size <= self.config.max_direct_call_object_size or self.store is None:
+            frame = serialization.pack(pickled, buffers)
+            self._run_sync(self._put_inband(oid.binary(), frame))
+        else:
+            self.store.put_serialized(oid, pickled, buffers)
+            self._run_sync(self._put_plasma_meta(oid.binary()))
+        return ObjectRef(oid, self.address)
+
+    async def _put_inband(self, oid: bytes, frame: bytes):
+        self.memory_store.put_value(oid, frame)
+
+    async def _put_plasma_meta(self, oid: bytes):
+        self.memory_store.add_location(oid, self.raylet_addr)
+
+    def get(self, refs, timeout: float | None = None):
+        single = isinstance(refs, ObjectRef)
+        ref_list = [refs] if single else list(refs)
+        values = self._run_sync(self._get_async(ref_list, timeout))
+        out = []
+        for v in values:
+            if isinstance(v, Exception):
+                raise v
+            out.append(v)
+        return out[0] if single else out
+
+    async def _get_async(self, refs: Sequence[ObjectRef],
+                         timeout: float | None = None) -> List[Any]:
+        return await asyncio.gather(*[self._get_one(r, timeout) for r in refs])
+
+    async def _get_one(self, ref: ObjectRef, timeout: float | None = None):
+        oid = ref.binary()
+        mem = self.memory_store
+        owner_is_self = ref.owner_addr in ("", self.address)
+
+        deadline = None
+        if timeout is not None:
+            deadline = self._loop.time() + timeout
+
+        def remaining():
+            if deadline is None:
+                return None
+            return max(0.0, deadline - self._loop.time())
+
+        while True:
+            if oid in mem.errors:
+                return self._error_from_frame(mem.errors[oid])
+            if oid in mem.values:
+                return serialization.loads(mem.values[oid])
+            if self.store is not None:
+                buf = self.store.get_buffer(ObjectID(oid), timeout=-1)
+                if buf is not None:
+                    return serialization.deserialize(buf)
+            if oid in mem.locations:
+                # Object lives in remote plasma: ask local raylet to pull it.
+                await self._pull_via_raylet(ref)
+                continue
+            if owner_is_self:
+                try:
+                    await mem.wait_ready(oid, remaining())
+                except asyncio.TimeoutError:
+                    raise GetTimeoutError(f"get timed out: {ref}")
+                continue
+            # Borrowed ref: ask the owner for status.
+            status = await self._owner_status(ref, remaining())
+            if status.get("error"):
+                return RayTaskError(status["error"])
+            if status["status"] == "inband":
+                mem.put_value(oid, status["value"])
+            elif status["status"] == "err":
+                mem.put_error(oid, status["value"])
+            else:
+                for addr in status.get("locations", []):
+                    mem.add_location(oid, addr)
+
+    async def _owner_status(self, ref: ObjectRef, timeout: float | None):
+        owner = await self._clients.get(ref.owner_addr)
+        try:
+            return await owner.call("get_object_status", {
+                "object_id": ref.binary(),
+                "wait": True,
+            }, timeout=timeout)
+        except asyncio.TimeoutError:
+            raise GetTimeoutError(f"get timed out: {ref}")
+
+    async def _pull_via_raylet(self, ref: ObjectRef):
+        raylet = await self._clients.get(self.raylet_addr)
+        await raylet.call("pull_object", {
+            "object_id": ref.binary(),
+            "owner_addr": ref.owner_addr or self.address,
+        }, timeout=300.0)
+
+    def _error_from_frame(self, frame: bytes) -> Exception:
+        err = serialization.loads(frame)
+        if isinstance(err, Exception):
+            return err
+        return RayTaskError(str(err))
+
+    def wait(self, refs: Sequence[ObjectRef], num_returns: int = 1,
+             timeout: float | None = None):
+        return self._run_sync(self._wait_async(refs, num_returns, timeout))
+
+    async def _wait_async(self, refs, num_returns, timeout):
+        pending = {ref: asyncio.ensure_future(self._ready_one(ref))
+                   for ref in refs}
+        ready: List[ObjectRef] = []
+        try:
+            deadline = None if timeout is None else self._loop.time() + timeout
+            while len(ready) < num_returns and pending:
+                waits = list(pending.values())
+                t = None if deadline is None else max(0, deadline - self._loop.time())
+                done, _ = await asyncio.wait(
+                    waits, timeout=t, return_when=asyncio.FIRST_COMPLETED
+                )
+                if not done:
+                    break
+                for ref, fut in list(pending.items()):
+                    if fut in done:
+                        ready.append(ref)
+                        del pending[ref]
+        finally:
+            for fut in pending.values():
+                fut.cancel()
+        not_ready = [r for r in refs if r not in ready]
+        return ready, not_ready
+
+    async def _ready_one(self, ref: ObjectRef):
+        oid = ref.binary()
+        mem = self.memory_store
+        while True:
+            if mem.ready(oid):
+                return
+            if self.store is not None and self.store.contains(ObjectID(oid)):
+                return
+            if ref.owner_addr in ("", self.address):
+                await mem.wait_ready(oid)
+                return
+            status = await self._owner_status(ref, None)
+            if status["status"] == "inband":
+                mem.put_value(oid, status["value"])
+            elif status["status"] == "err":
+                mem.put_error(oid, status["value"])
+            else:
+                for addr in status.get("locations", []):
+                    mem.add_location(oid, addr)
+            return
+
+    def as_future(self, ref: ObjectRef) -> SyncFuture:
+        out: SyncFuture = SyncFuture()
+
+        def _done(task: asyncio.Task):
+            exc = task.exception()
+            if exc is not None:
+                out.set_exception(exc)
+            else:
+                value = task.result()
+                if isinstance(value, Exception):
+                    out.set_exception(value)
+                else:
+                    out.set_result(value)
+
+        fut = asyncio.run_coroutine_threadsafe(self._get_one(ref), self._loop)
+        fut.add_done_callback(_done)
+        return out
+
+    async def await_ref(self, ref: ObjectRef):
+        """Used by `await ref` inside async actor methods (runs on the actor
+        loop, so delegate to the io loop)."""
+        value = await asyncio.wrap_future(
+            asyncio.run_coroutine_threadsafe(self._get_one(ref), self._loop)
+        )
+        if isinstance(value, Exception):
+            raise value
+        return value
+
+    # ------------------------------------------------------------------
+    # argument serialization
+    # ------------------------------------------------------------------
+
+    def _serialize_args(self, args, kwargs):
+        wire_args = []
+        for a in args:
+            wire_args.append(self._serialize_arg(a))
+        wire_kwargs = {k: self._serialize_arg(v) for k, v in (kwargs or {}).items()}
+        return wire_args, wire_kwargs
+
+    def _serialize_arg(self, value):
+        if isinstance(value, ObjectRef):
+            oid = value.binary()
+            mem = self.memory_store
+            # Inline owner-local in-band values (reference:
+            # LocalDependencyResolver inlines memory-store objects).
+            if oid in mem.values:
+                return ["v", mem.values[oid]]
+            return ["r", oid, value.owner_addr or self.address]
+        return ["v", serialization.dumps(value)]
+
+    async def _deserialize_args(self, spec: task_mod.TaskSpec):
+        async def resolve(entry):
+            if entry[0] == "v":
+                return serialization.loads(entry[1])
+            ref = ObjectRef(ObjectID(entry[1]), entry[2])
+            value = await self._get_one(ref)
+            if isinstance(value, Exception):
+                raise value
+            return value
+
+        args = [await resolve(e) for e in spec.args]
+        kwargs = {k: await resolve(e) for k, e in spec.kwargs.items()}
+        return args, kwargs
+
+    # ------------------------------------------------------------------
+    # normal task submission (CoreWorkerDirectTaskSubmitter)
+    # ------------------------------------------------------------------
+
+    def submit_task(
+        self,
+        function_key: bytes,
+        args: tuple,
+        kwargs: dict,
+        name: str = "",
+        num_returns: int = 1,
+        resources: Dict[str, float] | None = None,
+        max_retries: int | None = None,
+        strategy: str = task_mod.STRATEGY_DEFAULT,
+        node_id: bytes | None = None,
+        soft: bool = False,
+        placement_group_id: bytes | None = None,
+        bundle_index: int = -1,
+    ) -> List[ObjectRef]:
+        task_id = TaskID.of(self.job_id, self.current_task_id,
+                            next(self._task_counter))
+        wire_args, wire_kwargs = self._serialize_args(args, kwargs)
+        spec = task_mod.TaskSpec(
+            task_id=task_id.binary(),
+            job_id=self.job_id.binary(),
+            name=name,
+            task_type=task_mod.NORMAL_TASK,
+            function_key=function_key,
+            args=wire_args,
+            kwargs=wire_kwargs,
+            num_returns=num_returns,
+            resources=resources or {"CPU": 1.0},
+            owner_addr=self.address,
+            owner_worker_id=self.worker_id.binary(),
+            strategy=strategy,
+            node_id=node_id,
+            soft=soft,
+            placement_group_id=placement_group_id,
+            bundle_index=bundle_index,
+            max_retries=(self.config.task_max_retries_default
+                         if max_retries is None else max_retries),
+        )
+        refs = [
+            ObjectRef(ObjectID.for_task_return(task_id, i), self.address)
+            for i in range(num_returns)
+        ]
+        self._loop.call_soon_threadsafe(self._enqueue_task, spec)
+        return refs
+
+    def _enqueue_task(self, spec: task_mod.TaskSpec):
+        key = spec.scheduling_key()
+        state = self._key_states.get(key)
+        if state is None:
+            state = self._key_states[key] = _KeyState()
+        state.queue.append([spec, spec.max_retries])
+        # One outstanding lease request per queued task, capped lightly.
+        if state.requesting < max(1, len(state.queue)):
+            state.requesting += 1
+            asyncio.ensure_future(self._lease_and_run(key, state))
+
+    async def _lease_and_run(self, key, state: _KeyState):
+        try:
+            while state.queue:
+                spec0 = state.queue[0][0]
+                lease = await self._request_lease(spec0)
+                if lease is None or not lease.get("granted"):
+                    if state.queue:
+                        entry = state.queue.popleft()
+                        self._store_task_error(
+                            entry[0],
+                            RayTaskError(
+                                "scheduling failed: "
+                                + str((lease or {}).get("error", "no lease"))
+                            ),
+                        )
+                    continue
+                await self._drain_with_lease(key, state, lease)
+        finally:
+            state.requesting -= 1
+
+    async def _pg_bundle_addr(self, pg_id: bytes, bundle_index: int) -> str:
+        """Route a PG-targeted lease to the raylet hosting the bundle
+        (reference: the submitter's lease policy consults the placement
+        group's location)."""
+        deadline = self._loop.time() + 300.0
+        while True:
+            reply = await self.gcs.call("get_placement_group", {"pg_id": pg_id})
+            if reply.get("found") and reply["state"] == "CREATED":
+                break
+            if reply.get("found") and reply["state"] == "REMOVED":
+                raise RayTaskError("placement group was removed")
+            if self._loop.time() > deadline:
+                raise RayTaskError("placement group never became ready")
+            await asyncio.sleep(0.05)
+        nodes = await self.gcs.call("get_nodes", {})
+        addr_by_id = {n["node_id"]: n["raylet_addr"] for n in nodes if n["alive"]}
+        index = bundle_index if bundle_index >= 0 else 0
+        node_id = reply["bundle_nodes"][index]
+        if node_id not in addr_by_id:
+            raise RayTaskError("placement group bundle node is dead")
+        return addr_by_id[node_id]
+
+    async def _request_lease(self, spec: task_mod.TaskSpec, max_hops: int = 4):
+        addr = self.raylet_addr
+        no_spillback = False
+        if spec.placement_group_id is not None:
+            try:
+                addr = await self._pg_bundle_addr(
+                    spec.placement_group_id, spec.bundle_index
+                )
+            except RayTaskError as e:
+                return {"granted": False, "error": str(e)}
+            no_spillback = True
+        for _ in range(max_hops):
+            try:
+                raylet = await self._clients.get(addr)
+                reply = await raylet.call("request_worker_lease", {
+                    "spec": spec.to_wire(),
+                    "no_spillback": no_spillback,
+                }, timeout=300.0)
+            except (ConnectionLost, RpcError, OSError) as e:
+                return {"granted": False, "error": str(e)}
+            if reply.get("granted"):
+                reply["raylet_addr"] = addr
+                return reply
+            if reply.get("spillback_addr"):
+                addr = reply["spillback_addr"]
+                no_spillback = True
+                continue
+            return reply
+        return {"granted": False, "error": "too many spillback hops"}
+
+    async def _drain_with_lease(self, key, state: _KeyState, lease: dict):
+        worker_addr = lease["worker_addr"]
+        raylet_addr = lease["raylet_addr"]
+        lease_id = lease["lease_id"]
+        worker_dead = False
+        try:
+            while state.queue:
+                entry = state.queue.popleft()
+                spec, retries_left = entry
+                try:
+                    worker = await self._clients.get(worker_addr)
+                    reply = await worker.call(
+                        "push_task", {"spec": spec.to_wire()}, timeout=None
+                    )
+                    self._process_task_reply(spec, reply)
+                except (ConnectionLost, RpcError, OSError) as e:
+                    worker_dead = True
+                    if retries_left > 0:
+                        state.queue.append([spec, retries_left - 1])
+                        state.requesting += 1
+                        asyncio.ensure_future(self._lease_and_run(key, state))
+                    else:
+                        self._store_task_error(
+                            spec, RayTaskError(f"worker died: {e}"))
+                    return
+        finally:
+            try:
+                raylet = await self._clients.get(raylet_addr)
+                await raylet.call("return_worker", {
+                    "lease_id": lease_id,
+                    "worker_dead": worker_dead,
+                })
+            except (ConnectionLost, RpcError, OSError):
+                pass
+
+    def _process_task_reply(self, spec: task_mod.TaskSpec, reply: dict):
+        mem = self.memory_store
+        for entry in reply.get("returns", []):
+            oid, kind, payload = entry
+            if kind == "v":
+                mem.put_value(oid, payload)
+            elif kind == "err":
+                mem.put_error(oid, payload)
+            elif kind == "plasma":
+                mem.add_location(oid, payload)
+
+    def _store_task_error(self, spec: task_mod.TaskSpec, err: Exception):
+        frame = serialization.dumps(err)
+        for i in range(spec.num_returns):
+            oid = ObjectID.for_task_return(TaskID(spec.task_id), i)
+            self.memory_store.put_error(oid.binary(), frame)
+
+    # ------------------------------------------------------------------
+    # actor submission (CoreWorkerDirectActorTaskSubmitter)
+    # ------------------------------------------------------------------
+
+    def create_actor(
+        self,
+        class_key: bytes,
+        args: tuple,
+        kwargs: dict,
+        name: str = "",
+        actor_name: str | None = None,
+        resources: Dict[str, float] | None = None,
+        max_restarts: int = 0,
+        max_concurrency: int = 1,
+        detached: bool = False,
+        strategy: str = task_mod.STRATEGY_DEFAULT,
+        node_id: bytes | None = None,
+        soft: bool = False,
+        placement_group_id: bytes | None = None,
+        bundle_index: int = -1,
+    ) -> ActorID:
+        actor_id = ActorID.of(self.job_id, self.current_task_id,
+                              next(self._task_counter))
+        task_id = TaskID.of(self.job_id, self.current_task_id,
+                            next(self._task_counter), actor_id)
+        wire_args, wire_kwargs = self._serialize_args(args, kwargs)
+        spec = task_mod.TaskSpec(
+            task_id=task_id.binary(),
+            job_id=self.job_id.binary(),
+            name=name,
+            task_type=task_mod.ACTOR_CREATION_TASK,
+            function_key=class_key,
+            args=wire_args,
+            kwargs=wire_kwargs,
+            num_returns=0,
+            resources=resources or {"CPU": 1.0},
+            owner_addr=self.address,
+            owner_worker_id=self.worker_id.binary(),
+            actor_id=actor_id.binary(),
+            max_restarts=max_restarts,
+            max_concurrency=max_concurrency,
+            strategy=strategy,
+            node_id=node_id,
+            soft=soft,
+            placement_group_id=placement_group_id,
+            bundle_index=bundle_index,
+            detached=detached,
+            actor_name=actor_name,
+        )
+        reply = self._run_sync(
+            self.gcs.call("register_actor", {"spec": spec.to_wire()})
+        )
+        if not reply.get("ok"):
+            raise ValueError(reply.get("error", "actor registration failed"))
+        return actor_id
+
+    def submit_actor_task(
+        self,
+        actor_id: ActorID,
+        method_name: str,
+        args: tuple,
+        kwargs: dict,
+        num_returns: int = 1,
+    ) -> List[ObjectRef]:
+        task_id = TaskID.of(self.job_id, self.current_task_id,
+                            next(self._task_counter), actor_id)
+        wire_args, wire_kwargs = self._serialize_args(args, kwargs)
+        spec = task_mod.TaskSpec(
+            task_id=task_id.binary(),
+            job_id=self.job_id.binary(),
+            name=method_name,
+            task_type=task_mod.ACTOR_TASK,
+            args=wire_args,
+            kwargs=wire_kwargs,
+            num_returns=num_returns,
+            owner_addr=self.address,
+            owner_worker_id=self.worker_id.binary(),
+            actor_id=actor_id.binary(),
+            method_name=method_name,
+        )
+        refs = [
+            ObjectRef(ObjectID.for_task_return(task_id, i), self.address)
+            for i in range(num_returns)
+        ]
+        self._loop.call_soon_threadsafe(self._actor_enqueue, spec)
+        return refs
+
+    def _actor_state(self, actor_id: bytes) -> dict:
+        st = self._actor_clients.get(actor_id)
+        if st is None:
+            st = self._actor_clients[actor_id] = {
+                "queue": deque(),
+                "sending": False,
+                "seq": 0,
+                "instance": None,  # (addr, num_restarts) of the live actor
+            }
+        return st
+
+    def _actor_enqueue(self, spec: task_mod.TaskSpec):
+        st = self._actor_state(spec.actor_id)
+        st["queue"].append(spec)
+        if not st["sending"]:
+            st["sending"] = True
+            asyncio.ensure_future(self._actor_sender(spec.actor_id, st))
+
+    async def _actor_sender(self, actor_id: bytes, st: dict):
+        """Ordered, pipelined sends: sequence numbers assigned at send time
+        against the current actor instance (so a restarted actor starts at
+        seq 0), replies handled asynchronously. A task in flight when the
+        actor dies fails — actor tasks are never implicitly re-executed
+        (reference: max_task_retries defaults to 0 for actors)."""
+        try:
+            while st["queue"]:
+                spec = st["queue"][0]
+                try:
+                    addr, restarts = await self._resolve_actor(actor_id)
+                except ActorDiedError as e:
+                    while st["queue"]:
+                        self._store_task_error(st["queue"].popleft(), e)
+                    return
+                instance = (addr, restarts)
+                if st.get("seq_instance") != instance:
+                    st["seq_instance"] = instance
+                    st["seq"] = 0
+                st["queue"].popleft()
+                spec.seq_no = st["seq"]
+                st["seq"] += 1
+                asyncio.ensure_future(self._push_actor_task(st, spec, addr))
+        finally:
+            st["sending"] = False
+
+    async def _push_actor_task(self, st: dict, spec: task_mod.TaskSpec,
+                               addr: str):
+        try:
+            worker = await self._clients.get(addr)
+            reply = await worker.call("push_task", {"spec": spec.to_wire()},
+                                      timeout=None)
+            self._process_task_reply(spec, reply)
+        except (ConnectionLost, RpcError, OSError) as e:
+            if st.get("instance") and st["instance"][0] == addr:
+                st["instance"] = None  # force re-resolve for queued tasks
+            self._store_task_error(
+                spec,
+                ActorDiedError(
+                    f"actor task {spec.method_name} failed (actor died "
+                    f"mid-call, not retried): {e}"
+                ),
+            )
+
+    async def _resolve_actor(self, actor_id: bytes,
+                             timeout: float | None = None
+                             ) -> Tuple[str, int]:
+        st = self._actor_state(actor_id)
+        if st.get("instance") is not None:
+            return st["instance"]
+        deadline = None if timeout is None else self._loop.time() + timeout
+        while True:
+            reply = await self.gcs.call("get_actor", {"actor_id": actor_id})
+            if reply.get("found"):
+                if reply["state"] == "ALIVE":
+                    st["instance"] = (reply["addr"],
+                                      reply.get("num_restarts", 0))
+                    return st["instance"]
+                if reply["state"] == "DEAD":
+                    raise ActorDiedError(
+                        f"actor {actor_id.hex()[:8]} is dead: "
+                        f"{reply.get('death_cause')}"
+                    )
+            ev = self._actor_events.setdefault(actor_id, asyncio.Event())
+            ev.clear()
+            t = 1.0
+            if deadline is not None:
+                t = min(t, max(0.05, deadline - self._loop.time()))
+                if self._loop.time() > deadline:
+                    raise ActorDiedError(
+                        f"timed out resolving actor {actor_id.hex()[:8]}")
+            try:
+                await asyncio.wait_for(ev.wait(), t)
+            except asyncio.TimeoutError:
+                pass
+
+    def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
+        self._run_sync(self.gcs.call("kill_actor", {
+            "actor_id": actor_id.binary(),
+            "reason": "ray_tpu.kill",
+        }))
+
+    # ------------------------------------------------------------------
+    # owner services (RPC handlers, run on io loop)
+    # ------------------------------------------------------------------
+
+    async def rpc_get_object_status(self, req):
+        oid = req["object_id"]
+        mem = self.memory_store
+        if req.get("wait") and not mem.ready(oid):
+            if self.store is not None and self.store.contains(ObjectID(oid)):
+                mem.add_location(oid, self.raylet_addr)
+            else:
+                await mem.wait_ready(oid)
+        if oid in mem.errors:
+            return {"status": "err", "value": mem.errors[oid]}
+        if oid in mem.values:
+            return {"status": "inband", "value": mem.values[oid]}
+        return {"status": "plasma", "locations": mem.locations.get(oid, [])}
+
+    async def rpc_add_object_location(self, req):
+        self.memory_store.add_location(req["object_id"], req["raylet_addr"])
+        return {"ok": True}
+
+    async def rpc_pubsub(self, msg):
+        if msg["channel"] == "actors":
+            data = msg["data"]
+            actor_id = data["actor_id"]
+            st = self._actor_state(actor_id)
+            if data["state"] == "ALIVE":
+                st["instance"] = (data["addr"], data.get("num_restarts", 0))
+            else:
+                st["instance"] = None
+            ev = self._actor_events.get(actor_id)
+            if ev is not None:
+                ev.set()
+        return None
+
+    async def rpc_exit_worker(self, req):
+        logger.info("exit requested: %s", req.get("reason"))
+        self._exec_queue.put(None)
+        return None
+
+    async def rpc_ping(self, req):
+        return {"ok": True, "worker_id": self.worker_id.binary()}
+
+    # ------------------------------------------------------------------
+    # task execution (worker mode; reference: _raylet.pyx execute_task)
+    # ------------------------------------------------------------------
+
+    async def rpc_push_task(self, req):
+        spec = task_mod.TaskSpec.from_wire(req["spec"])
+        loop = self._loop
+        fut = loop.create_future()
+        if spec.task_type == task_mod.ACTOR_TASK:
+            await self._enqueue_ordered(spec, fut)
+        else:
+            self._exec_queue.put((spec, fut))
+        return await fut
+
+    async def _enqueue_ordered(self, spec: task_mod.TaskSpec, fut):
+        """Per-caller sequence ordering (reference: ActorSchedulingQueue)."""
+        caller = spec.owner_worker_id
+        expect = self._actor_seq_expect.get(caller, 0)
+        buffer = self._actor_seq_buffer.setdefault(caller, {})
+        buffer[spec.seq_no] = (spec, fut)
+        while expect in buffer:
+            ready_spec, ready_fut = buffer.pop(expect)
+            expect += 1
+            self._dispatch_actor_task(ready_spec, ready_fut)
+        self._actor_seq_expect[caller] = expect
+
+    def _dispatch_actor_task(self, spec, fut):
+        if self._actor_async_loop is not None:
+            asyncio.run_coroutine_threadsafe(
+                self._run_async_actor_task(spec, fut), self._actor_async_loop
+            )
+        elif self._actor_threadpool is not None:
+            self._actor_threadpool.submit(self._execute_to_future, spec, fut)
+        else:
+            self._exec_queue.put((spec, fut))
+
+    def run_task_loop(self):
+        """Blocks forever executing tasks (worker main thread)."""
+        while True:
+            item = self._exec_queue.get()
+            if item is None:
+                break
+            spec, fut = item
+            self._execute_to_future(spec, fut)
+
+    def _execute_to_future(self, spec, fut):
+        reply = self.execute_task(spec)
+        self._loop.call_soon_threadsafe(
+            lambda: fut.done() or fut.set_result(reply)
+        )
+
+    async def _run_async_actor_task(self, spec, fut):
+        async with self._actor_async_sem:
+            reply = await self._execute_task_async(spec)
+        self._loop.call_soon_threadsafe(
+            lambda: fut.done() or fut.set_result(reply)
+        )
+
+    async def _execute_task_async(self, spec: task_mod.TaskSpec):
+        try:
+            args, kwargs = await asyncio.wrap_future(
+                asyncio.run_coroutine_threadsafe(
+                    self._deserialize_args(spec), self._loop
+                )
+            )
+            method = getattr(self._actor_instance, spec.method_name)
+            result = method(*args, **kwargs)
+            if asyncio.iscoroutine(result):
+                result = await result
+            return self._package_returns(spec, result)
+        except Exception as e:  # noqa: BLE001
+            return self._package_error(spec, e)
+
+    def execute_task(self, spec: task_mod.TaskSpec) -> dict:
+        prev_task = self.current_task_id
+        self.current_task_id = TaskID(spec.task_id)
+        try:
+            args, kwargs = asyncio.run_coroutine_threadsafe(
+                self._deserialize_args(spec), self._loop
+            ).result()
+            if spec.task_type == task_mod.NORMAL_TASK:
+                fn = asyncio.run_coroutine_threadsafe(
+                    self._load_function(spec.function_key), self._loop
+                ).result()
+                result = fn(*args, **kwargs)
+            elif spec.task_type == task_mod.ACTOR_CREATION_TASK:
+                cls = asyncio.run_coroutine_threadsafe(
+                    self._load_function(spec.function_key), self._loop
+                ).result()
+                instance = cls(*args, **kwargs)
+                self._actor_instance = instance
+                self.current_actor_id = ActorID(spec.actor_id)
+                if spec.max_concurrency > 1:
+                    if any(
+                        asyncio.iscoroutinefunction(getattr(cls, n))
+                        for n in dir(cls)
+                        if callable(getattr(cls, n, None)) and not n.startswith("__")
+                    ):
+                        self._start_actor_async_loop(spec.max_concurrency)
+                    else:
+                        self._actor_threadpool = ThreadPoolExecutor(
+                            spec.max_concurrency
+                        )
+                elif self._has_async_methods(cls):
+                    self._start_actor_async_loop(1)
+                return {"returns": []}
+            elif spec.task_type == task_mod.ACTOR_TASK:
+                method = getattr(self._actor_instance, spec.method_name)
+                result = method(*args, **kwargs)
+                if asyncio.iscoroutine(result):
+                    # Sync path got a coroutine (async method, concurrency 1
+                    # without dedicated loop): run it to completion here.
+                    result = asyncio.run(result)
+            else:
+                raise RuntimeError(f"unknown task type {spec.task_type}")
+            return self._package_returns(spec, result)
+        except Exception as e:  # noqa: BLE001
+            return self._package_error(spec, e)
+        finally:
+            self.current_task_id = prev_task
+
+    @staticmethod
+    def _has_async_methods(cls) -> bool:
+        return any(
+            asyncio.iscoroutinefunction(getattr(cls, n, None))
+            for n in dir(cls)
+            if not n.startswith("__")
+        )
+
+    def _start_actor_async_loop(self, max_concurrency: int):
+        loop = asyncio.new_event_loop()
+        self._actor_async_loop = loop
+        self._actor_async_sem = asyncio.Semaphore(max_concurrency)
+
+        def run():
+            asyncio.set_event_loop(loop)
+            loop.run_forever()
+
+        threading.Thread(target=run, name="actor-async", daemon=True).start()
+
+    def _package_returns(self, spec: task_mod.TaskSpec, result) -> dict:
+        if spec.num_returns == 0:
+            return {"returns": []}
+        if spec.num_returns == 1:
+            results = [result]
+        else:
+            results = list(result)
+            if len(results) != spec.num_returns:
+                raise ValueError(
+                    f"task {spec.name} returned {len(results)} values, "
+                    f"expected {spec.num_returns}"
+                )
+        returns = []
+        for i, value in enumerate(results):
+            oid = ObjectID.for_task_return(TaskID(spec.task_id), i)
+            pickled, buffers = serialization.serialize(value)
+            size = serialization.serialized_size(pickled, buffers)
+            if size <= self.config.max_direct_call_object_size or \
+                    self.store is None:
+                returns.append([oid.binary(), "v",
+                                serialization.pack(pickled, buffers)])
+            else:
+                self.store.put_serialized(oid, pickled, buffers)
+                returns.append([oid.binary(), "plasma", self.raylet_addr])
+        return {"returns": returns}
+
+    def _package_error(self, spec: task_mod.TaskSpec, exc: Exception) -> dict:
+        tb = traceback.format_exc()
+        logger.warning("task %s failed: %s", spec.name, tb)
+        err = RayTaskError(
+            f"task {spec.name} failed:\n{tb}", cause=None
+        )
+        frame = serialization.dumps(err)
+        returns = []
+        for i in range(max(spec.num_returns, 1)):
+            oid = ObjectID.for_task_return(TaskID(spec.task_id), i)
+            returns.append([oid.binary(), "err", frame])
+        return {"returns": returns, "error": True, "error_msg": str(exc)}

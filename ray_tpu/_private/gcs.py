@@ -1,0 +1,590 @@
+"""GCS — Global Control Service: the head-node metadata/control plane.
+
+Reference: `src/ray/gcs/gcs_server/` — cluster metadata authority and
+cluster-level scheduler: node membership + health checks
+(`GcsNodeManager`, `GcsHealthCheckManager`), actor directory with
+fault-tolerant restart (`GcsActorManager` + `GcsActorScheduler`),
+placement-group creation (`GcsPlacementGroupManager`), job table
+(`GcsJobManager`), internal KV (`GcsKvManager`), pubsub
+(`pubsub_handler`), and the resource-view sync loop (ray_syncer).
+
+All tables are in-memory (the reference's default `InMemoryStoreClient`);
+Redis-backed persistence for GCS fault tolerance is a later round.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Any, Dict, List, Optional
+
+from ray_tpu._private import task as task_mod
+from ray_tpu._private.config import Config
+from ray_tpu._private.rpc import ClientPool, ConnectionLost, RpcError, RpcServer
+from ray_tpu._private.scheduling import ClusterView, pick_node, place_bundles
+
+logger = logging.getLogger(__name__)
+
+# Actor lifecycle states (reference: rpc::ActorTableData::ActorState).
+PENDING = "PENDING_CREATION"
+ALIVE = "ALIVE"
+RESTARTING = "RESTARTING"
+DEAD = "DEAD"
+
+
+class GcsServer:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 config: Config | None = None):
+        self.config = config or Config.from_env()
+        self.server = RpcServer(host, port)
+        self.clients = ClientPool()
+        self.view = ClusterView()
+
+        # Tables.
+        self.kv: Dict[str, Dict[bytes, bytes]] = {}
+        self.nodes: Dict[bytes, dict] = {}
+        self.jobs: Dict[bytes, dict] = {}
+        self.actors: Dict[bytes, dict] = {}
+        self.named_actors: Dict[str, bytes] = {}
+        self.placement_groups: Dict[bytes, dict] = {}
+        self.subscribers: Dict[str, List[str]] = {}
+        self._last_heartbeat: Dict[bytes, float] = {}
+        self._pending_actors: List[bytes] = []
+        self._scheduling_actors: set = set()
+        self._pending_pgs: List[bytes] = []
+        self._bg_tasks: list = []
+        self._retry_wakeup = asyncio.Event()
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+
+    async def start(self):
+        self.server.register_all(self)
+        await self.server.start()
+        self._bg_tasks = [
+            asyncio.ensure_future(self._health_check_loop()),
+            asyncio.ensure_future(self._retry_loop()),
+        ]
+        logger.info("GCS listening on %s", self.server.address)
+        return self
+
+    async def stop(self):
+        for t in self._bg_tasks:
+            t.cancel()
+        await self.clients.close_all()
+        await self.server.stop()
+
+    @property
+    def address(self) -> str:
+        return self.server.address
+
+    # ------------------------------------------------------------------
+    # pubsub (reference: src/ray/pubsub — push-based here since every
+    # participant runs an RpcServer)
+    # ------------------------------------------------------------------
+
+    async def rpc_subscribe(self, req):
+        self.subscribers.setdefault(req["channel"], [])
+        if req["addr"] not in self.subscribers[req["channel"]]:
+            self.subscribers[req["channel"]].append(req["addr"])
+        return {"ok": True}
+
+    async def publish(self, channel: str, data: Any):
+        dead = []
+        for addr in self.subscribers.get(channel, []):
+            try:
+                client = await self.clients.get(addr)
+                await client.notify("pubsub", {"channel": channel, "data": data})
+            except (ConnectionLost, OSError, RpcError):
+                dead.append(addr)
+        for addr in dead:
+            self.subscribers[channel].remove(addr)
+
+    # ------------------------------------------------------------------
+    # node membership + resource view (GcsNodeManager + ray_syncer)
+    # ------------------------------------------------------------------
+
+    async def rpc_register_node(self, req):
+        node_id = req["node_id"]
+        self.nodes[node_id] = {
+            "node_id": node_id,
+            "raylet_addr": req["raylet_addr"],
+            "total": req["total"],
+            "available": req["available"],
+            "alive": True,
+            "hostname": req.get("hostname", ""),
+            "labels": req.get("labels", {}),
+        }
+        self.view.update_node(node_id, req["raylet_addr"], req["total"],
+                              req["available"])
+        self._last_heartbeat[node_id] = time.monotonic()
+        await self.publish("nodes", {"event": "added", "node": self.nodes[node_id]})
+        self._retry_wakeup.set()
+        return {"ok": True}
+
+    async def rpc_heartbeat(self, req):
+        node_id = req["node_id"]
+        node = self.nodes.get(node_id)
+        if node is None or not node["alive"]:
+            return {"ok": False, "reregister": True}
+        node["available"] = req["available"]
+        self.view.update_node(node_id, node["raylet_addr"], node["total"],
+                              req["available"])
+        self._last_heartbeat[node_id] = time.monotonic()
+        if req.get("idle_freed"):
+            self._retry_wakeup.set()
+        # Reply with the cluster resource view so raylets can spill back
+        # tasks to other nodes (the ray_syncer gossip, piggybacked).
+        return {"ok": True, "view": self._view_wire()}
+
+    def _view_wire(self):
+        return [
+            {
+                "node_id": n.node_id,
+                "raylet_addr": n.raylet_addr,
+                "total": n.total,
+                "available": n.available,
+            }
+            for n in self.view.alive_nodes()
+        ]
+
+    async def rpc_get_nodes(self, req):
+        return list(self.nodes.values())
+
+    async def _health_check_loop(self):
+        # Reference: GcsHealthCheckManager — mark nodes dead after missed
+        # heartbeats; publish so raylets/workers fail fast.
+        period = self.config.raylet_heartbeat_period_s
+        threshold = self.config.health_check_failure_threshold
+        while True:
+            await asyncio.sleep(period)
+            now = time.monotonic()
+            for node_id, node in list(self.nodes.items()):
+                if not node["alive"]:
+                    continue
+                last = self._last_heartbeat.get(node_id, 0)
+                if now - last > period * threshold:
+                    await self._mark_node_dead(node_id, "missed heartbeats")
+
+    async def _mark_node_dead(self, node_id: bytes, reason: str):
+        node = self.nodes.get(node_id)
+        if node is None or not node["alive"]:
+            return
+        node["alive"] = False
+        self.view.remove_node(node_id)
+        logger.warning("node %s dead: %s", node_id.hex()[:8], reason)
+        await self.publish("nodes", {"event": "removed", "node_id": node_id,
+                                     "reason": reason})
+        # Fail over actors that lived on that node.
+        for actor_id, info in list(self.actors.items()):
+            if info.get("node_id") == node_id and info["state"] in (ALIVE, PENDING):
+                await self._on_actor_failure(actor_id, f"node died: {reason}")
+
+    # ------------------------------------------------------------------
+    # KV + function table (GcsKvManager / function_manager)
+    # ------------------------------------------------------------------
+
+    async def rpc_kv_put(self, req):
+        ns = self.kv.setdefault(req.get("ns", ""), {})
+        key = req["key"]
+        if not req.get("overwrite", True) and key in ns:
+            return {"added": False}
+        ns[key] = req["value"]
+        return {"added": True}
+
+    async def rpc_kv_get(self, req):
+        value = self.kv.get(req.get("ns", ""), {}).get(req["key"])
+        return {"value": value}
+
+    async def rpc_kv_del(self, req):
+        existed = self.kv.get(req.get("ns", ""), {}).pop(req["key"], None)
+        return {"deleted": existed is not None}
+
+    async def rpc_kv_keys(self, req):
+        prefix = req.get("prefix", b"")
+        ns = self.kv.get(req.get("ns", ""), {})
+        return {"keys": [k for k in ns if k.startswith(prefix)]}
+
+    async def rpc_kv_exists(self, req):
+        return {"exists": req["key"] in self.kv.get(req.get("ns", ""), {})}
+
+    # ------------------------------------------------------------------
+    # jobs (GcsJobManager)
+    # ------------------------------------------------------------------
+
+    async def rpc_register_job(self, req):
+        job_id = req["job_id"]
+        self.jobs[job_id] = {
+            "job_id": job_id,
+            "driver_addr": req.get("driver_addr", ""),
+            "start_time": time.time(),
+            "finished": False,
+        }
+        await self.publish("jobs", {"event": "started", "job_id": job_id})
+        return {"ok": True}
+
+    async def rpc_finish_job(self, req):
+        job_id = req["job_id"]
+        job = self.jobs.get(job_id)
+        if job:
+            job["finished"] = True
+            job["end_time"] = time.time()
+        # Tear down the job's non-detached actors.
+        for actor_id, info in list(self.actors.items()):
+            if info["job_id"] == job_id and not info.get("detached") \
+                    and info["state"] != DEAD:
+                await self._kill_actor(actor_id, "job finished")
+        await self.publish("jobs", {"event": "finished", "job_id": job_id})
+        return {"ok": True}
+
+    # ------------------------------------------------------------------
+    # actors (GcsActorManager + GcsActorScheduler)
+    # ------------------------------------------------------------------
+
+    async def rpc_register_actor(self, req):
+        spec = task_mod.TaskSpec.from_wire(req["spec"])
+        actor_id = spec.actor_id
+        if spec.actor_name:
+            if spec.actor_name in self.named_actors:
+                existing = self.named_actors[spec.actor_name]
+                if self.actors[existing]["state"] != DEAD:
+                    return {"ok": False,
+                            "error": f"actor name taken: {spec.actor_name}"}
+            self.named_actors[spec.actor_name] = actor_id
+        self.actors[actor_id] = {
+            "actor_id": actor_id,
+            "job_id": spec.job_id,
+            "name": spec.actor_name,
+            "state": PENDING,
+            "addr": None,
+            "node_id": None,
+            "spec": req["spec"],
+            "max_restarts": spec.max_restarts,
+            "num_restarts": 0,
+            "detached": spec.detached,
+            "death_cause": None,
+            "class_name": spec.name,
+        }
+        self._pending_actors.append(actor_id)
+        self._retry_wakeup.set()
+        return {"ok": True}
+
+    async def _schedule_one(self, actor_id: bytes):
+        try:
+            done = await self._schedule_actor(actor_id)
+        except Exception:
+            logger.exception("actor scheduling error")
+            done = False
+        finally:
+            self._scheduling_actors.discard(actor_id)
+        if done and actor_id in self._pending_actors:
+            self._pending_actors.remove(actor_id)
+
+    async def _schedule_actor(self, actor_id: bytes) -> bool:
+        info = self.actors.get(actor_id)
+        if info is None or info["state"] not in (PENDING, RESTARTING):
+            return True
+        spec = task_mod.TaskSpec.from_wire(info["spec"])
+        if spec.placement_group_id is not None:
+            # PG-targeted actors are placed on the bundle's node.
+            pg = self.placement_groups.get(spec.placement_group_id)
+            if pg is None or pg["state"] != "CREATED":
+                return False
+            index = spec.bundle_index if spec.bundle_index >= 0 else 0
+            node_id = pg["bundle_nodes"][index]
+            node = next(
+                (n for n in self.view.alive_nodes() if n.node_id == node_id),
+                None,
+            )
+        else:
+            node = pick_node(
+                self.view, spec.resources, spec.strategy,
+                target_node_id=spec.node_id,
+                soft=spec.soft,
+                spread_threshold=self.config.scheduler_spread_threshold,
+            )
+        if node is None:
+            return False
+        try:
+            raylet = await self.clients.get(node.raylet_addr)
+            lease = await raylet.call(
+                "request_worker_lease",
+                {"spec": info["spec"], "dedicated": True},
+                timeout=60.0,
+            )
+        except (ConnectionLost, RpcError, OSError, asyncio.TimeoutError) as e:
+            logger.warning("actor lease failed on %s: %s", node.raylet_addr, e)
+            return False
+        if not lease.get("granted"):
+            return False
+        worker_addr = lease["worker_addr"]
+        try:
+            worker = await self.clients.get(worker_addr)
+            reply = await worker.call("push_task", {"spec": info["spec"]},
+                                      timeout=300.0)
+            if reply.get("error"):
+                raise RpcError(reply["error_msg"])
+        except (ConnectionLost, RpcError, OSError, asyncio.TimeoutError) as e:
+            logger.warning("actor creation failed on %s: %s", worker_addr, e)
+            info["death_cause"] = f"creation failed: {e}"
+            info["state"] = DEAD
+            # Release the dedicated lease and kill the contaminated worker,
+            # or the node permanently loses those resources.
+            try:
+                await raylet.call("return_worker", {
+                    "lease_id": lease["lease_id"],
+                    "worker_dead": False,
+                    "kill_worker": True,
+                })
+            except (ConnectionLost, RpcError, OSError):
+                pass
+            await self._publish_actor(actor_id)
+            return True
+        info["state"] = ALIVE
+        info["addr"] = worker_addr
+        info["node_id"] = node.node_id
+        info["worker_id"] = lease.get("worker_id")
+        await self._publish_actor(actor_id)
+        return True
+
+    async def _publish_actor(self, actor_id: bytes):
+        info = self.actors[actor_id]
+        await self.publish("actors", {
+            "actor_id": actor_id,
+            "state": info["state"],
+            "addr": info["addr"],
+            "death_cause": info["death_cause"],
+            "num_restarts": info["num_restarts"],
+        })
+
+    async def rpc_get_actor(self, req):
+        actor_id = req.get("actor_id")
+        if actor_id is None and req.get("name"):
+            actor_id = self.named_actors.get(req["name"])
+            if actor_id is None:
+                return {"found": False}
+        info = self.actors.get(actor_id)
+        if info is None:
+            return {"found": False}
+        return {
+            "found": True,
+            "actor_id": actor_id,
+            "state": info["state"],
+            "addr": info["addr"],
+            "spec": info["spec"],
+            "death_cause": info["death_cause"],
+            "num_restarts": info["num_restarts"],
+            "name": info["name"],
+            "class_name": info.get("class_name"),
+        }
+
+    async def rpc_list_actors(self, req):
+        return [
+            {
+                "actor_id": a["actor_id"],
+                "state": a["state"],
+                "name": a["name"],
+                "class_name": a.get("class_name"),
+                "node_id": a.get("node_id"),
+                "num_restarts": a["num_restarts"],
+            }
+            for a in self.actors.values()
+        ]
+
+    async def rpc_report_actor_death(self, req):
+        await self._on_actor_failure(req["actor_id"], req.get("reason", "died"))
+        return {"ok": True}
+
+    async def _on_actor_failure(self, actor_id: bytes, reason: str):
+        info = self.actors.get(actor_id)
+        if info is None or info["state"] == DEAD:
+            return
+        restarts = info["max_restarts"]
+        if restarts == -1 or info["num_restarts"] < restarts:
+            info["num_restarts"] += 1
+            info["state"] = RESTARTING
+            info["addr"] = None
+            await self._publish_actor(actor_id)
+            self._pending_actors.append(actor_id)
+            self._retry_wakeup.set()
+        else:
+            info["state"] = DEAD
+            info["death_cause"] = reason
+            info["addr"] = None
+            await self._publish_actor(actor_id)
+
+    async def _kill_actor(self, actor_id: bytes, reason: str):
+        info = self.actors.get(actor_id)
+        if info is None:
+            return
+        addr = info.get("addr")
+        info["state"] = DEAD
+        info["death_cause"] = reason
+        info["max_restarts"] = 0
+        if addr:
+            try:
+                worker = await self.clients.get(addr)
+                await worker.notify("exit_worker", {"reason": reason})
+            except (ConnectionLost, OSError, RpcError):
+                pass
+        await self._publish_actor(actor_id)
+
+    async def rpc_kill_actor(self, req):
+        await self._kill_actor(req["actor_id"], req.get("reason", "ray.kill"))
+        return {"ok": True}
+
+    # ------------------------------------------------------------------
+    # placement groups (GcsPlacementGroupManager)
+    # ------------------------------------------------------------------
+
+    async def rpc_create_placement_group(self, req):
+        pg_id = req["pg_id"]
+        self.placement_groups[pg_id] = {
+            "pg_id": pg_id,
+            "bundles": req["bundles"],
+            "strategy": req["strategy"],
+            "name": req.get("name"),
+            "state": "PENDING",
+            "bundle_nodes": [],
+            "job_id": req.get("job_id"),
+        }
+        self._pending_pgs.append(pg_id)
+        self._retry_wakeup.set()
+        return {"ok": True}
+
+    async def _schedule_pg(self, pg_id: bytes) -> bool:
+        pg = self.placement_groups.get(pg_id)
+        if pg is None or pg["state"] != "PENDING":
+            return True
+        placement = place_bundles(self.view, pg["bundles"], pg["strategy"])
+        if placement is None:
+            return False
+        # Two-phase commit: prepare on every raylet, then commit (reference:
+        # GcsPlacementGroupScheduler prepare/commit protocol).
+        prepared = []
+        ok = True
+        for index, (node, demand) in enumerate(zip(placement, pg["bundles"])):
+            try:
+                raylet = await self.clients.get(node.raylet_addr)
+                reply = await raylet.call("prepare_bundle", {
+                    "pg_id": pg_id, "bundle_index": index, "resources": demand,
+                }, timeout=10.0)
+                if not reply.get("ok"):
+                    ok = False
+                    break
+                prepared.append((node, index))
+            except (ConnectionLost, RpcError, OSError, asyncio.TimeoutError):
+                ok = False
+                break
+        if not ok:
+            for node, index in prepared:
+                try:
+                    raylet = await self.clients.get(node.raylet_addr)
+                    await raylet.call("release_bundle",
+                                      {"pg_id": pg_id, "bundle_index": index})
+                except (ConnectionLost, RpcError, OSError):
+                    pass
+            return False
+        for node, index in prepared:
+            raylet = await self.clients.get(node.raylet_addr)
+            await raylet.call("commit_bundle",
+                              {"pg_id": pg_id, "bundle_index": index})
+        pg["state"] = "CREATED"
+        pg["bundle_nodes"] = [n.node_id for n in placement]
+        await self.publish("placement_groups", {
+            "pg_id": pg_id, "state": "CREATED",
+            "bundle_nodes": pg["bundle_nodes"],
+        })
+        return True
+
+    async def rpc_get_placement_group(self, req):
+        pg = self.placement_groups.get(req["pg_id"])
+        if pg is None:
+            return {"found": False}
+        return {"found": True, **{k: v for k, v in pg.items()}}
+
+    async def rpc_remove_placement_group(self, req):
+        pg = self.placement_groups.get(req["pg_id"])
+        if pg is None:
+            return {"ok": True}
+        for index, node_id in enumerate(pg.get("bundle_nodes", [])):
+            node = self.nodes.get(node_id)
+            if node and node["alive"]:
+                try:
+                    raylet = await self.clients.get(node["raylet_addr"])
+                    await raylet.call(
+                        "release_bundle",
+                        {"pg_id": pg["pg_id"], "bundle_index": index},
+                    )
+                except (ConnectionLost, RpcError, OSError):
+                    pass
+        pg["state"] = "REMOVED"
+        await self.publish("placement_groups",
+                           {"pg_id": pg["pg_id"], "state": "REMOVED"})
+        return {"ok": True}
+
+    # ------------------------------------------------------------------
+    # pending-work retry loop (actor + PG scheduling)
+    # ------------------------------------------------------------------
+
+    async def _retry_loop(self):
+        while True:
+            try:
+                await asyncio.wait_for(self._retry_wakeup.wait(), timeout=0.5)
+            except asyncio.TimeoutError:
+                pass
+            self._retry_wakeup.clear()
+            if self._pending_actors:
+                # Dispatch concurrently: one slow actor __init__ must not
+                # head-of-line block every other creation.
+                for actor_id in list(self._pending_actors):
+                    if actor_id in self._scheduling_actors:
+                        continue
+                    self._scheduling_actors.add(actor_id)
+                    asyncio.ensure_future(self._schedule_one(actor_id))
+            if self._pending_pgs:
+                still_pgs: List[bytes] = []
+                for pg_id in self._pending_pgs:
+                    done = await self._schedule_pg(pg_id)
+                    if not done:
+                        still_pgs.append(pg_id)
+                self._pending_pgs = still_pgs
+
+
+async def main(host: str, port: int):
+    import os
+    import signal
+
+    server = GcsServer(host, port)
+    await server.start()
+    print(f"GCS_READY {server.address}", flush=True)
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    loop.add_signal_handler(signal.SIGTERM, stop.set)
+    loop.add_signal_handler(signal.SIGINT, stop.set)
+
+    async def parent_watch():
+        # Exit if the spawning driver dies (see raylet main's parent_watch).
+        parent = os.getppid()
+        while os.getppid() == parent:
+            await asyncio.sleep(1.0)
+        stop.set()
+
+    asyncio.ensure_future(parent_watch())
+    await stop.wait()
+    await server.stop()
+
+
+if __name__ == "__main__":
+    import argparse
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--log-file", default=None)
+    args = parser.parse_args()
+    if args.log_file:
+        logging.basicConfig(filename=args.log_file, level=logging.INFO)
+    asyncio.run(main(args.host, args.port))

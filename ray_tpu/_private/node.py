@@ -1,0 +1,158 @@
+"""Node bootstrap: spawn the daemons that make up a ray_tpu node.
+
+Reference: `python/ray/_private/node.py` — `start_head_processes` (GCS then
+raylet, dashboard, monitors) and `python/ray/_private/services.py` command
+assembly. Also provides `Cluster`, the multi-node-on-one-machine testing
+mechanism (reference: `python/ray/cluster_utils.py:135` — one raylet + store
+per simulated node, one shared GCS).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+class ProcessHandle:
+    def __init__(self, proc: subprocess.Popen, ready_line: str, log_path: str):
+        self.proc = proc
+        self.ready_line = ready_line
+        self.log_path = log_path
+
+    def terminate(self):
+        if self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+
+
+def _spawn(args: List[str], log_path: str, ready_prefix: str,
+           timeout: float = 30.0, env: dict | None = None) -> ProcessHandle:
+    env = dict(env or os.environ)
+    env.setdefault("PYTHONPATH", REPO_ROOT)
+    # Daemons never touch accelerators; workers get chips explicitly. Keep
+    # the original platform setting so raylets can hand it to TPU workers.
+    if "JAX_PLATFORMS" in env and "RAY_TPU_WORKER_JAX_PLATFORMS" not in env:
+        env["RAY_TPU_WORKER_JAX_PLATFORMS"] = env["JAX_PLATFORMS"]
+    env["JAX_PLATFORMS"] = "cpu"
+    logfile = open(log_path, "wb")
+    proc = subprocess.Popen(
+        args, stdout=subprocess.PIPE, stderr=logfile, env=env,
+        cwd=REPO_ROOT,
+    )
+    logfile.close()
+    deadline = time.monotonic() + timeout
+    ready_line = ""
+    while time.monotonic() < deadline:
+        line = proc.stdout.readline().decode()
+        if not line:
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"daemon exited: {args!r}; log: {log_path}: "
+                    + open(log_path, errors="replace").read()[-2000:]
+                )
+            time.sleep(0.02)
+            continue
+        if line.startswith(ready_prefix):
+            ready_line = line.strip()
+            break
+    if not ready_line:
+        proc.terminate()
+        raise RuntimeError(f"daemon not ready in {timeout}s: {args!r}")
+    return ProcessHandle(proc, ready_line, log_path)
+
+
+class NodeHandle:
+    def __init__(self, raylet: ProcessHandle):
+        parts = raylet.ready_line.split()
+        self.raylet_addr = parts[1]
+        self.store_name = parts[2]
+        self.node_id_hex = parts[3]
+        self.process = raylet
+
+
+class Cluster:
+    """A real multi-daemon cluster on one machine.
+
+    `Cluster(num_nodes=3)` starts one GCS and three raylets, each with its
+    own shared-memory arena and worker pool — the mechanism every
+    multi-node test in the reference uses (`ray_start_cluster`).
+    """
+
+    def __init__(
+        self,
+        head_resources: Dict[str, float] | None = None,
+        object_store_memory: int | None = None,
+        session_dir: str | None = None,
+    ):
+        ts = int(time.time() * 1000)
+        self.session_dir = session_dir or f"/tmp/ray_tpu/session_{ts}_{os.getpid()}"
+        os.makedirs(os.path.join(self.session_dir, "logs"), exist_ok=True)
+        self.object_store_memory = object_store_memory
+        self.gcs: Optional[ProcessHandle] = None
+        self.nodes: List[NodeHandle] = []
+        self._start_gcs()
+        if head_resources is not None:
+            self.add_node(head_resources)
+
+    def _log(self, name: str) -> str:
+        return os.path.join(self.session_dir, "logs", name)
+
+    def _start_gcs(self):
+        self.gcs = _spawn(
+            [sys.executable, "-m", "ray_tpu._private.gcs",
+             "--log-file", self._log("gcs.log")],
+            self._log("gcs.out"),
+            "GCS_READY",
+        )
+        self.gcs_addr = self.gcs.ready_line.split()[1]
+
+    def add_node(self, resources: Dict[str, float],
+                 object_store_memory: int | None = None) -> NodeHandle:
+        args = [
+            sys.executable, "-m", "ray_tpu._private.raylet",
+            "--gcs-addr", self.gcs_addr,
+            "--resources", json.dumps(resources),
+            "--session-dir", self.session_dir,
+            "--log-file", self._log(f"raylet-{len(self.nodes)}.log"),
+        ]
+        mem = object_store_memory or self.object_store_memory
+        if mem:
+            args += ["--object-store-memory", str(mem)]
+        raylet = _spawn(args, self._log(f"raylet-{len(self.nodes)}.out"),
+                        "RAYLET_READY")
+        node = NodeHandle(raylet)
+        self.nodes.append(node)
+        return node
+
+    @property
+    def head_node(self) -> NodeHandle:
+        return self.nodes[0]
+
+    def remove_node(self, node: NodeHandle):
+        node.process.terminate()
+        self.nodes.remove(node)
+
+    def shutdown(self):
+        # Arena cleanup is scoped to THIS session's stores — other clusters
+        # on the machine own their own /dev/shm entries.
+        store_names = [n.store_name for n in self.nodes]
+        for node in self.nodes:
+            node.process.terminate()
+        if self.gcs:
+            self.gcs.terminate()
+        self.nodes.clear()
+        for name in store_names:
+            try:
+                os.unlink(f"/dev/shm{name}")
+            except OSError:
+                pass

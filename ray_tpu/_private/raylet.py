@@ -1,0 +1,734 @@
+"""Raylet — the per-node daemon: local scheduler, worker pool, object plane.
+
+Reference: `src/ray/raylet/` — `NodeManager` (lease protocol + dispatch),
+`WorkerPool` (spawns/pools per-job worker processes, `worker_pool.h:159`),
+`LocalTaskManager` (dispatch queue), `DependencyManager` (pulls task args
+into the local store), `PlacementGroupResourceManager` (bundle reservations),
+plus the `ObjectManager` node-to-node transfer path
+(`src/ray/object_manager/object_manager.h:117`). The shared-memory arena
+(plasma) is created by this process and inherited by workers, exactly as the
+reference embeds the plasma store in the raylet.
+
+TPU-specific: the raylet owns the node's TPU chips as schedulable resources;
+a lease that consumes `TPU` gets dedicated chips and the worker is spawned
+with `TPU_VISIBLE_CHIPS` so JAX in that worker only initializes its chips
+(reference sketch: python/ray/_private/accelerators/tpu.py).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import json
+import logging
+import os
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ray_tpu._private import task as task_mod
+from ray_tpu._private.config import Config
+from ray_tpu._private.ids import NodeID, ObjectID
+from ray_tpu._private.object_store import ObjectStore
+from ray_tpu._private.rpc import ClientPool, ConnectionLost, RpcError, RpcServer
+from ray_tpu._private.scheduling import ClusterView, pick_node
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class WorkerHandle:
+    worker_id: bytes
+    addr: str
+    pid: int
+    job_id: bytes
+    proc: Optional[asyncio.subprocess.Process] = None
+    tpu_chips: tuple = ()
+    alive: bool = True
+
+
+@dataclass
+class Lease:
+    lease_id: int
+    spec: task_mod.TaskSpec
+    dedicated: bool
+    reply_fut: asyncio.Future
+    resources: Dict[str, float] = field(default_factory=dict)
+    worker: Optional[WorkerHandle] = None
+    deps_ready: bool = False
+    acquired: bool = False
+    pg_key: Optional[tuple] = None
+
+
+class Raylet:
+    def __init__(
+        self,
+        gcs_addr: str,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        resources: Dict[str, float] | None = None,
+        store_name: str | None = None,
+        object_store_memory: int | None = None,
+        config: Config | None = None,
+        session_dir: str = "/tmp/ray_tpu",
+    ):
+        self.config = config or Config.from_env()
+        self.node_id = NodeID.from_random()
+        self.gcs_addr = gcs_addr
+        self.server = RpcServer(host, port)
+        self.clients = ClientPool()
+        self.session_dir = session_dir
+
+        self.total = dict(resources or {"CPU": os.cpu_count() or 1})
+        self.available = dict(self.total)
+        # TPU chips are individually assignable; a chip is bound to a
+        # worker process from spawn until that worker dies (a JAX process
+        # owns its chips for its lifetime — chips cannot be handed between
+        # live processes).
+        n_tpu = int(self.total.get("TPU", 0))
+        self.unassigned_chips: List[int] = list(range(n_tpu))
+
+        self.store_name = store_name or f"/ray_tpu_{self.node_id.hex()[:12]}"
+        self.store = ObjectStore.create(
+            self.store_name,
+            object_store_memory or self.config.object_store_memory,
+            self.config.object_store_table_size,
+        )
+
+        # Worker pool state.
+        self._idle: Dict[tuple, List[WorkerHandle]] = {}
+        self._workers: Dict[bytes, WorkerHandle] = {}
+        self._starting: Dict[tuple, int] = {}
+        self._register_waiters: Dict[tuple, List[asyncio.Future]] = {}
+
+        self._leases: Dict[int, Lease] = {}
+        self._pending: List[Lease] = []
+        self._lease_seq = itertools.count(1)
+        self._bundles: Dict[tuple, Dict[str, float]] = {}  # committed PG bundles
+        self._bundle_available: Dict[tuple, Dict[str, float]] = {}
+        self.view = ClusterView()
+        self._bg: list = []
+        self._spawned_procs: List[tuple] = []  # (proc, pool_key) pre-register
+        self._pulls_inflight: Dict[bytes, asyncio.Future] = {}
+        self._freed_since_heartbeat = False
+        self._actor_workers: Dict[bytes, bytes] = {}  # worker_id -> actor_id
+
+    # ------------------------------------------------------------------
+
+    async def start(self):
+        self.server.register_all(self)
+        await self.server.start()
+        self.gcs = await self.clients.get(self.gcs_addr)
+        await self.gcs.call("register_node", {
+            "node_id": self.node_id.binary(),
+            "raylet_addr": self.server.address,
+            "total": self.total,
+            "available": self.available,
+            "hostname": os.uname().nodename,
+        })
+        await self.gcs.call("subscribe",
+                            {"channel": "jobs", "addr": self.server.address})
+        self.view.update_node(self.node_id.binary(), self.server.address,
+                              self.total, self.available)
+        self._bg = [
+            asyncio.ensure_future(self._heartbeat_loop()),
+            asyncio.ensure_future(self._reap_loop()),
+        ]
+        logger.info("raylet %s on %s", self.node_id.hex()[:8], self.server.address)
+        return self
+
+    async def stop(self):
+        for t in self._bg:
+            t.cancel()
+        for w in self._workers.values():
+            if w.proc and w.proc.returncode is None:
+                try:
+                    w.proc.terminate()
+                except ProcessLookupError:
+                    pass
+        await self.clients.close_all()
+        await self.server.stop()
+        self.store.destroy()
+
+    @property
+    def address(self) -> str:
+        return self.server.address
+
+    # ------------------------------------------------------------------
+    # sync with GCS
+    # ------------------------------------------------------------------
+
+    async def _heartbeat_loop(self):
+        while True:
+            await asyncio.sleep(self.config.raylet_heartbeat_period_s)
+            try:
+                reply = await self.gcs.call("heartbeat", {
+                    "node_id": self.node_id.binary(),
+                    "available": self.available,
+                    "idle_freed": self._freed_since_heartbeat,
+                }, timeout=5.0)
+                self._freed_since_heartbeat = False
+                if reply.get("reregister"):
+                    await self.gcs.call("register_node", {
+                        "node_id": self.node_id.binary(),
+                        "raylet_addr": self.server.address,
+                        "total": self.total,
+                        "available": self.available,
+                    })
+                for n in reply.get("view", []):
+                    self.view.update_node(n["node_id"], n["raylet_addr"],
+                                          n["total"], n["available"])
+                current = {n["node_id"] for n in reply.get("view", [])}
+                for node_id in list(self.view.nodes):
+                    if node_id not in current:
+                        self.view.remove_node(node_id)
+            except (ConnectionLost, RpcError, OSError, asyncio.TimeoutError):
+                pass
+
+    async def _reap_loop(self):
+        """Detect dead worker processes (reference: WorkerPool monitors its
+        children; NodeManager death-notifies the GCS for actors)."""
+        while True:
+            await asyncio.sleep(0.2)
+            for worker in list(self._workers.values()):
+                if worker.proc is not None and worker.proc.returncode is not None \
+                        and worker.alive:
+                    await self._on_worker_death(worker)
+            # Workers that died before registering must release their
+            # "starting" slot (and chips) or the pool stops replacing them.
+            for entry in list(self._spawned_procs):
+                proc, key = entry[0], entry[1]
+                starting_key = entry[2] if len(entry) > 2 else key
+                if proc.returncode is not None:
+                    self._spawned_procs.remove(entry)
+                    self._starting[starting_key] = max(
+                        0, self._starting.get(starting_key, 0) - 1)
+                    self.unassigned_chips.extend(key[1])
+                    self._dispatch()
+
+    async def _on_worker_death(self, worker: WorkerHandle):
+        worker.alive = False
+        self._workers.pop(worker.worker_id, None)
+        self.unassigned_chips.extend(worker.tpu_chips)
+        for pool in self._idle.values():
+            if worker in pool:
+                pool.remove(worker)
+        # Free resources of any lease bound to this worker.
+        for lease in list(self._leases.values()):
+            if lease.worker is worker:
+                self._release_lease(lease, worker_dead=True)
+        actor_id = self._actor_workers.pop(worker.worker_id, None)
+        if actor_id is not None:
+            try:
+                await self.gcs.call("report_actor_death", {
+                    "actor_id": actor_id,
+                    "reason": f"worker process {worker.pid} exited",
+                })
+            except (ConnectionLost, RpcError, OSError):
+                pass
+        self._dispatch()
+
+    async def rpc_pubsub(self, msg):
+        if msg["channel"] == "jobs" and msg["data"].get("event") == "finished":
+            job_id = msg["data"]["job_id"]
+            for worker in list(self._workers.values()):
+                if worker.job_id == job_id and worker.proc \
+                        and worker.proc.returncode is None:
+                    worker.proc.terminate()
+        return None
+
+    # ------------------------------------------------------------------
+    # worker pool
+    # ------------------------------------------------------------------
+
+    def _pool_key(self, job_id: bytes, tpu_chips: tuple) -> tuple:
+        return (job_id, tpu_chips)
+
+    async def _spawn_worker(self, job_id: bytes, tpu_chips: tuple):
+        env = dict(os.environ)
+        env["RAY_TPU_NODE_ID"] = self.node_id.hex()
+        if tpu_chips:
+            env["TPU_VISIBLE_CHIPS"] = ",".join(str(c) for c in tpu_chips)
+            env["TPU_CHIPS_PER_PROCESS_BOUNDS"] = "1,1,1"
+            # The raylet daemon runs with JAX_PLATFORMS=cpu; TPU workers
+            # must get the machine's original platform back or JAX would
+            # silently compute "TPU" tasks on host CPU.
+            original = env.pop("RAY_TPU_WORKER_JAX_PLATFORMS", None)
+            if original:
+                env["JAX_PLATFORMS"] = original
+            else:
+                env.pop("JAX_PLATFORMS", None)
+        else:
+            # CPU-only workers must never grab the node's TPU chips.
+            env["JAX_PLATFORMS"] = "cpu"
+        log_dir = os.path.join(self.session_dir, "logs")
+        os.makedirs(log_dir, exist_ok=True)
+        log_path = os.path.join(
+            log_dir, f"worker-{len(self._workers)}-{os.urandom(3).hex()}.log"
+        )
+        logfile = open(log_path, "ab")
+        proc = await asyncio.create_subprocess_exec(
+            sys.executable, "-m", "ray_tpu._private.worker_main",
+            "--raylet-addr", self.server.address,
+            "--gcs-addr", self.gcs_addr,
+            "--store-name", self.store_name,
+            "--node-id", self.node_id.hex(),
+            "--job-id", job_id.hex(),
+            "--tpu-chips", ",".join(str(c) for c in tpu_chips),
+            env=env,
+            stdout=logfile,
+            stderr=logfile,
+        )
+        logfile.close()
+        return proc
+
+    async def rpc_register_worker(self, req):
+        worker = WorkerHandle(
+            worker_id=req["worker_id"],
+            addr=req["addr"],
+            pid=req["pid"],
+            job_id=req["job_id"],
+            tpu_chips=tuple(req.get("tpu_chips", ())),
+        )
+        # Adopt the subprocess handle if we spawned it.
+        if worker.tpu_chips:
+            key = self._pool_key(worker.job_id, ("tpu", len(worker.tpu_chips)))
+        else:
+            key = self._pool_key(worker.job_id, ())
+        if self._starting.get(key):
+            self._starting[key] -= 1
+        key = self._pool_key(worker.job_id, worker.tpu_chips)
+        self._workers[worker.worker_id] = worker
+        self._idle.setdefault(key, []).append(worker)
+        self._match_worker_procs(worker)
+        self._dispatch()
+        return {"node_id": self.node_id.binary(), "store_name": self.store_name}
+
+    def _match_worker_procs(self, worker: WorkerHandle):
+        # Attach the asyncio Process object by pid for death detection.
+        for entry in self._spawned_procs:
+            if entry[0].pid == worker.pid:
+                worker.proc = entry[0]
+                self._spawned_procs.remove(entry)
+                return
+
+    # ------------------------------------------------------------------
+    # lease protocol (reference: NodeManager::HandleRequestWorkerLease)
+    # ------------------------------------------------------------------
+
+    async def rpc_request_worker_lease(self, req):
+        spec = task_mod.TaskSpec.from_wire(req["spec"])
+        dedicated = bool(req.get("dedicated")) or \
+            spec.task_type == task_mod.ACTOR_CREATION_TASK
+
+        # Cluster-level decision: schedule here or spill back to another node.
+        if spec.placement_group_id is None and not req.get("no_spillback"):
+            if (spec.strategy == task_mod.STRATEGY_NODE_AFFINITY
+                    and spec.node_id is not None and not spec.soft):
+                # Hard affinity: always route to the target raylet — it is
+                # the authority on its own resources and queues the lease
+                # if busy. Deciding from our (possibly stale) view here
+                # could wrongly run the task locally.
+                if spec.node_id != self.node_id.binary():
+                    target = self.view.nodes.get(spec.node_id)
+                    if target is None:
+                        return {"granted": False,
+                                "error": "affinity target node is dead"}
+                    return {"granted": False,
+                            "spillback_addr": target.raylet_addr}
+            else:
+                node = pick_node(
+                    self.view, spec.resources, spec.strategy,
+                    local_node_id=self.node_id.binary(),
+                    target_node_id=spec.node_id,
+                    soft=spec.soft,
+                    spread_threshold=self.config.scheduler_spread_threshold,
+                )
+                if node is not None and node.node_id != self.node_id.binary():
+                    return {"granted": False,
+                            "spillback_addr": node.raylet_addr}
+
+        lease = Lease(
+            lease_id=next(self._lease_seq),
+            spec=spec,
+            dedicated=dedicated,
+            reply_fut=asyncio.get_event_loop().create_future(),
+            resources=dict(spec.resources),
+        )
+        if spec.placement_group_id is not None:
+            lease.pg_key = (spec.placement_group_id, spec.bundle_index)
+        self._leases[lease.lease_id] = lease
+        self._pending.append(lease)
+        asyncio.ensure_future(self._localize_deps(lease))
+        self._dispatch()
+        return await lease.reply_fut
+
+    async def _localize_deps(self, lease: Lease):
+        deps = lease.spec.plasma_deps()
+        try:
+            await asyncio.gather(*[
+                self.pull_object(ObjectID(oid), owner) for oid, owner in deps
+            ])
+            lease.deps_ready = True
+        except Exception as e:  # noqa: BLE001 — dep failure fails the lease
+            if not lease.reply_fut.done():
+                lease.reply_fut.set_result(
+                    {"granted": False, "error": f"dependency fetch failed: {e}"}
+                )
+            if lease in self._pending:
+                self._pending.remove(lease)
+            self._leases.pop(lease.lease_id, None)
+            return
+        self._dispatch()
+
+    def _try_acquire(self, lease: Lease) -> bool:
+        """Deduct lease resources from the node pool (or its PG bundle)."""
+        pool = self.available
+        if lease.pg_key is not None:
+            pg_id, bundle_index = lease.pg_key
+            if bundle_index < 0:
+                # Any bundle of this PG on this node that fits.
+                demand = lease.resources
+                for key, avail in self._bundle_available.items():
+                    if key[0] == pg_id and all(
+                        avail.get(k, 0.0) >= v for k, v in demand.items() if v > 0
+                    ):
+                        lease.pg_key = key
+                        break
+                else:
+                    return False
+            pool = self._bundle_available.get(lease.pg_key)
+            if pool is None:
+                return False
+        demand = lease.resources
+        if not all(pool.get(k, 0.0) >= v for k, v in demand.items() if v > 0):
+            return False
+        for k, v in demand.items():
+            pool[k] = pool.get(k, 0.0) - v
+        lease.acquired = True
+        return True
+
+    def _release_resources(self, lease: Lease):
+        if not lease.acquired:
+            return
+        pool = self.available
+        if lease.pg_key is not None:
+            pool = self._bundle_available.get(lease.pg_key)
+            if pool is None:
+                lease.acquired = False
+                return
+        for k, v in lease.resources.items():
+            pool[k] = pool.get(k, 0.0) + v
+        lease.acquired = False
+        self._freed_since_heartbeat = True
+
+    def _find_idle_tpu_worker(self, job_id: bytes, n_chips: int):
+        for key, pool in self._idle.items():
+            if key[0] == job_id and len(key[1]) == n_chips and pool:
+                return pool.pop()
+        return None
+
+    def _reclaim_idle_tpu_workers(self, needed: int):
+        """Terminate idle TPU workers so their chips return to the
+        unassigned pool (via the death path) when a pending lease needs a
+        different chip grouping."""
+        reclaimable = 0
+        for key, pool in self._idle.items():
+            if not key[1]:
+                continue
+            for worker in list(pool):
+                if worker.proc is not None and worker.proc.returncode is None:
+                    worker.proc.terminate()
+                    pool.remove(worker)
+                    reclaimable += len(worker.tpu_chips)
+                    if reclaimable + len(self.unassigned_chips) >= needed:
+                        return True
+        return reclaimable > 0
+
+    def _dispatch(self):
+        """Dispatch queue scan (reference: LocalTaskManager::
+        ScheduleAndDispatchTasks)."""
+        # key -> number of leases that hold resources but lack a worker.
+        spawn_needed: Dict[tuple, int] = {}
+        for lease in list(self._pending):
+            if not lease.deps_ready:
+                continue
+            if not lease.acquired and not self._try_acquire(lease):
+                continue
+            n_chips = int(lease.resources.get("TPU", 0))
+            if n_chips:
+                worker = self._find_idle_tpu_worker(lease.spec.job_id, n_chips)
+                if worker is not None:
+                    self._grant(lease, worker)
+                    self._pending.remove(lease)
+                    continue
+                key = self._pool_key(lease.spec.job_id, ("tpu", n_chips))
+                if self._starting.get(key, 0) > 0:
+                    continue  # a matching worker is already starting
+                if len(self.unassigned_chips) >= n_chips:
+                    # Chips are reserved here, at spawn decision time, so
+                    # two pending leases can never spawn workers holding
+                    # the same chips.
+                    chips = tuple(self.unassigned_chips[:n_chips])
+                    del self.unassigned_chips[:n_chips]
+                    self._starting[key] = self._starting.get(key, 0) + 1
+                    asyncio.ensure_future(self._spawn_and_track(
+                        (lease.spec.job_id, chips), starting_key=key))
+                else:
+                    self._reclaim_idle_tpu_workers(n_chips)
+                continue
+            key = self._pool_key(lease.spec.job_id, ())
+            idle = self._idle.get(key, [])
+            if idle:
+                worker = idle.pop()
+                self._grant(lease, worker)
+                self._pending.remove(lease)
+            else:
+                spawn_needed[key] = spawn_needed.get(key, 0) + 1
+        # Spawn exactly the shortfall: workers already starting count against
+        # the need, and total in-flight spawns are capped. The shortfall is
+        # bounded by acquired resources, so a request flood cannot fork more
+        # workers than the node has capacity for.
+        for key, needed in spawn_needed.items():
+            starting = self._starting.get(key, 0)
+            cap = self.config.maximum_startup_concurrency - starting
+            for _ in range(max(0, min(needed - starting, cap))):
+                self._starting[key] = self._starting.get(key, 0) + 1
+                asyncio.ensure_future(self._spawn_and_track(key))
+
+    async def _spawn_and_track(self, key: tuple, starting_key: tuple | None = None):
+        job_id, chips = key
+        starting_key = starting_key or key
+        try:
+            proc = await self._spawn_worker(job_id, chips)
+        except Exception:
+            logger.exception("worker spawn failed")
+            self._starting[starting_key] = max(
+                0, self._starting.get(starting_key, 0) - 1)
+            self.unassigned_chips.extend(chips)
+            return
+        self._spawned_procs.append((proc, key, starting_key))
+
+    def _grant(self, lease: Lease, worker: WorkerHandle):
+        lease.worker = worker
+        if lease.spec.task_type == task_mod.ACTOR_CREATION_TASK:
+            self._actor_workers[worker.worker_id] = lease.spec.actor_id
+            # Actors use their resources for *placement* but hold only
+            # accelerators while alive (reference: actors hold 0 CPU after
+            # creation, ray docs "actors use 1 CPU for scheduling and 0 for
+            # running"); otherwise N live actors deadlock an N-CPU node.
+            pool = self.available
+            if lease.pg_key is not None:
+                pool = self._bundle_available.get(lease.pg_key, pool)
+            released = {k: v for k, v in lease.resources.items() if k != "TPU"}
+            for k, v in released.items():
+                pool[k] = pool.get(k, 0.0) + v
+            lease.resources = {k: v for k, v in lease.resources.items()
+                               if k == "TPU"}
+            self._freed_since_heartbeat = True
+        if not lease.reply_fut.done():
+            lease.reply_fut.set_result({
+                "granted": True,
+                "worker_addr": worker.addr,
+                "worker_id": worker.worker_id,
+                "lease_id": lease.lease_id,
+                "node_id": self.node_id.binary(),
+            })
+
+    def _release_lease(self, lease: Lease, worker_dead: bool = False):
+        self._release_resources(lease)
+        self._leases.pop(lease.lease_id, None)
+        if lease in self._pending:
+            self._pending.remove(lease)
+        worker = lease.worker
+        if worker is None:
+            return
+        if worker_dead:
+            return
+        if lease.dedicated:
+            # Actor workers stay bound to the actor until it dies.
+            return
+        key = self._pool_key(worker.job_id, worker.tpu_chips)
+        self._idle.setdefault(key, []).append(worker)
+
+    async def rpc_return_worker(self, req):
+        lease = self._leases.get(req["lease_id"])
+        if lease is None:
+            return {"ok": False}
+        worker = lease.worker
+        self._release_lease(lease, worker_dead=req.get("worker_dead", False))
+        if req.get("kill_worker") and worker is not None and worker.proc \
+                and worker.proc.returncode is None:
+            worker.proc.terminate()  # death path returns its chips/slots
+        self._dispatch()
+        return {"ok": True}
+
+    # ------------------------------------------------------------------
+    # placement group bundles
+    # ------------------------------------------------------------------
+
+    async def rpc_prepare_bundle(self, req):
+        key = (req["pg_id"], req["bundle_index"])
+        demand = req["resources"]
+        if not all(self.available.get(k, 0.0) >= v for k, v in demand.items()):
+            return {"ok": False}
+        for k, v in demand.items():
+            self.available[k] = self.available.get(k, 0.0) - v
+        self._bundles[key] = dict(demand)
+        return {"ok": True}
+
+    async def rpc_commit_bundle(self, req):
+        key = (req["pg_id"], req["bundle_index"])
+        if key not in self._bundles:
+            return {"ok": False}
+        self._bundle_available[key] = dict(self._bundles[key])
+        self._dispatch()
+        return {"ok": True}
+
+    async def rpc_release_bundle(self, req):
+        key = (req["pg_id"], req["bundle_index"])
+        demand = self._bundles.pop(key, None)
+        self._bundle_available.pop(key, None)
+        if demand:
+            for k, v in demand.items():
+                self.available[k] = self.available.get(k, 0.0) + v
+            self._freed_since_heartbeat = True
+        self._dispatch()
+        return {"ok": True}
+
+    # ------------------------------------------------------------------
+    # object plane (DependencyManager + ObjectManager)
+    # ------------------------------------------------------------------
+
+    async def pull_object(self, object_id: ObjectID, owner_addr: str):
+        """Ensure `object_id` is in the local store, fetching if needed."""
+        if self.store.contains(object_id):
+            return
+        inflight = self._pulls_inflight.get(object_id.binary())
+        if inflight is not None:
+            await inflight
+            return
+        fut = asyncio.get_event_loop().create_future()
+        self._pulls_inflight[object_id.binary()] = fut
+        try:
+            owner = await self.clients.get(owner_addr)
+            status = await owner.call("get_object_status", {
+                "object_id": object_id.binary(),
+                "wait": True,
+            }, timeout=300.0)
+            if status.get("error"):
+                raise RuntimeError(status["error"])
+            if self.store.contains(object_id):
+                return
+            if status["status"] == "inband":
+                self.store.put_raw(object_id, status["value"])
+            else:
+                locations = [
+                    a for a in status.get("locations", [])
+                    if a != self.server.address
+                ]
+                if not locations:
+                    raise RuntimeError(
+                        f"no locations for object {object_id.hex()}"
+                    )
+                holder = await self.clients.get(locations[0])
+                data = await holder.call(
+                    "fetch_object", {"object_id": object_id.binary()},
+                    timeout=300.0,
+                )
+                if data.get("data") is None:
+                    raise RuntimeError(f"fetch failed for {object_id.hex()}")
+                self.store.put_raw(object_id, data["data"])
+                await owner.notify("add_object_location", {
+                    "object_id": object_id.binary(),
+                    "raylet_addr": self.server.address,
+                })
+            fut.set_result(True)
+        except BaseException as e:
+            fut.set_exception(e)
+            raise
+        finally:
+            if not fut.done():
+                fut.set_result(True)
+            # The entry only dedupes concurrent pulls; once settled it must
+            # go away or a later re-pull (after eviction) would no-op on the
+            # stale completed future.
+            self._pulls_inflight.pop(object_id.binary(), None)
+
+    async def rpc_pull_object(self, req):
+        await self.pull_object(ObjectID(req["object_id"]), req["owner_addr"])
+        return {"ok": True}
+
+    async def rpc_fetch_object(self, req):
+        buf = self.store.get_buffer(ObjectID(req["object_id"]), timeout=-1)
+        if buf is None:
+            return {"data": None}
+        return {"data": bytes(buf)}
+
+    async def rpc_get_store_stats(self, req):
+        return self.store.stats()
+
+    async def rpc_node_info(self, req):
+        return {
+            "node_id": self.node_id.binary(),
+            "store_name": self.store_name,
+            "total": self.total,
+            "available": self.available,
+            "num_workers": len(self._workers),
+        }
+
+
+async def main(args):
+    resources = json.loads(args.resources) if args.resources else None
+    raylet = Raylet(
+        gcs_addr=args.gcs_addr,
+        host=args.host,
+        port=args.port,
+        resources=resources,
+        store_name=args.store_name or None,
+        object_store_memory=args.object_store_memory or None,
+        session_dir=args.session_dir,
+    )
+    await raylet.start()
+    print(f"RAYLET_READY {raylet.address} {raylet.store_name} "
+          f"{raylet.node_id.hex()}", flush=True)
+    import signal
+
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    loop.add_signal_handler(signal.SIGTERM, stop.set)
+    loop.add_signal_handler(signal.SIGINT, stop.set)
+
+    async def parent_watch():
+        # Daemons are children of the driver that spawned the cluster; if
+        # that driver dies abruptly (crash, SIGKILL) we are reparented to
+        # init — tear down instead of leaking (reference: raylets die with
+        # the session via `ray stop`; subreaper kills orphans).
+        parent = os.getppid()
+        while os.getppid() == parent:
+            await asyncio.sleep(1.0)
+        stop.set()
+
+    asyncio.ensure_future(parent_watch())
+    await stop.wait()
+    # Graceful teardown: kill worker children, unlink the shm arena.
+    await raylet.stop()
+
+
+if __name__ == "__main__":
+    import argparse
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--gcs-addr", required=True)
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--resources", default=None)
+    parser.add_argument("--store-name", default=None)
+    parser.add_argument("--object-store-memory", type=int, default=0)
+    parser.add_argument("--session-dir", default="/tmp/ray_tpu")
+    parser.add_argument("--log-file", default=None)
+    args = parser.parse_args()
+    if args.log_file:
+        logging.basicConfig(filename=args.log_file, level=logging.INFO)
+    asyncio.run(main(args))

@@ -1,0 +1,192 @@
+"""Cluster scheduling policies.
+
+Reference: `src/ray/raylet/scheduling/policy/` — hybrid (pack until a
+utilization threshold, then spread), spread, node-affinity, and
+placement-group bundle policies, all over a cluster resource view synced from
+heartbeats (the ray_syncer equivalent). Used by both raylets (task leases)
+and the GCS (actor creation, placement-group bundle placement).
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ray_tpu._private import task as task_mod
+
+
+@dataclass
+class NodeResources:
+    node_id: bytes
+    raylet_addr: str
+    total: Dict[str, float] = field(default_factory=dict)
+    available: Dict[str, float] = field(default_factory=dict)
+    alive: bool = True
+    labels: Dict[str, str] = field(default_factory=dict)
+
+    def feasible(self, demand: Dict[str, float]) -> bool:
+        return all(self.total.get(k, 0.0) >= v for k, v in demand.items() if v > 0)
+
+    def fits_now(self, demand: Dict[str, float]) -> bool:
+        return all(
+            self.available.get(k, 0.0) >= v for k, v in demand.items() if v > 0
+        )
+
+    def utilization(self) -> float:
+        parts = []
+        for key, total in self.total.items():
+            if total > 0:
+                parts.append(1.0 - self.available.get(key, 0.0) / total)
+        return max(parts) if parts else 0.0
+
+
+class ClusterView:
+    """A consistent snapshot of per-node resources, updated from heartbeats."""
+
+    def __init__(self):
+        self.nodes: Dict[bytes, NodeResources] = {}
+
+    def update_node(self, node_id: bytes, raylet_addr: str,
+                    total: Dict[str, float], available: Dict[str, float]):
+        node = self.nodes.get(node_id)
+        if node is None:
+            self.nodes[node_id] = NodeResources(
+                node_id, raylet_addr, dict(total), dict(available)
+            )
+        else:
+            node.total = dict(total)
+            node.available = dict(available)
+            node.raylet_addr = raylet_addr
+
+    def remove_node(self, node_id: bytes):
+        self.nodes.pop(node_id, None)
+
+    def alive_nodes(self) -> List[NodeResources]:
+        return [n for n in self.nodes.values() if n.alive]
+
+
+def pick_node(
+    view: ClusterView,
+    spec_resources: Dict[str, float],
+    strategy: str = task_mod.STRATEGY_DEFAULT,
+    local_node_id: Optional[bytes] = None,
+    target_node_id: Optional[bytes] = None,
+    soft: bool = False,
+    spread_threshold: float = 0.5,
+    rng: random.Random | None = None,
+) -> Optional[NodeResources]:
+    """Select a node for a task/actor. Returns None if nothing is feasible
+    right now (caller queues and retries when resources free up)."""
+    nodes = view.alive_nodes()
+    if not nodes:
+        return None
+
+    if strategy == task_mod.STRATEGY_NODE_AFFINITY and target_node_id is not None:
+        for n in nodes:
+            if n.node_id == target_node_id:
+                if n.fits_now(spec_resources):
+                    return n
+                return None if not soft else _best_fit(nodes, spec_resources)
+        return _best_fit(nodes, spec_resources) if soft else None
+
+    if strategy == task_mod.STRATEGY_SPREAD:
+        fitting = [n for n in nodes if n.fits_now(spec_resources)]
+        if not fitting:
+            return None
+        # Least-utilized first; random tiebreak for even spread.
+        (rng or random).shuffle(fitting)
+        return min(fitting, key=lambda n: n.utilization())
+
+    # DEFAULT hybrid policy: prefer the local node while it is under the
+    # spread threshold, else pick the best (lowest-utilization) fitting node.
+    local = None
+    if local_node_id is not None:
+        for n in nodes:
+            if n.node_id == local_node_id:
+                local = n
+                break
+    if (
+        local is not None
+        and local.fits_now(spec_resources)
+        and local.utilization() <= spread_threshold
+    ):
+        return local
+    return _best_fit(nodes, spec_resources)
+
+
+def _best_fit(nodes: List[NodeResources], demand: Dict[str, float]):
+    fitting = [n for n in nodes if n.fits_now(demand)]
+    if not fitting:
+        return None
+    return min(fitting, key=lambda n: n.utilization())
+
+
+def place_bundles(
+    view: ClusterView,
+    bundles: List[Dict[str, float]],
+    strategy: str,
+) -> Optional[List[NodeResources]]:
+    """Choose a node per bundle (reference: bundle_scheduling_policy.cc).
+
+    PACK: minimize node count (best effort). STRICT_PACK: all on one node.
+    SPREAD: prefer distinct nodes (best effort). STRICT_SPREAD: distinct
+    nodes required. Returns None if infeasible (all-or-nothing).
+    """
+    nodes = view.alive_nodes()
+    remaining = {
+        n.node_id: dict(n.available) for n in nodes
+    }
+    by_id = {n.node_id: n for n in nodes}
+
+    def try_place(node_id: bytes, demand: Dict[str, float]) -> bool:
+        avail = remaining[node_id]
+        if all(avail.get(k, 0.0) >= v for k, v in demand.items() if v > 0):
+            for k, v in demand.items():
+                avail[k] = avail.get(k, 0.0) - v
+            return True
+        return False
+
+    placement: List[NodeResources] = []
+
+    if strategy in ("PACK", "STRICT_PACK"):
+        order = sorted(nodes, key=lambda n: n.utilization())
+        for demand in bundles:
+            placed = False
+            # Prefer nodes already used by earlier bundles.
+            used_ids = [n.node_id for n in placement]
+            candidates = used_ids + [
+                n.node_id for n in order if n.node_id not in used_ids
+            ]
+            for node_id in candidates:
+                if try_place(node_id, demand):
+                    placement.append(by_id[node_id])
+                    placed = True
+                    break
+            if not placed:
+                return None
+        if strategy == "STRICT_PACK" and len({n.node_id for n in placement}) > 1:
+            return None
+        return placement
+
+    if strategy in ("SPREAD", "STRICT_SPREAD"):
+        for demand in bundles:
+            used_ids = {n.node_id for n in placement}
+            fresh = [n for n in nodes if n.node_id not in used_ids]
+            candidates = sorted(fresh, key=lambda n: n.utilization())
+            if strategy == "SPREAD":
+                candidates += sorted(
+                    [n for n in nodes if n.node_id in used_ids],
+                    key=lambda n: n.utilization(),
+                )
+            placed = False
+            for node in candidates:
+                if try_place(node.node_id, demand):
+                    placement.append(node)
+                    placed = True
+                    break
+            if not placed:
+                return None
+        return placement
+
+    raise ValueError(f"unknown placement strategy: {strategy}")

@@ -1,0 +1,117 @@
+"""Task specification — the unit shipped from submitter to executor.
+
+Equivalent of the reference's `TaskSpecification`
+(`src/ray/common/task/task_spec.h:247`), kept msgpack-serializable so it can
+ride the RPC layer without a separate proto toolchain. Args are a list of
+entries, each either an inlined serialized value or an object reference
+(top-level ObjectRef args become dependencies; the executor resolves them to
+values before invoking the function — reference semantics).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+NORMAL_TASK = "normal"
+ACTOR_CREATION_TASK = "actor_creation"
+ACTOR_TASK = "actor"
+
+# Scheduling strategies (reference: python/ray/util/scheduling_strategies.py).
+STRATEGY_DEFAULT = "DEFAULT"
+STRATEGY_SPREAD = "SPREAD"
+STRATEGY_NODE_AFFINITY = "NODE_AFFINITY"
+STRATEGY_PLACEMENT_GROUP = "PLACEMENT_GROUP"
+
+
+@dataclass
+class TaskSpec:
+    task_id: bytes
+    job_id: bytes
+    name: str
+    task_type: str = NORMAL_TASK
+    # Function: either a KV key into the GCS function table (normal path) or
+    # an inline pickled callable (actor creation ships the class inline).
+    function_key: Optional[bytes] = None
+    # Serialized positional args: list of ("v", frame_bytes) | ("r", id, owner_addr).
+    args: List = field(default_factory=list)
+    # Serialized kwargs: {name: same entry form}.
+    kwargs: Dict[str, Any] = field(default_factory=dict)
+    num_returns: int = 1
+    resources: Dict[str, float] = field(default_factory=dict)
+    owner_addr: str = ""
+    owner_worker_id: bytes = b""
+    # Actor fields.
+    actor_id: Optional[bytes] = None
+    method_name: Optional[str] = None
+    seq_no: int = 0
+    max_restarts: int = 0
+    max_concurrency: int = 1
+    # Scheduling.
+    strategy: str = STRATEGY_DEFAULT
+    node_id: Optional[bytes] = None  # NODE_AFFINITY target
+    soft: bool = False
+    placement_group_id: Optional[bytes] = None
+    bundle_index: int = -1
+    max_retries: int = 0
+    runtime_env: Optional[dict] = None
+    # Detached actors outlive their creator job.
+    detached: bool = False
+    actor_name: Optional[str] = None
+
+    def to_wire(self) -> dict:
+        return {
+            "task_id": self.task_id,
+            "job_id": self.job_id,
+            "name": self.name,
+            "task_type": self.task_type,
+            "function_key": self.function_key,
+            "args": self.args,
+            "kwargs": self.kwargs,
+            "num_returns": self.num_returns,
+            "resources": self.resources,
+            "owner_addr": self.owner_addr,
+            "owner_worker_id": self.owner_worker_id,
+            "actor_id": self.actor_id,
+            "method_name": self.method_name,
+            "seq_no": self.seq_no,
+            "max_restarts": self.max_restarts,
+            "max_concurrency": self.max_concurrency,
+            "strategy": self.strategy,
+            "node_id": self.node_id,
+            "soft": self.soft,
+            "placement_group_id": self.placement_group_id,
+            "bundle_index": self.bundle_index,
+            "max_retries": self.max_retries,
+            "runtime_env": self.runtime_env,
+            "detached": self.detached,
+            "actor_name": self.actor_name,
+        }
+
+    @classmethod
+    def from_wire(cls, wire: dict) -> "TaskSpec":
+        # msgpack round-trips lists as lists; args entries arrive as lists.
+        return cls(**wire)
+
+    def plasma_deps(self) -> List[tuple[bytes, str]]:
+        """(object_id, owner_addr) for every by-reference arg."""
+        deps = []
+        for entry in self.args:
+            if entry[0] == "r":
+                deps.append((entry[1], entry[2]))
+        for entry in self.kwargs.values():
+            if entry[0] == "r":
+                deps.append((entry[1], entry[2]))
+        return deps
+
+    def scheduling_key(self) -> tuple:
+        """Tasks with the same key can reuse a cached worker lease
+        (reference: SchedulingKey in direct_task_transport.h)."""
+        return (
+            self.function_key,
+            tuple(sorted(self.resources.items())),
+            self.strategy,
+            self.node_id,
+            self.placement_group_id,
+            self.bundle_index,
+        )

@@ -1,0 +1,535 @@
+"""Driver-side runtime: init/shutdown/remote/get/put/wait + actor frontends.
+
+Reference: `python/ray/_private/worker.py` (init/connect/get/put/wait),
+`python/ray/remote_function.py` (RemoteFunction), `python/ray/actor.py`
+(ActorClass/ActorHandle/ActorMethod).
+"""
+
+from __future__ import annotations
+
+import atexit
+import functools
+import inspect
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+from ray_tpu._private import task as task_mod
+from ray_tpu._private.config import Config, global_config
+from ray_tpu._private.core_worker import (
+    ActorDiedError,
+    CoreWorker,
+    GetTimeoutError,
+    RayTaskError,
+)
+from ray_tpu._private.ids import ActorID, JobID, ObjectID, PlacementGroupID
+from ray_tpu._private.node import Cluster
+from ray_tpu._private.object_ref import ObjectRef, get_core_worker
+from ray_tpu._private.object_store import ObjectStore
+
+_global_lock = threading.Lock()
+_global_state: Optional["GlobalState"] = None
+
+
+class GlobalState:
+    def __init__(self, cluster: Cluster | None, core_worker: CoreWorker,
+                 owns_cluster: bool):
+        self.cluster = cluster
+        self.core_worker = core_worker
+        self.owns_cluster = owns_cluster
+
+
+def is_initialized() -> bool:
+    return _global_state is not None
+
+
+def _require_state() -> GlobalState:
+    # Inside a worker process there is a process-global CoreWorker but no
+    # GlobalState; fall back to it so tasks can call the public API.
+    if _global_state is None:
+        cw = get_core_worker()
+        if cw is not None:
+            return GlobalState(None, cw, owns_cluster=False)
+        raise RuntimeError("ray_tpu.init() has not been called")
+    return _global_state
+
+
+def init(
+    address: str | None = None,
+    num_cpus: int | None = None,
+    num_tpus: int | None = None,
+    resources: Dict[str, float] | None = None,
+    object_store_memory: int | None = None,
+    _system_config: dict | None = None,
+    ignore_reinit_error: bool = False,
+):
+    """Start (or connect to) a ray_tpu cluster and attach this driver."""
+    global _global_state
+    with _global_lock:
+        if _global_state is not None:
+            if ignore_reinit_error:
+                return _global_state
+            raise RuntimeError("ray_tpu.init() already called")
+        cfg = global_config()
+        if _system_config:
+            cfg.update(_system_config)
+
+        if address is None:
+            node_resources = dict(resources or {})
+            import os as _os
+            node_resources.setdefault("CPU", float(num_cpus if num_cpus is not None
+                                                   else (_os.cpu_count() or 1)))
+            if num_tpus is not None:
+                node_resources["TPU"] = float(num_tpus)
+            else:
+                node_resources.setdefault("TPU", float(_detect_tpu_chips()))
+            cluster = Cluster(
+                head_resources=node_resources,
+                object_store_memory=object_store_memory,
+            )
+            owns = True
+            gcs_addr = cluster.gcs_addr
+            head = cluster.head_node
+            raylet_addr = head.raylet_addr
+            store_name = head.store_name
+        else:
+            cluster = None
+            owns = False
+            gcs_addr = address
+            raylet_addr, store_name = _discover_local_raylet(address)
+
+        job_id = JobID.from_random()
+        store = ObjectStore.attach(store_name)
+        cw = CoreWorker(
+            mode="driver",
+            gcs_addr=gcs_addr,
+            raylet_addr=raylet_addr,
+            job_id=job_id,
+            store=store,
+            config=cfg,
+        )
+        cw.start()
+        cw._run_sync(cw.gcs.call("register_job", {
+            "job_id": job_id.binary(),
+            "driver_addr": cw.address,
+        }))
+        _global_state = GlobalState(cluster, cw, owns)
+        atexit.register(shutdown)
+        return _global_state
+
+
+def _detect_tpu_chips() -> int:
+    """TPU chip autodetection (reference:
+    python/ray/_private/accelerators/tpu.py:104-120 — /dev/accel* and vfio)."""
+    import glob
+    chips = len(glob.glob("/dev/accel*"))
+    if chips == 0:
+        chips = len(glob.glob("/dev/vfio/*")) - (
+            1 if glob.glob("/dev/vfio/vfio") else 0
+        )
+    return max(chips, 0)
+
+
+def _discover_local_raylet(gcs_addr: str):
+    import asyncio
+
+    from ray_tpu._private.rpc import RpcClient
+
+    async def query():
+        client = await RpcClient(gcs_addr).connect()
+        nodes = await client.call("get_nodes", {})
+        await client.close()
+        alive = [n for n in nodes if n["alive"]]
+        if not alive:
+            raise RuntimeError("no alive nodes in cluster")
+        import os as _os
+        hostname = _os.uname().nodename
+        for n in alive:
+            if n.get("hostname") == hostname:
+                return n
+        return alive[0]
+
+    node = asyncio.run(query())
+    # Ask the raylet for its store name.
+    async def info(addr):
+        client = await RpcClient(addr).connect()
+        reply = await client.call("node_info", {})
+        await client.close()
+        return reply
+
+    reply = asyncio.run(info(node["raylet_addr"]))
+    return node["raylet_addr"], reply["store_name"]
+
+
+def shutdown():
+    global _global_state
+    with _global_lock:
+        state = _global_state
+        if state is None:
+            return
+        _global_state = None
+        try:
+            state.core_worker._run_sync(
+                state.core_worker.gcs.call(
+                    "finish_job",
+                    {"job_id": state.core_worker.job_id.binary()},
+                ),
+                timeout=5,
+            )
+        except Exception:
+            pass
+        state.core_worker.shutdown()
+        if state.owns_cluster and state.cluster is not None:
+            state.cluster.shutdown()
+
+
+def put(value: Any) -> ObjectRef:
+    return _require_state().core_worker.put(value)
+
+
+def get(refs, timeout: float | None = None):
+    return _require_state().core_worker.get(refs, timeout)
+
+
+def wait(refs: Sequence[ObjectRef], *, num_returns: int = 1,
+         timeout: float | None = None):
+    return _require_state().core_worker.wait(refs, num_returns, timeout)
+
+
+def kill(actor: "ActorHandle", *, no_restart: bool = True):
+    _require_state().core_worker.kill_actor(actor._actor_id, no_restart)
+
+
+# ----------------------------------------------------------------------
+# @remote — tasks
+# ----------------------------------------------------------------------
+
+_OPTION_DEFAULTS = dict(
+    num_cpus=None,
+    num_tpus=None,
+    resources=None,
+    num_returns=1,
+    max_retries=None,
+    max_restarts=0,
+    max_concurrency=1,
+    name=None,
+    lifetime=None,
+    scheduling_strategy=None,
+    placement_group=None,
+    placement_group_bundle_index=-1,
+)
+
+
+def _resource_dict(opts: dict, default_cpu: float) -> Dict[str, float]:
+    resources = dict(opts.get("resources") or {})
+    num_cpus = opts.get("num_cpus")
+    num_tpus = opts.get("num_tpus")
+    resources["CPU"] = float(num_cpus) if num_cpus is not None else default_cpu
+    if num_tpus is not None:
+        resources["TPU"] = float(num_tpus)
+    return resources
+
+
+def _strategy_fields(opts: dict):
+    strategy = task_mod.STRATEGY_DEFAULT
+    node_id = None
+    soft = False
+    pg_id = None
+    bundle_index = opts.get("placement_group_bundle_index", -1)
+    ss = opts.get("scheduling_strategy")
+    if isinstance(ss, str) and ss == "SPREAD":
+        strategy = task_mod.STRATEGY_SPREAD
+    elif isinstance(ss, NodeAffinitySchedulingStrategy):
+        strategy = task_mod.STRATEGY_NODE_AFFINITY
+        node_id = bytes.fromhex(ss.node_id)
+        soft = ss.soft
+    elif isinstance(ss, PlacementGroupSchedulingStrategy):
+        strategy = task_mod.STRATEGY_PLACEMENT_GROUP
+        pg_id = ss.placement_group.id.binary()
+        bundle_index = ss.placement_group_bundle_index
+    pg = opts.get("placement_group")
+    if pg is not None:
+        strategy = task_mod.STRATEGY_PLACEMENT_GROUP
+        pg_id = pg.id.binary()
+    return strategy, node_id, soft, pg_id, bundle_index
+
+
+class RemoteFunction:
+    def __init__(self, fn, options: dict, function_key: bytes | None = None):
+        self._fn = fn
+        self._options = {**_OPTION_DEFAULTS, **options}
+        self._function_key = function_key
+        functools.update_wrapper(self, fn)
+
+    def options(self, **opts) -> "RemoteFunction":
+        return RemoteFunction(self._fn, {**self._options, **opts},
+                              self._function_key)
+
+    def _ensure_pushed(self, cw: CoreWorker) -> bytes:
+        # Benign race: two threads may push the same function; the GCS KV
+        # dedupes on the content hash (overwrite=False).
+        if self._function_key is None:
+            self._function_key = cw.push_function(self._fn)
+        return self._function_key
+
+    def __reduce__(self):
+        # Remote functions captured in closures of other tasks must travel;
+        # the function itself is cloudpickled by value (reference pickles
+        # RemoteFunction the same way).
+        return (RemoteFunction, (self._fn, self._options, self._function_key))
+
+    def remote(self, *args, **kwargs):
+        cw = _require_state().core_worker
+        key = self._ensure_pushed(cw)
+        opts = self._options
+        strategy, node_id, soft, pg_id, bundle_index = _strategy_fields(opts)
+        refs = cw.submit_task(
+            key, args, kwargs,
+            name=self._fn.__name__,
+            num_returns=opts["num_returns"],
+            resources=_resource_dict(opts, default_cpu=1.0),
+            max_retries=opts["max_retries"],
+            strategy=strategy,
+            node_id=node_id,
+            soft=soft,
+            placement_group_id=pg_id,
+            bundle_index=bundle_index,
+        )
+        if opts["num_returns"] == 1:
+            return refs[0]
+        return refs
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Remote function '{self._fn.__name__}' cannot be called directly; "
+            f"use .remote()."
+        )
+
+
+# ----------------------------------------------------------------------
+# @remote — actors
+# ----------------------------------------------------------------------
+
+
+class ActorMethod:
+    def __init__(self, handle: "ActorHandle", name: str, num_returns: int = 1):
+        self._handle = handle
+        self._name = name
+        self._num_returns = num_returns
+
+    def options(self, num_returns: int = 1) -> "ActorMethod":
+        return ActorMethod(self._handle, self._name, num_returns)
+
+    def remote(self, *args, **kwargs):
+        cw = _require_state().core_worker
+        refs = cw.submit_actor_task(
+            self._handle._actor_id, self._name, args, kwargs,
+            num_returns=self._num_returns,
+        )
+        if self._num_returns == 1:
+            return refs[0]
+        return refs
+
+
+class ActorHandle:
+    def __init__(self, actor_id: ActorID):
+        self._actor_id = actor_id
+
+    def __getattr__(self, name: str) -> ActorMethod:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return ActorMethod(self, name)
+
+    def __reduce__(self):
+        return (_reconstruct_handle, (self._actor_id.binary(),))
+
+    def __repr__(self):
+        return f"ActorHandle({self._actor_id.hex()[:16]})"
+
+
+def _reconstruct_handle(actor_id_bytes: bytes) -> ActorHandle:
+    return ActorHandle(ActorID(actor_id_bytes))
+
+
+class ActorClass:
+    def __init__(self, cls, options: dict, class_key: bytes | None = None):
+        self._cls = cls
+        self._options = {**_OPTION_DEFAULTS, **options}
+        self._class_key = class_key
+
+    def options(self, **opts) -> "ActorClass":
+        return ActorClass(self._cls, {**self._options, **opts}, self._class_key)
+
+    def __reduce__(self):
+        return (ActorClass, (self._cls, self._options, self._class_key))
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        cw = _require_state().core_worker
+        if self._class_key is None:
+            self._class_key = cw.push_function(self._cls)
+        opts = self._options
+        strategy, node_id, soft, pg_id, bundle_index = _strategy_fields(opts)
+        actor_id = cw.create_actor(
+            self._class_key, args, kwargs,
+            name=self._cls.__name__,
+            actor_name=opts["name"],
+            resources=_resource_dict(opts, default_cpu=1.0),
+            max_restarts=opts["max_restarts"],
+            max_concurrency=opts["max_concurrency"],
+            detached=(opts["lifetime"] == "detached"),
+            strategy=strategy,
+            node_id=node_id,
+            soft=soft,
+            placement_group_id=pg_id,
+            bundle_index=bundle_index,
+        )
+        return ActorHandle(actor_id)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Actor class '{self._cls.__name__}' cannot be instantiated "
+            f"directly; use .remote()."
+        )
+
+
+def remote(*args, **kwargs):
+    """`@remote` / `@remote(num_cpus=2, num_tpus=1, ...)` for functions and
+    classes (reference: python/ray/__init__.py `ray.remote`)."""
+    if len(args) == 1 and not kwargs and (
+        inspect.isfunction(args[0]) or inspect.isclass(args[0])
+    ):
+        target = args[0]
+        if inspect.isclass(target):
+            return ActorClass(target, {})
+        return RemoteFunction(target, {})
+
+    def wrap(target):
+        if inspect.isclass(target):
+            return ActorClass(target, kwargs)
+        return RemoteFunction(target, kwargs)
+
+    return wrap
+
+
+def get_actor(name: str) -> ActorHandle:
+    cw = _require_state().core_worker
+    reply = cw._run_sync(cw.gcs.call("get_actor", {"name": name}))
+    if not reply.get("found"):
+        raise ValueError(f"no actor named {name!r}")
+    return ActorHandle(ActorID(reply["actor_id"]))
+
+
+# ----------------------------------------------------------------------
+# scheduling strategies + placement groups
+# ----------------------------------------------------------------------
+
+
+class NodeAffinitySchedulingStrategy:
+    def __init__(self, node_id: str, soft: bool = False):
+        self.node_id = node_id
+        self.soft = soft
+
+
+class PlacementGroupSchedulingStrategy:
+    def __init__(self, placement_group: "PlacementGroup",
+                 placement_group_bundle_index: int = -1,
+                 placement_group_capture_child_tasks: bool = False):
+        self.placement_group = placement_group
+        self.placement_group_bundle_index = placement_group_bundle_index
+
+
+class PlacementGroup:
+    def __init__(self, pg_id: PlacementGroupID, bundles: List[Dict[str, float]]):
+        self.id = pg_id
+        self.bundles = bundles
+
+    def ready(self, timeout: float = 60.0) -> bool:
+        cw = _require_state().core_worker
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            reply = cw._run_sync(cw.gcs.call(
+                "get_placement_group", {"pg_id": self.id.binary()}
+            ))
+            if reply.get("found") and reply["state"] == "CREATED":
+                return True
+            if reply.get("found") and reply["state"] == "REMOVED":
+                return False
+            time.sleep(0.05)
+        return False
+
+    @property
+    def bundle_specs(self) -> List[Dict[str, float]]:
+        return self.bundles
+
+
+def placement_group(bundles: List[Dict[str, float]], strategy: str = "PACK",
+                    name: str | None = None) -> PlacementGroup:
+    cw = _require_state().core_worker
+    pg_id = PlacementGroupID.from_random()
+    cw._run_sync(cw.gcs.call("create_placement_group", {
+        "pg_id": pg_id.binary(),
+        "bundles": bundles,
+        "strategy": strategy,
+        "name": name,
+        "job_id": cw.job_id.binary(),
+    }))
+    return PlacementGroup(pg_id, bundles)
+
+
+def remove_placement_group(pg: PlacementGroup):
+    cw = _require_state().core_worker
+    cw._run_sync(cw.gcs.call("remove_placement_group",
+                             {"pg_id": pg.id.binary()}))
+
+
+# ----------------------------------------------------------------------
+# cluster introspection (reference: ray.nodes / cluster_resources)
+# ----------------------------------------------------------------------
+
+
+def nodes() -> List[dict]:
+    cw = _require_state().core_worker
+    raw = cw._run_sync(cw.gcs.call("get_nodes", {}))
+    return [
+        {
+            "NodeID": n["node_id"].hex(),
+            "Alive": n["alive"],
+            "RayletAddr": n["raylet_addr"],
+            "Resources": n["total"],
+            "Available": n["available"],
+        }
+        for n in raw
+    ]
+
+
+def cluster_resources() -> Dict[str, float]:
+    totals: Dict[str, float] = {}
+    for n in nodes():
+        if n["Alive"]:
+            for k, v in n["Resources"].items():
+                totals[k] = totals.get(k, 0.0) + v
+    return totals
+
+
+def available_resources() -> Dict[str, float]:
+    totals: Dict[str, float] = {}
+    for n in nodes():
+        if n["Alive"]:
+            for k, v in n["Available"].items():
+                totals[k] = totals.get(k, 0.0) + v
+    return totals
+
+
+def list_actors() -> List[dict]:
+    cw = _require_state().core_worker
+    raw = cw._run_sync(cw.gcs.call("list_actors", {}))
+    return [
+        {
+            "actor_id": a["actor_id"].hex(),
+            "state": a["state"],
+            "name": a["name"],
+            "class_name": a.get("class_name"),
+            "num_restarts": a["num_restarts"],
+        }
+        for a in raw
+    ]

@@ -1,0 +1,86 @@
+"""Worker process entrypoint.
+
+Reference: `python/ray/_private/workers/default_worker.py` — spawned by the
+raylet's WorkerPool; connects a CoreWorker to its raylet + GCS, registers,
+then blocks in the task-execution loop.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import os
+import sys
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--raylet-addr", required=True)
+    parser.add_argument("--gcs-addr", required=True)
+    parser.add_argument("--store-name", required=True)
+    parser.add_argument("--node-id", required=True)
+    parser.add_argument("--job-id", required=True)
+    parser.add_argument("--tpu-chips", default="")
+    args = parser.parse_args()
+
+    logging.basicConfig(
+        level=logging.INFO,
+        format=f"[worker {os.getpid()}] %(levelname)s %(name)s: %(message)s",
+    )
+
+    from ray_tpu._private.core_worker import CoreWorker
+    from ray_tpu._private.ids import JobID
+    from ray_tpu._private.object_store import ObjectStore
+
+    chips = tuple(int(c) for c in args.tpu_chips.split(",") if c != "")
+    store = ObjectStore.attach(args.store_name)
+    cw = CoreWorker(
+        mode="worker",
+        gcs_addr=args.gcs_addr,
+        raylet_addr=args.raylet_addr,
+        job_id=JobID.from_hex(args.job_id),
+        store=store,
+        node_id_hex=args.node_id,
+        tpu_chips=chips,
+    )
+    cw.start()
+
+    async def register():
+        raylet = await cw._clients.get(args.raylet_addr)
+        await raylet.call("register_worker", {
+            "worker_id": cw.worker_id.binary(),
+            "addr": cw.address,
+            "pid": os.getpid(),
+            "job_id": cw.job_id.binary(),
+            "tpu_chips": list(chips),
+        })
+
+    cw._run_sync(register())
+
+    async def raylet_watchdog():
+        # Exit if the raylet disappears (reference: workers die with their
+        # raylet via the unix-socket connection; here we poll).
+        from ray_tpu._private.rpc import ConnectionLost, RpcError
+
+        while True:
+            await asyncio.sleep(2.0)
+            try:
+                raylet = await cw._clients.get(args.raylet_addr)
+                await raylet.call("node_info", {}, timeout=5.0)
+            except (ConnectionLost, RpcError, OSError, asyncio.TimeoutError):
+                logging.warning("raylet unreachable; worker exiting")
+                os._exit(1)
+
+    asyncio.run_coroutine_threadsafe(raylet_watchdog(), cw._loop)
+    try:
+        cw.run_task_loop()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        cw.shutdown()
+        sys.exit(0)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,8 @@
+"""Model zoo, TPU-first: bfloat16 by default, logical-axis-annotated
+parameters (DP/FSDP/TP/SP shardings applied by the trainer), remat-friendly
+blocks, pluggable attention (dense / ring / Ulysses)."""
+
+from ray_tpu.models.gpt import GPT, GPTConfig
+from ray_tpu.models.resnet import ResNet, ResNetConfig
+
+__all__ = ["GPT", "GPTConfig", "ResNet", "ResNetConfig"]

@@ -1,0 +1,191 @@
+"""GPT-2-family decoder-only transformer, TPU-first.
+
+The north-star model (BASELINE.json: "JaxTrainer GPT-2-125M data-parallel").
+Design notes:
+- bfloat16 activations/params-compute, float32 master params via optimizer.
+- Every parameter is annotated with logical axes (`nn.with_partitioning`),
+  so DP/FSDP/TP shardings are a rules change, not a model change
+  (ray_tpu/parallel/sharding.py maps them onto the mesh).
+- Attention is pluggable: dense (XLA fuses to MXU-friendly blocks), ring
+  (sequence sharded over `sp`, KV blocks rotating over ICI), or Ulysses.
+- `remat` wraps each block so long-sequence training trades FLOPs for HBM.
+- No data-dependent Python control flow: one jit-traced program.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.parallel.ring_attention import full_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTConfig:
+    vocab_size: int = 50304  # GPT-2 vocab padded to a multiple of 128 (MXU)
+    n_layer: int = 12
+    n_head: int = 12
+    d_model: int = 768
+    max_seq_len: int = 1024
+    dropout: float = 0.0
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    remat: bool = True
+
+    @classmethod
+    def gpt2_125m(cls, **kw):
+        return cls(n_layer=12, n_head=12, d_model=768, **kw)
+
+    @classmethod
+    def gpt2_350m(cls, **kw):
+        return cls(n_layer=24, n_head=16, d_model=1024, **kw)
+
+    @classmethod
+    def tiny(cls, **kw):
+        kw.setdefault("vocab_size", 512)
+        kw.setdefault("max_seq_len", 128)
+        return cls(n_layer=2, n_head=2, d_model=64, **kw)
+
+
+def _dense(features, logical_axes, name, config, use_bias=True):
+    return nn.Dense(
+        features,
+        use_bias=use_bias,
+        dtype=config.dtype,
+        param_dtype=config.param_dtype,
+        kernel_init=nn.with_partitioning(
+            nn.initializers.normal(stddev=0.02), logical_axes
+        ),
+        bias_init=nn.with_partitioning(
+            nn.initializers.zeros, (logical_axes[-1],)
+        ),
+        name=name,
+    )
+
+
+class Block(nn.Module):
+    """Pre-LN transformer block."""
+
+    config: GPTConfig
+    attention_fn: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):
+        cfg = self.config
+        head_dim = cfg.d_model // cfg.n_head
+
+        h = nn.LayerNorm(dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                         scale_init=nn.with_partitioning(
+                             nn.initializers.ones, ("norm",)),
+                         bias_init=nn.with_partitioning(
+                             nn.initializers.zeros, ("norm",)),
+                         name="ln_1")(x)
+        qkv = _dense(3 * cfg.d_model, ("embed", "qkv"), "attn_qkv", cfg)(h)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        b, t = q.shape[0], q.shape[1]
+        q = q.reshape(b, t, cfg.n_head, head_dim)
+        k = k.reshape(b, t, cfg.n_head, head_dim)
+        v = v.reshape(b, t, cfg.n_head, head_dim)
+        q = nn.with_logical_constraint(q, ("batch", "seq", "heads", None))
+        k = nn.with_logical_constraint(k, ("batch", "seq", "heads", None))
+        v = nn.with_logical_constraint(v, ("batch", "seq", "heads", None))
+        attend = self.attention_fn or partial(full_attention, causal=True)
+        att = attend(q, k, v).reshape(b, t, cfg.d_model)
+        att = _dense(cfg.d_model, ("heads", "embed"), "attn_out", cfg)(att)
+        x = x + att
+
+        h = nn.LayerNorm(dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                         scale_init=nn.with_partitioning(
+                             nn.initializers.ones, ("norm",)),
+                         bias_init=nn.with_partitioning(
+                             nn.initializers.zeros, ("norm",)),
+                         name="ln_2")(x)
+        h = _dense(4 * cfg.d_model, ("embed", "mlp"), "mlp_up", cfg)(h)
+        h = nn.gelu(h)
+        h = _dense(cfg.d_model, ("mlp", "embed"), "mlp_down", cfg)(h)
+        if cfg.dropout > 0:
+            h = nn.Dropout(cfg.dropout)(h, deterministic=deterministic)
+        x = x + h
+        return nn.with_logical_constraint(x, ("batch", "seq", "embed"))
+
+
+class GPT(nn.Module):
+    """Decoder-only LM. `attention_fn` lets the trainer swap in ring/Ulysses
+    attention bound to its mesh for sequence parallelism."""
+
+    config: GPTConfig
+    attention_fn: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, tokens, deterministic: bool = True):
+        cfg = self.config
+        b, t = tokens.shape
+        wte = self.param(
+            "wte",
+            nn.with_partitioning(nn.initializers.normal(0.02),
+                                 ("vocab", "embed")),
+            (cfg.vocab_size, cfg.d_model),
+            cfg.param_dtype,
+        )
+        wpe = self.param(
+            "wpe",
+            nn.with_partitioning(nn.initializers.normal(0.01),
+                                 (None, "embed")),
+            (cfg.max_seq_len, cfg.d_model),
+            cfg.param_dtype,
+        )
+        x = wte.astype(cfg.dtype)[tokens] + wpe.astype(cfg.dtype)[None, :t]
+        x = nn.with_logical_constraint(x, ("batch", "seq", "embed"))
+
+        block = Block
+        if cfg.remat:
+            block = nn.remat(
+                Block,
+                prevent_cse=False,
+                static_argnums=(1,),
+            )
+        for i in range(cfg.n_layer):
+            x = block(cfg, self.attention_fn, name=f"h{i}")(x, deterministic)
+
+        x = nn.LayerNorm(dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                         scale_init=nn.with_partitioning(
+                             nn.initializers.ones, ("norm",)),
+                         bias_init=nn.with_partitioning(
+                             nn.initializers.zeros, ("norm",)),
+                         name="ln_f")(x)
+        # Tied LM head: logits = x @ wte^T (the vocab axis shards over tp).
+        logits = jnp.einsum("btd,vd->btv", x, wte.astype(cfg.dtype))
+        return logits
+
+
+def cross_entropy_loss(logits, targets, ignore_index: int = -1):
+    """Mean token NLL in float32 (stable softmax on bf16 logits)."""
+    logits = logits.astype(jnp.float32)
+    mask = (targets != ignore_index).astype(jnp.float32)
+    targets = jnp.maximum(targets, 0)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return (nll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+
+
+def count_params(params) -> int:
+    return sum(int(np.prod(p.shape))
+               for p in jax.tree_util.tree_leaves(params))
+
+
+def flops_per_token(cfg: GPTConfig, seq_len: int | None = None) -> float:
+    """Approximate training FLOPs per token (6N + attention term)."""
+    t = seq_len or cfg.max_seq_len
+    n_params = (
+        cfg.vocab_size * cfg.d_model
+        + cfg.max_seq_len * cfg.d_model
+        + cfg.n_layer * (12 * cfg.d_model**2 + 13 * cfg.d_model)
+        + 2 * cfg.d_model
+    )
+    return 6.0 * n_params + 12.0 * cfg.n_layer * cfg.d_model * t

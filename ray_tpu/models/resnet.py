@@ -1,0 +1,110 @@
+"""ResNet-50 family, TPU-first (north-star config #1: ResNet-50 CIFAR-10).
+
+Convs are NHWC (TPU-native layout); batch norm in float32; parameters carry
+logical axes so FSDP shards the big conv kernels over `fsdp` while DP
+replicates. Reference parity target: the TorchTrainer ResNet harness
+(`release/air_tests/air_benchmarks/mlperf-train`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class ResNetConfig:
+    stage_sizes: Sequence[int] = (3, 4, 6, 3)  # ResNet-50
+    num_classes: int = 1000
+    width: int = 64
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    small_images: bool = False  # CIFAR stem (3x3, no max-pool)
+
+    @classmethod
+    def resnet18(cls, **kw):
+        return cls(stage_sizes=(2, 2, 2, 2), **kw)
+
+    @classmethod
+    def resnet50(cls, **kw):
+        return cls(stage_sizes=(3, 4, 6, 3), **kw)
+
+
+def _conv(features, kernel, strides, name, cfg):
+    return nn.Conv(
+        features, kernel, strides, padding="SAME", use_bias=False,
+        dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+        kernel_init=nn.with_partitioning(
+            nn.initializers.variance_scaling(2.0, "fan_out", "normal"),
+            (None, None, None, "embed"),
+        ),
+        name=name,
+    )
+
+
+def _bn(cfg, name):
+    return nn.BatchNorm(
+        use_running_average=None, momentum=0.9, epsilon=1e-5,
+        dtype=jnp.float32, param_dtype=cfg.param_dtype,
+        scale_init=nn.with_partitioning(nn.initializers.ones, ("norm",)),
+        bias_init=nn.with_partitioning(nn.initializers.zeros, ("norm",)),
+        name=name,
+    )
+
+
+class Bottleneck(nn.Module):
+    cfg: ResNetConfig
+    features: int
+    strides: int = 1
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        residual = x
+        y = _conv(self.features, (1, 1), 1, "conv1", self.cfg)(x)
+        y = _bn(self.cfg, "bn1")(y, use_running_average=not train)
+        y = nn.relu(y)
+        y = _conv(self.features, (3, 3), self.strides, "conv2", self.cfg)(y)
+        y = _bn(self.cfg, "bn2")(y, use_running_average=not train)
+        y = nn.relu(y)
+        y = _conv(4 * self.features, (1, 1), 1, "conv3", self.cfg)(y)
+        y = _bn(self.cfg, "bn3")(y, use_running_average=not train)
+        if residual.shape != y.shape:
+            residual = _conv(4 * self.features, (1, 1), self.strides,
+                             "conv_proj", self.cfg)(residual)
+            residual = _bn(self.cfg, "bn_proj")(
+                residual, use_running_average=not train)
+        return nn.relu(residual + y)
+
+
+class ResNet(nn.Module):
+    config: ResNetConfig
+
+    @nn.compact
+    def __call__(self, images, train: bool = True):
+        cfg = self.config
+        x = images.astype(cfg.dtype)
+        if cfg.small_images:
+            x = _conv(cfg.width, (3, 3), 1, "stem", cfg)(x)
+        else:
+            x = _conv(cfg.width, (7, 7), 2, "stem", cfg)(x)
+        x = _bn(cfg, "stem_bn")(x, use_running_average=not train)
+        x = nn.relu(x)
+        if not cfg.small_images:
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for stage, n_blocks in enumerate(cfg.stage_sizes):
+            for block in range(n_blocks):
+                strides = 2 if stage > 0 and block == 0 else 1
+                x = Bottleneck(cfg, cfg.width * 2**stage, strides,
+                               name=f"stage{stage}_block{block}")(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(
+            cfg.num_classes, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            kernel_init=nn.with_partitioning(
+                nn.initializers.normal(0.01), ("embed", "vocab")),
+            bias_init=nn.with_partitioning(nn.initializers.zeros, ("vocab",)),
+            name="head",
+        )(x)
+        return x.astype(jnp.float32)

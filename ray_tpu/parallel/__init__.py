@@ -1,0 +1,28 @@
+"""TPU-native parallelism: meshes, sharding rules, collectives, ring
+attention, pipeline and expert parallelism.
+
+This package is what replaces the reference's delegated parallelism story
+(NCCL process groups via `python/ray/util/collective/` and
+`torch.distributed` bootstrap in `python/ray/train/torch/config.py`): every
+strategy — DP / FSDP / TP / SP-CP / ring attention / PP / EP — is provided
+natively on `jax.sharding.Mesh` + GSPMD + `shard_map`, with XLA collectives
+riding ICI inside a slice and DCN across slices.
+"""
+
+from ray_tpu.parallel.mesh import MeshConfig, build_mesh, mesh_shape_for
+from ray_tpu.parallel.sharding import (
+    ShardingStrategy,
+    logical_axis_rules,
+    shard_batch,
+    sharding_constraint,
+)
+
+__all__ = [
+    "MeshConfig",
+    "ShardingStrategy",
+    "build_mesh",
+    "logical_axis_rules",
+    "mesh_shape_for",
+    "shard_batch",
+    "sharding_constraint",
+]

@@ -1,0 +1,94 @@
+"""Multi-host JAX bootstrap over the ray_tpu control plane.
+
+Replaces the reference's `torch.distributed` rendezvous
+(`python/ray/train/torch/config.py:65` — rank-0 address broadcast then
+`dist.init_process_group`): here the GCS KV is the rendezvous store and
+`jax.distributed.initialize` forms the slice, after which every collective
+rides ICI/DCN via XLA — no NCCL anywhere.
+
+Each train worker (actor) is one JAX process owning its host's chips
+(multi-controller model); the driver never touches TPUs.
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+_NAMESPACE = "jax_coordination"
+
+
+@dataclass
+class JaxDistributedConfig:
+    group_name: str
+    world_size: int
+    rank: int
+    coordinator_port: int = 0  # 0: pick a free port on rank 0
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _kv_put(key: str, value: bytes):
+    from ray_tpu._private.object_ref import get_core_worker
+
+    cw = get_core_worker()
+    cw._run_sync(cw.gcs.call("kv_put", {
+        "ns": _NAMESPACE, "key": key.encode(), "value": value,
+    }))
+
+
+def _kv_get(key: str, timeout: float = 120.0) -> Optional[bytes]:
+    from ray_tpu._private.object_ref import get_core_worker
+
+    cw = get_core_worker()
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        reply = cw._run_sync(cw.gcs.call("kv_get", {
+            "ns": _NAMESPACE, "key": key.encode(),
+        }))
+        if reply["value"] is not None:
+            return reply["value"]
+        time.sleep(0.1)
+    return None
+
+
+def initialize_jax_distributed(cfg: JaxDistributedConfig) -> None:
+    """Rendezvous via GCS KV, then `jax.distributed.initialize`.
+
+    Single-process groups skip jax.distributed entirely (all chips are
+    already visible locally)."""
+    if cfg.world_size <= 1:
+        return
+    key = f"coordinator:{cfg.group_name}"
+    if cfg.rank == 0:
+        port = cfg.coordinator_port or _free_port()
+        addr = f"{socket.gethostbyname(socket.gethostname())}:{port}"
+        _kv_put(key, addr.encode())
+    else:
+        raw = _kv_get(key)
+        if raw is None:
+            raise RuntimeError(
+                f"jax.distributed rendezvous timed out for {cfg.group_name}"
+            )
+        addr = raw.decode()
+
+    import jax
+
+    logger.info("jax.distributed.initialize(%s, %d, %d)", addr,
+                cfg.world_size, cfg.rank)
+    jax.distributed.initialize(
+        coordinator_address=addr,
+        num_processes=cfg.world_size,
+        process_id=cfg.rank,
+    )

@@ -1,0 +1,137 @@
+"""Expert parallelism: sharded mixture-of-experts dispatch.
+
+Absent from the reference (SURVEY.md §2.6). Experts are sharded over the
+`ep` mesh axis; tokens are routed top-k, dispatched to expert shards with an
+`all_to_all` inside `shard_map`, processed, and combined back weighted by the
+router probabilities. Capacity-factor truncation keeps shapes static for XLA.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def top1_routing(router_logits: jax.Array, num_experts: int,
+                 capacity: int):
+    """Top-1 routing with static capacity. Returns (dispatch [T, E, C]
+    one-hot, combine [T, E, C] weights, aux_loss)."""
+    probs = jax.nn.softmax(router_logits, axis=-1)  # [T, E]
+    expert = jnp.argmax(probs, axis=-1)  # [T]
+    gate = jnp.take_along_axis(probs, expert[:, None], axis=-1)[:, 0]
+
+    # Position of each token within its expert's capacity buffer.
+    onehot = jax.nn.one_hot(expert, num_experts, dtype=jnp.int32)  # [T, E]
+    position = jnp.cumsum(onehot, axis=0) * onehot - 1  # [T, E]
+    in_capacity = (position < capacity) & (position >= 0)
+    pos_clipped = jnp.clip(position, 0, capacity - 1)
+    dispatch = (
+        jax.nn.one_hot(pos_clipped, capacity, dtype=jnp.float32)
+        * in_capacity[..., None]
+    )  # [T, E, C]
+    combine = dispatch * gate[:, None, None]
+
+    # Load-balancing auxiliary loss (Switch Transformer).
+    density = onehot.mean(axis=0)
+    density_proxy = probs.mean(axis=0)
+    aux_loss = jnp.sum(density * density_proxy) * num_experts
+    return dispatch, combine, aux_loss
+
+
+def moe_layer(
+    x: jax.Array,              # [tokens, d_model] (shard-local)
+    router_w: jax.Array,       # [d_model, num_experts] (replicated)
+    expert_params,             # pytree with leading [experts_local, ...]
+    expert_fn: Callable,       # (params_e, tokens[C, d]) -> [C, d]
+    *,
+    axis_name: str = "ep",
+    capacity_factor: float = 1.25,
+):
+    """Shard-local MoE body — call inside shard_map with experts sharded
+    over `axis_name` and tokens sharded over the data axes."""
+    n_shards = lax.axis_size(axis_name)
+    tokens, d_model = x.shape
+    experts_local = jax.tree_util.tree_leaves(expert_params)[0].shape[0]
+    num_experts = experts_local * n_shards
+    capacity = max(1, int(capacity_factor * tokens / num_experts))
+
+    logits = x @ router_w
+    dispatch, combine, aux = top1_routing(logits, num_experts, capacity)
+
+    # Dispatch: [E, C, d]; shard j hosts experts [j*E_local, (j+1)*E_local).
+    # all_to_all(tiled=False) removes the size-n split axis and stacks the
+    # n received pieces at concat_axis.
+    expert_in = jnp.einsum("tec,td->ecd", dispatch, x)  # [E, C, d]
+    expert_in = expert_in.reshape(n_shards, experts_local, capacity, d_model)
+    expert_in = lax.all_to_all(expert_in, axis_name, split_axis=0,
+                               concat_axis=2, tiled=False)
+    # [E_local, C, n_src, d] -> [E_local, n_src * C, d]
+    expert_in = expert_in.transpose(0, 2, 1, 3).reshape(
+        experts_local, n_shards * capacity, d_model
+    )
+
+    expert_out = jax.vmap(expert_fn)(expert_params, expert_in)
+
+    # Route back: the exact inverse layout walk.
+    expert_out = expert_out.reshape(experts_local, n_shards, capacity, d_model)
+    expert_out = expert_out.transpose(0, 2, 1, 3)  # [E_local, C, n_src, d]
+    expert_out = lax.all_to_all(expert_out, axis_name, split_axis=2,
+                                concat_axis=0, tiled=False)
+    # [n_host, E_local, C, d] -> [E, C, d] on every shard's own token set.
+    expert_out = expert_out.reshape(num_experts, capacity, d_model)
+    y = jnp.einsum("tec,ecd->td", combine, expert_out)
+    return y.astype(x.dtype), aux
+
+
+def apply_moe(
+    x: jax.Array,              # [batch, seq, d_model] global
+    router_w: jax.Array,
+    expert_params,             # [num_experts, ...] pytree, sharded over ep
+    expert_fn: Callable,
+    mesh: Mesh,
+    *,
+    axis_name: str = "ep",
+    batch_axes=("dp", "fsdp"),
+    capacity_factor: float = 1.25,
+):
+    if axis_name not in mesh.axis_names or mesh.shape[axis_name] == 1:
+        # Single shard: dense dispatch without collectives.
+        b, s, d = x.shape
+        flat = x.reshape(b * s, d)
+        num_experts = jax.tree_util.tree_leaves(expert_params)[0].shape[0]
+        capacity = max(1, int(capacity_factor * flat.shape[0] / num_experts))
+        logits = flat @ router_w
+        dispatch, combine, aux = top1_routing(logits, num_experts, capacity)
+        expert_in = jnp.einsum("tec,td->ecd", dispatch, flat)
+        expert_out = jax.vmap(expert_fn)(expert_params, expert_in)
+        y = jnp.einsum("tec,ecd->td", combine, expert_out)
+        return y.reshape(b, s, d).astype(x.dtype), aux
+
+    batch = tuple(a for a in batch_axes if a in mesh.axis_names)
+    bspec = batch if len(batch) > 1 else (batch[0] if batch else None)
+    xspec = P(bspec, None, None)
+    pspec = jax.tree_util.tree_map(
+        lambda p: P(axis_name, *([None] * (p.ndim - 1))), expert_params
+    )
+
+    def local(x, router_w, expert_params):
+        b, s, d = x.shape
+        y, aux = moe_layer(
+            x.reshape(b * s, d), router_w, expert_params, expert_fn,
+            axis_name=axis_name, capacity_factor=capacity_factor,
+        )
+        return y.reshape(b, s, d), lax.pmean(aux, axis_name)
+
+    fn = shard_map(
+        local, mesh=mesh,
+        in_specs=(xspec, P(None, None), pspec),
+        out_specs=(xspec, P()),
+        check_vma=False,
+    )
+    return fn(x, router_w, expert_params)

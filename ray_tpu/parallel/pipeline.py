@@ -1,0 +1,106 @@
+"""Pipeline parallelism over a `pp` mesh axis (collective-permute pipeline).
+
+Absent from the reference (SURVEY.md §2.6). Design: layers are stacked into
+a [num_stages, ...] parameter tree sharded over `pp`; microbatches stream
+through the stages inside one jit program, with `lax.ppermute` rotating
+activations stage-to-stage over ICI (GPipe schedule, bubble =
+(stages-1)/(microbatches+stages-1)). Because the whole schedule is one XLA
+program, forward+backward of the pipeline differentiates with plain
+`jax.grad` — no per-stage runtime coordination is needed.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def pipeline_apply(
+    stage_fn: Callable,
+    stage_params,
+    x,
+    mesh: Mesh,
+    *,
+    num_microbatches: int,
+    axis_name: str = "pp",
+    batch_axes=("dp", "fsdp"),
+):
+    """Run `stage_fn(params_i, activations)` through all pipeline stages.
+
+    stage_params: pytree with leading [num_stages, ...] axis, sharded over
+        `axis_name` (each device holds its stage's slice).
+    x: [batch, ...] global input; the batch is split into microbatches.
+    Returns the final stage's output for every microbatch, re-assembled to
+    [batch, ...].
+
+    Stage i computes microbatch m at step i+m; activations hop i -> i+1 via
+    ppermute each step. Total steps = num_microbatches + num_stages - 1.
+    """
+    n_stages = mesh.shape[axis_name]
+    batch = tuple(a for a in batch_axes if a in mesh.axis_names)
+    bspec = batch if len(batch) > 1 else (batch[0] if batch else None)
+    xspec = P(bspec, *([None] * (x.ndim - 1)))
+    pspec_leaf = lambda leaf: P(axis_name, *([None] * (leaf.ndim - 1)))  # noqa: E731
+    param_specs = jax.tree_util.tree_map(pspec_leaf, stage_params)
+
+    def local(params, xb):
+        # params: stage-local (leading axis length 1) -> squeeze.
+        params = jax.tree_util.tree_map(lambda p: p[0], params)
+        stage = lax.axis_index(axis_name)
+        mb = xb.reshape(num_microbatches, xb.shape[0] // num_microbatches,
+                        *xb.shape[1:])
+        state = jnp.zeros_like(mb[0])
+        outputs = jnp.zeros_like(mb)
+        perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+        def step(t, carry):
+            state, outputs = carry
+            # First stage ingests microbatch t (when in range).
+            feed_idx = jnp.clip(t, 0, num_microbatches - 1)
+            state = jnp.where(stage == 0, mb[feed_idx], state)
+            out = stage_fn(params, state)
+            # Last stage retires microbatch t - (n_stages - 1).
+            out_idx = t - (n_stages - 1)
+            write = jnp.logical_and(stage == n_stages - 1, out_idx >= 0)
+            outputs = lax.cond(
+                write,
+                lambda o: o.at[jnp.clip(out_idx, 0, num_microbatches - 1)]
+                           .set(out),
+                lambda o: o,
+                outputs,
+            )
+            state = lax.ppermute(out, axis_name, perm)
+            return state, outputs
+
+        _, outputs = lax.fori_loop(
+            0, num_microbatches + n_stages - 1, step, (state, outputs)
+        )
+        # Only the last stage holds real outputs; broadcast them around the
+        # ring so every stage returns identical values (keeps out_specs
+        # replicated over pp).
+        outputs = lax.psum(
+            jnp.where(stage == n_stages - 1, outputs, jnp.zeros_like(outputs)),
+            axis_name,
+        )
+        return outputs.reshape(xb.shape)
+
+    fn = shard_map(
+        local, mesh=mesh,
+        in_specs=(param_specs, xspec),
+        out_specs=xspec,
+        check_vma=False,
+    )
+    return fn(stage_params, x)
+
+
+def stack_stage_params(param_list):
+    """Stack per-stage parameter pytrees into one [num_stages, ...] tree."""
+    return jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves), *param_list
+    )

@@ -1,0 +1,129 @@
+"""Sharding strategies over named mesh axes (GSPMD recipe).
+
+The reference has no native model parallelism (SURVEY.md §2.6: TP/PP/SP/EP
+all absent, delegated to DeepSpeed/FSDP). Here every strategy is a set of
+logical-axis rules mapped onto the mesh:
+
+- DP:   batch -> dp         (gradients allreduced by XLA over ICI)
+- FSDP: batch -> fsdp, params' largest axis -> fsdp (ZeRO-3 gather/scatter
+        inserted by GSPMD)
+- TP:   heads/mlp/vocab -> tp (Megatron-style column/row splits)
+- SP/CP: sequence -> sp     (activations sharded along sequence; ring
+        attention exchanges KV blocks over ICI)
+- EP:   experts -> ep       (all_to_all dispatch)
+
+Models annotate parameters/activations with logical axis names via
+`flax.linen.Partitioned` metadata (`nn.with_partitioning`) and the trainer
+applies these rules with `flax.linen.logical_axis_rules`.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclass(frozen=True)
+class ShardingStrategy:
+    """Declarative parallelism config (the ScalingConfig extension promised
+    in SURVEY.md §7.1)."""
+
+    dp: int = 1
+    fsdp: int = 1
+    tp: int = 1
+    sp: int = 1
+    pp: int = 1
+    ep: int = 1
+
+    def mesh_axes(self, n_devices: int) -> Dict[str, int]:
+        from ray_tpu.parallel.mesh import mesh_shape_for
+
+        return mesh_shape_for(n_devices, dp=self.dp, fsdp=self.fsdp,
+                              tp=self.tp, sp=self.sp, pp=self.pp, ep=self.ep)
+
+    def build_mesh(self, devices=None) -> Mesh:
+        from ray_tpu.parallel.mesh import MeshConfig, build_mesh
+
+        devices = list(devices if devices is not None else jax.devices())
+        return build_mesh(MeshConfig(self.mesh_axes(len(devices))), devices)
+
+    @property
+    def data_axes(self) -> Tuple[str, ...]:
+        """Mesh axes the global batch is split over."""
+        return tuple(a for a, n in (("dp", self.dp), ("fsdp", self.fsdp))
+                     if n > 1) or ("dp",)
+
+
+def logical_axis_rules(strategy: ShardingStrategy) -> List[Tuple[str, Optional[tuple]]]:
+    """Logical-axis -> mesh-axis rules for `flax.linen.logical_axis_rules`."""
+    batch_axes = tuple(a for a, n in (("dp", strategy.dp),
+                                      ("fsdp", strategy.fsdp)) if n > 1)
+    rules: List[Tuple[str, Optional[tuple]]] = [
+        ("batch", batch_axes or None),
+        ("seq", ("sp",) if strategy.sp > 1 else None),
+        # Parameter axes.
+        ("embed", ("fsdp",) if strategy.fsdp > 1 else None),
+        ("mlp", ("tp",) if strategy.tp > 1 else None),
+        ("heads", ("tp",) if strategy.tp > 1 else None),
+        ("kv", None),
+        ("qkv", ("tp",) if strategy.tp > 1 else None),
+        ("vocab", ("tp",) if strategy.tp > 1 else None),
+        ("expert", ("ep",) if strategy.ep > 1 else None),
+        ("stage", ("pp",) if strategy.pp > 1 else None),
+        ("norm", None),
+    ]
+    return [(name, axes[0] if axes and len(axes) == 1 else axes)
+            for name, axes in rules]
+
+
+def batch_spec(strategy: ShardingStrategy, extra_dims: int = 1) -> P:
+    """PartitionSpec for a [batch, ...] array: batch split over data axes,
+    sequence over sp if enabled."""
+    axes: list = [strategy.data_axes if len(strategy.data_axes) > 1
+                  else strategy.data_axes[0]]
+    if strategy.sp > 1 and extra_dims >= 1:
+        axes.append("sp")
+        extra_dims -= 1
+    axes.extend([None] * extra_dims)
+    return P(*axes)
+
+
+def shard_batch(batch, mesh: Mesh, strategy: ShardingStrategy):
+    """Place a host-local batch pytree onto the mesh, sharded over the data
+    (and sequence) axes."""
+
+    def place(x):
+        ndim = getattr(x, "ndim", 0)
+        spec = batch_spec(strategy, extra_dims=max(0, ndim - 1))
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    return jax.tree_util.tree_map(place, batch)
+
+
+def sharding_constraint(x, mesh: Mesh, spec: P):
+    """`lax.with_sharding_constraint` that is a no-op outside jit/mesh."""
+    try:
+        return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+    except ValueError:
+        return x
+
+
+def param_shardings(mesh: Mesh, abstract_params, rules) -> "jax.tree_util.PyTreeDef":
+    """NamedShardings for a flax param tree annotated with
+    `nn.with_partitioning` metadata; unannotated leaves replicate."""
+    import flax.linen as nn
+
+    logical = nn.get_partition_spec(abstract_params)
+
+    def to_sharding(spec):
+        with nn.logical_axis_rules(rules):
+            mesh_spec = nn.logical_to_mesh(spec)
+        return NamedSharding(mesh, mesh_spec if isinstance(mesh_spec, P) else P())
+
+    return jax.tree_util.tree_map(
+        to_sharding, logical,
+        is_leaf=lambda x: isinstance(x, P),
+    )

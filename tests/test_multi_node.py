@@ -1,0 +1,127 @@
+"""Multi-node tests: spillback scheduling, cross-node objects, placement
+groups, node failure (reference: `ray_start_cluster`-based tests).
+
+Marked `slow`: spawns a 3-node cluster (3 raylets + GCS + workers) on one
+machine. Run with `-m slow` or as part of the full suite.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import (
+    NodeAffinitySchedulingStrategy,
+    PlacementGroupSchedulingStrategy,
+)
+from ray_tpu._private.node import Cluster
+
+pytestmark = pytest.mark.slow
+
+
+@pytest.fixture(scope="module")
+def three_nodes():
+    cluster = Cluster(head_resources={"CPU": 2},
+                      object_store_memory=64 * 1024 * 1024)
+    cluster.add_node({"CPU": 2})
+    cluster.add_node({"CPU": 2})
+    ray_tpu.init(address=cluster.gcs_addr)
+    yield cluster
+    ray_tpu.shutdown()
+    cluster.shutdown()
+
+
+@ray_tpu.remote
+def where_am_i():
+    return os.environ.get("RAY_TPU_NODE_ID")
+
+
+def test_spread_uses_multiple_nodes(three_nodes):
+    locs = set(ray_tpu.get(
+        [where_am_i.options(scheduling_strategy="SPREAD").remote()
+         for _ in range(12)],
+        timeout=240,
+    ))
+    assert len(locs) >= 2
+
+
+def test_node_affinity(three_nodes):
+    node_id = ray_tpu.nodes()[1]["NodeID"]
+    loc = ray_tpu.get(
+        where_am_i.options(
+            scheduling_strategy=NodeAffinitySchedulingStrategy(node_id)
+        ).remote(),
+        timeout=240,
+    )
+    assert loc == node_id
+
+
+def test_cross_node_object_transfer(three_nodes):
+    node_ids = [n["NodeID"] for n in ray_tpu.nodes()]
+
+    @ray_tpu.remote
+    def produce():
+        return np.arange(2_000_000, dtype=np.float64)  # 16MB
+
+    @ray_tpu.remote
+    def consume(arr):
+        return float(arr.sum())
+
+    r = produce.options(
+        scheduling_strategy=NodeAffinitySchedulingStrategy(node_ids[1])
+    ).remote()
+    out = consume.options(
+        scheduling_strategy=NodeAffinitySchedulingStrategy(node_ids[2])
+    ).remote(r)
+    assert ray_tpu.get(out, timeout=240) == 1999999 * 2000000 / 2
+
+
+def test_strict_spread_placement_group(three_nodes):
+    pg = ray_tpu.placement_group(
+        [{"CPU": 1}] * 3, strategy="STRICT_SPREAD"
+    )
+    assert pg.ready(timeout=60)
+    refs = [
+        where_am_i.options(
+            scheduling_strategy=PlacementGroupSchedulingStrategy(pg, i)
+        ).remote()
+        for i in range(3)
+    ]
+    locs = ray_tpu.get(refs, timeout=240)
+    assert len(set(locs)) == 3
+    ray_tpu.remove_placement_group(pg)
+
+
+def test_infeasible_strict_spread_stays_pending(three_nodes):
+    # 4 bundles on 3 nodes cannot STRICT_SPREAD.
+    pg = ray_tpu.placement_group([{"CPU": 1}] * 4, strategy="STRICT_SPREAD")
+    assert not pg.ready(timeout=3)
+    ray_tpu.remove_placement_group(pg)
+
+
+def test_actor_restart(three_nodes):
+    @ray_tpu.remote
+    class Flaky:
+        def pid(self):
+            return os.getpid()
+
+        def die(self):
+            os._exit(1)
+
+    f = Flaky.options(max_restarts=1).remote()
+    pid1 = ray_tpu.get(f.pid.remote(), timeout=240)
+    try:
+        ray_tpu.get(f.die.remote(), timeout=60)
+    except Exception:
+        pass
+    deadline = time.time() + 60
+    pid2 = None
+    while time.time() < deadline:
+        try:
+            pid2 = ray_tpu.get(f.pid.remote(), timeout=30)
+            break
+        except Exception:
+            time.sleep(1)
+    assert pid2 is not None and pid2 != pid1
