@@ -1,0 +1,60 @@
+"""Benchmark harness — prints ONE JSON line.
+
+Headline metric: 1:1 sync actor call throughput, the reference's own
+microbenchmark headline (`release/perf_metrics/microbenchmark.json`
+`1_1_actor_calls_sync` = 2,097/s on m5.16xlarge; harness
+`python/ray/_private/ray_perf.py`). Same shape here: one driver, one actor,
+round-trip method calls, wall-clocked.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+
+BASELINE_ACTOR_CALLS_SYNC = 2097.0  # release/perf_metrics/microbenchmark.json
+
+
+def bench_actor_calls_sync(duration_s: float = 5.0) -> float:
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=4)
+    try:
+        @ray_tpu.remote
+        class Sink:
+            def ping(self):
+                return None
+
+        actor = Sink.remote()
+        ray_tpu.get(actor.ping.remote())  # warm-up / actor creation
+
+        # Warm loop.
+        for _ in range(100):
+            ray_tpu.get(actor.ping.remote())
+
+        n = 0
+        start = time.perf_counter()
+        while True:
+            for _ in range(100):
+                ray_tpu.get(actor.ping.remote())
+            n += 100
+            elapsed = time.perf_counter() - start
+            if elapsed >= duration_s:
+                return n / elapsed
+    finally:
+        ray_tpu.shutdown()
+
+
+def main():
+    value = bench_actor_calls_sync()
+    print(json.dumps({
+        "metric": "1_1_actor_calls_sync",
+        "value": round(value, 1),
+        "unit": "calls/s",
+        "vs_baseline": round(value / BASELINE_ACTOR_CALLS_SYNC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -24,6 +24,7 @@ import logging
 import os
 import queue as queue_mod
 import threading
+import time
 import traceback
 from collections import deque
 from concurrent.futures import Future as SyncFuture
@@ -67,6 +68,10 @@ class _MemoryStore:
         self.errors: Dict[bytes, bytes] = {}       # oid -> pickled-exc frame
         self.locations: Dict[bytes, List[str]] = {}  # oid -> raylet addrs
         self._events: Dict[bytes, asyncio.Event] = {}
+        # Caller-thread waiters: registered at submit time so `get` can block
+        # on a concurrent Future resolved directly by the reply handler,
+        # without a loop round-trip (signalled on the loop thread).
+        self.thread_waiters: Dict[bytes, SyncFuture] = {}
 
     def _event(self, oid: bytes) -> asyncio.Event:
         ev = self._events.get(oid)
@@ -78,19 +83,30 @@ class _MemoryStore:
     def ready(self, oid: bytes) -> bool:
         return oid in self.values or oid in self.errors or oid in self.locations
 
+    def register_thread_waiter(self, oid: bytes) -> SyncFuture:
+        fut = SyncFuture()
+        self.thread_waiters[oid] = fut
+        return fut
+
+    def _signal(self, oid: bytes):
+        self._event(oid).set()
+        waiter = self.thread_waiters.pop(oid, None)
+        if waiter is not None and not waiter.done():
+            waiter.set_result(True)
+
     def put_value(self, oid: bytes, frame: bytes):
         self.values[oid] = frame
-        self._event(oid).set()
+        self._signal(oid)
 
     def put_error(self, oid: bytes, frame: bytes):
         self.errors[oid] = frame
-        self._event(oid).set()
+        self._signal(oid)
 
     def add_location(self, oid: bytes, raylet_addr: str):
         self.locations.setdefault(oid, [])
         if raylet_addr not in self.locations[oid]:
             self.locations[oid].append(raylet_addr)
-        self._event(oid).set()
+        self._signal(oid)
 
     async def wait_ready(self, oid: bytes, timeout: float | None = None):
         if self.ready(oid):
@@ -152,8 +168,7 @@ class CoreWorker:
         self._actor_instance = None
         self._actor_threadpool: Optional[ThreadPoolExecutor] = None
         self._actor_async_loop: Optional[asyncio.AbstractEventLoop] = None
-        self._actor_seq_expect: Dict[bytes, int] = {}
-        self._actor_seq_buffer: Dict[bytes, Dict[int, tuple]] = {}
+        self._actor_seq_state: Dict[bytes, dict] = {}
         self._function_cache: Dict[bytes, Any] = {}
         self._shutdown = False
         self.memory_store: Optional[_MemoryStore] = None
@@ -265,19 +280,60 @@ class CoreWorker:
     async def _put_plasma_meta(self, oid: bytes):
         self.memory_store.add_location(oid, self.raylet_addr)
 
+    _FAST_MISS = object()
+
     def get(self, refs, timeout: float | None = None):
         single = isinstance(refs, ObjectRef)
         ref_list = [refs] if single else list(refs)
-        values = self._run_sync(self._get_async(ref_list, timeout))
+        deadline = None if timeout is None else time.monotonic() + timeout
         out = []
-        for v in values:
+        slow: List[Tuple[int, ObjectRef]] = []
+        for i, ref in enumerate(ref_list):
+            v = self._get_fast(ref, deadline)
+            if v is CoreWorker._FAST_MISS:
+                slow.append((i, ref))
+            out.append(v)
+        if slow:
+            remaining = None
+            if deadline is not None:
+                remaining = max(0.0, deadline - time.monotonic())
+            values = self._run_sync(
+                self._get_async([r for _, r in slow], remaining))
+            for (i, _), v in zip(slow, values):
+                out[i] = v
+        for v in out:
             if isinstance(v, Exception):
                 raise v
-            out.append(v)
         return out[0] if single else out
+
+    def _get_fast(self, ref: ObjectRef, deadline: float | None):
+        """Caller-thread resolution of owned in-band results: no event-loop
+        round trip, and deserialization happens off the loop thread."""
+        if ref.owner_addr not in ("", self.address):
+            return CoreWorker._FAST_MISS
+        oid = ref.binary()
+        mem = self.memory_store
+        for _ in range(2):
+            if oid in mem.errors:
+                return self._error_from_frame(mem.errors[oid])
+            if oid in mem.values:
+                return serialization.loads(mem.values[oid])
+            if oid in mem.locations:
+                return CoreWorker._FAST_MISS  # plasma: needs the pull path
+            waiter = mem.thread_waiters.get(oid)
+            if waiter is None:
+                return CoreWorker._FAST_MISS
+            t = None if deadline is None else max(0.0, deadline - time.monotonic())
+            try:
+                waiter.result(t)
+            except TimeoutError:
+                raise GetTimeoutError(f"get timed out: {ref}")
+        return CoreWorker._FAST_MISS
 
     async def _get_async(self, refs: Sequence[ObjectRef],
                          timeout: float | None = None) -> List[Any]:
+        if len(refs) == 1:  # skip gather's per-ref task wrapping
+            return [await self._get_one(refs[0], timeout)]
         return await asyncio.gather(*[self._get_one(r, timeout) for r in refs])
 
     async def _get_one(self, ref: ObjectRef, timeout: float | None = None):
@@ -507,6 +563,8 @@ class CoreWorker:
             ObjectRef(ObjectID.for_task_return(task_id, i), self.address)
             for i in range(num_returns)
         ]
+        for r in refs:
+            self.memory_store.register_thread_waiter(r.binary())
         self._loop.call_soon_threadsafe(self._enqueue_task, spec)
         return refs
 
@@ -729,6 +787,8 @@ class CoreWorker:
             ObjectRef(ObjectID.for_task_return(task_id, i), self.address)
             for i in range(num_returns)
         ]
+        for r in refs:
+            self.memory_store.register_thread_waiter(r.binary())
         self._loop.call_soon_threadsafe(self._actor_enqueue, spec)
         return refs
 
@@ -739,16 +799,78 @@ class CoreWorker:
                 "queue": deque(),
                 "sending": False,
                 "seq": 0,
+                "epoch": 0,
                 "instance": None,  # (addr, num_restarts) of the live actor
             }
         return st
 
     def _actor_enqueue(self, spec: task_mod.TaskSpec):
         st = self._actor_state(spec.actor_id)
+        # Fast path: actor resolved, connection live, nothing queued — assign
+        # the sequence number and write the frame right now, skipping the
+        # sender/push coroutine hops. The executing side reorders by
+        # (epoch, seq) per caller, so this cannot race the slow path on
+        # ordering.
+        if not st["sending"] and not st["queue"] and st.get("instance"):
+            addr, restarts = st["instance"]
+            client = self._clients.get_cached(addr)
+            if client is not None:
+                self._assign_seq(st, addr, restarts, spec)
+                try:
+                    fut = client.call_nowait("push_task",
+                                             {"spec": spec.to_wire()})
+                except (ConnectionLost, OSError) as e:
+                    self._actor_task_failed(st, spec, addr, e)
+                    return
+                fut.add_done_callback(
+                    lambda f, spec=spec, st=st, addr=addr:
+                    self._actor_fast_reply(f, spec, st, addr))
+                return
         st["queue"].append(spec)
         if not st["sending"]:
             st["sending"] = True
             asyncio.ensure_future(self._actor_sender(spec.actor_id, st))
+
+    def _assign_seq(self, st: dict, addr: str, restarts: int,
+                    spec: task_mod.TaskSpec):
+        """Assign (epoch, seq) against the current actor instance. The epoch
+        bumps whenever numbering restarts — new actor instance or reconnect
+        after failure — so the executor can resync instead of waiting on a
+        seq that died with the old connection."""
+        instance = (addr, restarts)
+        if st.get("seq_instance") != instance:
+            st["seq_instance"] = instance
+            st["epoch"] += 1
+            st["seq"] = 0
+        spec.seq_no = st["seq"]
+        spec.seq_epoch = st["epoch"]
+        st["seq"] += 1
+
+    def _actor_task_failed(self, st: dict, spec: task_mod.TaskSpec,
+                           addr: str, exc: Exception):
+        """Shared failure handling for fast- and slow-path pushes: invalidate
+        the cached instance AND the seq instance (forcing an epoch bump on
+        the next send), then error the task — actor tasks are never
+        implicitly re-executed."""
+        if st.get("instance") and st["instance"][0] == addr:
+            st["instance"] = None
+        st["seq_instance"] = None
+        self._store_task_error(
+            spec,
+            ActorDiedError(
+                f"actor task {spec.method_name} failed (actor died "
+                f"mid-call, not retried): {exc}"
+            ),
+        )
+
+    def _actor_fast_reply(self, fut: asyncio.Future,
+                          spec: task_mod.TaskSpec, st: dict, addr: str):
+        try:
+            reply = fut.result()
+        except (ConnectionLost, RpcError, OSError) as e:
+            self._actor_task_failed(st, spec, addr, e)
+            return
+        self._process_task_reply(spec, reply)
 
     async def _actor_sender(self, actor_id: bytes, st: dict):
         """Ordered, pipelined sends: sequence numbers assigned at send time
@@ -765,13 +887,8 @@ class CoreWorker:
                     while st["queue"]:
                         self._store_task_error(st["queue"].popleft(), e)
                     return
-                instance = (addr, restarts)
-                if st.get("seq_instance") != instance:
-                    st["seq_instance"] = instance
-                    st["seq"] = 0
                 st["queue"].popleft()
-                spec.seq_no = st["seq"]
-                st["seq"] += 1
+                self._assign_seq(st, addr, restarts, spec)
                 asyncio.ensure_future(self._push_actor_task(st, spec, addr))
         finally:
             st["sending"] = False
@@ -784,15 +901,7 @@ class CoreWorker:
                                       timeout=None)
             self._process_task_reply(spec, reply)
         except (ConnectionLost, RpcError, OSError) as e:
-            if st.get("instance") and st["instance"][0] == addr:
-                st["instance"] = None  # force re-resolve for queued tasks
-            self._store_task_error(
-                spec,
-                ActorDiedError(
-                    f"actor task {spec.method_name} failed (actor died "
-                    f"mid-call, not retried): {e}"
-                ),
-            )
+            self._actor_task_failed(st, spec, addr, e)
 
     async def _resolve_actor(self, actor_id: bytes,
                              timeout: float | None = None
@@ -891,16 +1000,34 @@ class CoreWorker:
         return await fut
 
     async def _enqueue_ordered(self, spec: task_mod.TaskSpec, fut):
-        """Per-caller sequence ordering (reference: ActorSchedulingQueue)."""
+        """Per-caller (epoch, seq) ordering (reference: ActorSchedulingQueue).
+
+        The epoch bumps when the caller restarts numbering (reconnect after a
+        connection loss, or actor restart). A newer epoch means no more
+        frames from the old one can arrive: flush whatever is buffered (best
+        effort, in seq order — the missing seqs died with the connection)
+        and resync at seq 0. An older epoch is a stray orphan; run it rather
+        than wedge the stream."""
         caller = spec.owner_worker_id
-        expect = self._actor_seq_expect.get(caller, 0)
-        buffer = self._actor_seq_buffer.setdefault(caller, {})
-        buffer[spec.seq_no] = (spec, fut)
-        while expect in buffer:
-            ready_spec, ready_fut = buffer.pop(expect)
-            expect += 1
+        st = self._actor_seq_state.get(caller)
+        if st is None:
+            st = self._actor_seq_state[caller] = {
+                "epoch": -1, "expect": 0, "buffer": {},
+            }
+        if spec.seq_epoch < st["epoch"]:
+            self._dispatch_actor_task(spec, fut)
+            return
+        if spec.seq_epoch > st["epoch"]:
+            for seq in sorted(st["buffer"]):
+                self._dispatch_actor_task(*st["buffer"][seq])
+            st["buffer"] = {}
+            st["epoch"] = spec.seq_epoch
+            st["expect"] = 0
+        st["buffer"][spec.seq_no] = (spec, fut)
+        while st["expect"] in st["buffer"]:
+            ready_spec, ready_fut = st["buffer"].pop(st["expect"])
+            st["expect"] += 1
             self._dispatch_actor_task(ready_spec, ready_fut)
-        self._actor_seq_expect[caller] = expect
 
     def _dispatch_actor_task(self, spec, fut):
         if self._actor_async_loop is not None:

@@ -200,6 +200,18 @@ class RpcClient:
             return await fut
         return await asyncio.wait_for(fut, timeout)
 
+    def call_nowait(self, method: str, payload: Any = None) -> asyncio.Future:
+        """Loop-thread-only fast path: write the request frame synchronously
+        (StreamWriter.write appends a whole frame atomically, so no lock and
+        no drain round-trip) and return the pending reply future."""
+        if self._writer is None:
+            raise ConnectionLost(f"not connected: {self.address}")
+        msgid = next(self._msgid)
+        fut = asyncio.get_event_loop().create_future()
+        self._pending[msgid] = fut
+        self._writer.write(_pack([msgid, REQUEST, method, payload]))
+        return fut
+
     async def notify(self, method: str, payload: Any = None):
         if self._writer is None:
             raise ConnectionLost(f"not connected: {self.address}")
@@ -228,14 +240,21 @@ class ClientPool:
         self._clients: Dict[str, RpcClient] = {}
         self._locks: Dict[str, asyncio.Lock] = {}
 
-    async def get(self, address: str) -> RpcClient:
+    def get_cached(self, address: str) -> Optional[RpcClient]:
+        """Synchronous lookup; None when no live connection exists yet."""
         client = self._clients.get(address)
         if client is not None and client.connected:
             return client
+        return None
+
+    async def get(self, address: str) -> RpcClient:
+        client = self.get_cached(address)
+        if client is not None:
+            return client
         lock = self._locks.setdefault(address, asyncio.Lock())
         async with lock:
-            client = self._clients.get(address)
-            if client is not None and client.connected:
+            client = self.get_cached(address)
+            if client is not None:
                 return client
             client = RpcClient(address)
             await client.connect()

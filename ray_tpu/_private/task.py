@@ -45,6 +45,11 @@ class TaskSpec:
     actor_id: Optional[bytes] = None
     method_name: Optional[str] = None
     seq_no: int = 0
+    # Sequence epoch: bumped by the submitter whenever it restarts seq
+    # numbering (actor restart OR reconnect after a connection loss), so the
+    # executor can resynchronize its reorder buffer instead of waiting
+    # forever on a seq that died with the old connection.
+    seq_epoch: int = 0
     max_restarts: int = 0
     max_concurrency: int = 1
     # Scheduling.
@@ -75,6 +80,7 @@ class TaskSpec:
             "actor_id": self.actor_id,
             "method_name": self.method_name,
             "seq_no": self.seq_no,
+            "seq_epoch": self.seq_epoch,
             "max_restarts": self.max_restarts,
             "max_concurrency": self.max_concurrency,
             "strategy": self.strategy,

@@ -186,3 +186,48 @@ def test_actor_death_raises():
 def test_cluster_resources():
     res = ray_tpu.cluster_resources()
     assert res["CPU"] == 4.0
+
+
+def test_actor_seq_epoch_resync():
+    """Executor-side (epoch, seq) reorder buffer: a newer epoch flushes and
+    resyncs at seq 0 (reconnect after connection loss); an older epoch runs
+    immediately instead of wedging the stream."""
+    import asyncio
+
+    from ray_tpu._private.core_worker import CoreWorker
+    from ray_tpu._private.task import TaskSpec, ACTOR_TASK
+
+    class Stub:
+        _actor_seq_state = {}
+        dispatched = []
+
+        def _dispatch_actor_task(self, spec, fut):
+            self.dispatched.append((spec.seq_epoch, spec.seq_no))
+
+    stub = Stub()
+
+    def spec(epoch, seq):
+        return TaskSpec(task_id=b"t", job_id=b"j", name="m",
+                        task_type=ACTOR_TASK, owner_worker_id=b"caller",
+                        seq_no=seq, seq_epoch=epoch)
+
+    async def run():
+        enq = CoreWorker._enqueue_ordered
+        # Epoch 1: seq 0 runs, seq 2 buffers (seq 1 lost with the wire).
+        await enq(stub, spec(1, 0), None)
+        await enq(stub, spec(1, 2), None)
+        assert stub.dispatched == [(1, 0)]
+        # Epoch 2 arrives: buffered (1,2) flushes, numbering resyncs at 0.
+        await enq(stub, spec(2, 0), None)
+        assert stub.dispatched == [(1, 0), (1, 2), (2, 0)]
+        # In-order epoch 2 traffic flows normally.
+        await enq(stub, spec(2, 1), None)
+        assert stub.dispatched[-1] == (2, 1)
+        # A stray old-epoch orphan executes immediately.
+        await enq(stub, spec(1, 5), None)
+        assert stub.dispatched[-1] == (1, 5)
+        # Epoch 2 stream is unaffected by the orphan.
+        await enq(stub, spec(2, 2), None)
+        assert stub.dispatched[-1] == (2, 2)
+
+    asyncio.run(run())
