@@ -1,0 +1,25 @@
+"""Shared Train/Tune plumbing: run configs, checkpoints, results.
+
+Reference: `python/ray/air/config.py` (ScalingConfig :103, FailureConfig
+:395, CheckpointConfig :445, RunConfig :594), `python/ray/train/_checkpoint.py:56`
+(Checkpoint), re-designed for JAX/TPU: ScalingConfig speaks device-mesh
+axes (dp/fsdp/tp/sp/pp/ep) instead of torch process groups.
+"""
+
+from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.air.config import (
+    CheckpointConfig,
+    FailureConfig,
+    RunConfig,
+    ScalingConfig,
+)
+from ray_tpu.air.result import Result
+
+__all__ = [
+    "Checkpoint",
+    "CheckpointConfig",
+    "FailureConfig",
+    "Result",
+    "RunConfig",
+    "ScalingConfig",
+]

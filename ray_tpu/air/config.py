@@ -1,0 +1,118 @@
+"""Run/scaling/failure/checkpoint configs shared by Train and Tune.
+
+Reference: `python/ray/air/config.py` — `ScalingConfig` (:103),
+`FailureConfig` (:395), `CheckpointConfig` (:445), `RunConfig` (:594).
+
+TPU-first deltas vs the reference:
+- `ScalingConfig` carries an optional `mesh_shape` / `mesh_axes` describing
+  the per-worker `jax.sharding.Mesh` (DP/FSDP/TP/SP/PP/EP axes) instead of
+  assuming torch DDP; `use_tpu` replaces `use_gpu`.
+- Placement-group bundle construction (`as_placement_group_factory`) emits
+  slice-shaped bundles: one bundle per worker with its chip count, matching
+  the reference's worker-bundle layout
+  (`python/ray/train/_internal/backend_executor.py:206-256`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+
+@dataclasses.dataclass
+class ScalingConfig:
+    """How many train workers, and what each one holds.
+
+    num_workers: worker actors (one jax process each).
+    use_tpu: give each worker TPU chips.
+    resources_per_worker: explicit per-worker resources; defaults to
+        ``{"CPU": 1}`` plus ``{"TPU": tpus_per_worker}`` when ``use_tpu``.
+    tpus_per_worker: chips per worker (a TPU-VM host's local chips).
+    mesh_axes / mesh_shape: the global device-mesh the trainer should build
+        across all workers' devices, e.g. axes ``("dp", "tp")`` shape
+        ``(8, 4)``. ``None`` → pure DP over all devices.
+    placement_strategy: PG strategy (PACK default, like the reference).
+    """
+
+    num_workers: int = 1
+    use_tpu: bool = False
+    tpus_per_worker: int = 0
+    resources_per_worker: Optional[Dict[str, float]] = None
+    mesh_axes: Optional[Tuple[str, ...]] = None
+    mesh_shape: Optional[Tuple[int, ...]] = None
+    placement_strategy: str = "PACK"
+    trainer_resources: Optional[Dict[str, float]] = None
+
+    def _worker_resources(self) -> Dict[str, float]:
+        if self.resources_per_worker is not None:
+            return dict(self.resources_per_worker)
+        res: Dict[str, float] = {"CPU": 1.0}
+        if self.use_tpu:
+            res["TPU"] = float(self.tpus_per_worker or 1)
+        return res
+
+    @property
+    def num_tpus_per_worker(self) -> float:
+        return self._worker_resources().get("TPU", 0.0)
+
+    def bundles(self) -> List[Dict[str, float]]:
+        """One bundle per worker (+ a zero-CPU trainer bundle is implicit)."""
+        return [self._worker_resources() for _ in range(self.num_workers)]
+
+    def total_resources(self) -> Dict[str, float]:
+        total: Dict[str, float] = {}
+        for b in self.bundles():
+            for k, v in b.items():
+                total[k] = total.get(k, 0.0) + v
+        return total
+
+
+@dataclasses.dataclass
+class FailureConfig:
+    """Trial-level retry policy (reference `air/config.py:395`).
+
+    max_failures: retries after a worker/trial crash. 0 = no retries,
+        -1 = infinite.
+    """
+
+    max_failures: int = 0
+
+
+@dataclasses.dataclass
+class CheckpointConfig:
+    """Keep-top-K policy (reference `air/config.py:445`)."""
+
+    num_to_keep: Optional[int] = None
+    checkpoint_score_attribute: Optional[str] = None
+    checkpoint_score_order: str = "max"
+    checkpoint_frequency: int = 0
+    checkpoint_at_end: Optional[bool] = None
+
+    def __post_init__(self):
+        if self.checkpoint_score_order not in ("max", "min"):
+            raise ValueError("checkpoint_score_order must be 'max' or 'min'")
+
+
+@dataclasses.dataclass
+class RunConfig:
+    """Experiment-level config (reference `air/config.py:594`)."""
+
+    name: Optional[str] = None
+    storage_path: Optional[str] = None
+    failure_config: Optional[FailureConfig] = None
+    checkpoint_config: Optional[CheckpointConfig] = None
+    stop: Optional[Any] = None
+    verbose: int = 0
+    log_to_file: bool = False
+    callbacks: Optional[List[Any]] = None
+
+    def __post_init__(self):
+        if self.storage_path is None:
+            self.storage_path = os.path.expanduser(
+                os.environ.get("RAY_TPU_RESULTS_DIR", "~/ray_tpu_results")
+            )
+        if self.failure_config is None:
+            self.failure_config = FailureConfig()
+        if self.checkpoint_config is None:
+            self.checkpoint_config = CheckpointConfig()

@@ -1,0 +1,177 @@
+"""Per-worker train session: the report/checkpoint channel.
+
+Reference: `python/ray/train/_internal/session.py` — `_TrainSession`
+(:110), `report` (:402/:666), `get_checkpoint` (:753). The session runs the
+user's `train_loop_per_worker` on a background thread inside the train
+worker actor; `report()` synchronizes with the controller by blocking until
+the controller has consumed the previous result (queue of size 1, matching
+the reference's back-to-back report semantics).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import queue
+import threading
+from typing import Any, Dict, Optional
+
+from ray_tpu.air.checkpoint import Checkpoint
+
+
+@dataclasses.dataclass
+class SessionConfig:
+    experiment_name: str
+    storage_path: str          # experiment dir on the shared filesystem
+    world_rank: int
+    world_size: int
+    local_rank: int
+    local_world_size: int
+    node_rank: int
+    trial_id: str = "default"
+    trial_dir: str = ""        # {storage_path}/{trial_id}
+    checkpoint: Optional[Checkpoint] = None   # restore-from
+    metadata: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+class _TrainSession:
+    def __init__(self, config: SessionConfig):
+        self.config = config
+        self.result_queue: "queue.Queue[dict]" = queue.Queue(maxsize=1)
+        self.finished = threading.Event()
+        self.error: Optional[BaseException] = None
+        self._report_index = 0
+        self._last_checkpoint = config.checkpoint
+        self.datasets: Dict[str, Any] = {}
+        os.makedirs(config.trial_dir, exist_ok=True)
+
+    # called from the user's train-fn thread
+    def report(self, metrics: Dict[str, Any],
+               checkpoint: Optional[Checkpoint] = None) -> None:
+        persisted_path = None
+        if checkpoint is not None:
+            persisted_path = self._persist_checkpoint(checkpoint)
+            self._last_checkpoint = Checkpoint(persisted_path)
+        item = {
+            "metrics": dict(metrics),
+            "checkpoint_path": persisted_path,
+            "report_index": self._report_index,
+            "world_rank": self.config.world_rank,
+        }
+        self._report_index += 1
+        # Blocks until the controller drained the previous report — keeps
+        # workers in lockstep the way the reference's session does.
+        self.result_queue.put(item)
+
+    def get_checkpoint(self) -> Optional[Checkpoint]:
+        return self._last_checkpoint
+
+    def _persist_checkpoint(self, checkpoint: Checkpoint) -> str:
+        """Move the worker's local checkpoint dir into trial storage.
+
+        Reference: `python/ray/train/_internal/storage.py:349`
+        (StorageContext.persist_current_checkpoint) — here storage is a
+        shared local filesystem path.
+        """
+        dest = os.path.join(
+            self.config.trial_dir,
+            f"checkpoint_{self._report_index:06d}",
+        )
+        rank_dest = (dest if self.config.world_rank == 0
+                     else os.path.join(dest + "_shards",
+                                       f"rank_{self.config.world_rank}"))
+        checkpoint.to_directory(rank_dest)
+        return dest if self.config.world_rank == 0 else rank_dest
+
+
+_session_lock = threading.Lock()
+_session: Optional[_TrainSession] = None
+
+
+def init_session(config: SessionConfig) -> _TrainSession:
+    global _session
+    with _session_lock:
+        _session = _TrainSession(config)
+        return _session
+
+
+def get_session() -> Optional[_TrainSession]:
+    return _session
+
+
+def shutdown_session() -> None:
+    global _session
+    with _session_lock:
+        _session = None
+
+
+# ---------------------------------------------------------------------------
+# public API surface (`ray_tpu.train.report` etc.)
+# ---------------------------------------------------------------------------
+
+class TrainContext:
+    """Reference: `python/ray/train/context.py:26`."""
+
+    def get_world_size(self) -> int:
+        return _require().config.world_size
+
+    def get_world_rank(self) -> int:
+        return _require().config.world_rank
+
+    def get_local_rank(self) -> int:
+        return _require().config.local_rank
+
+    def get_local_world_size(self) -> int:
+        return _require().config.local_world_size
+
+    def get_node_rank(self) -> int:
+        return _require().config.node_rank
+
+    def get_trial_id(self) -> str:
+        return _require().config.trial_id
+
+    def get_trial_dir(self) -> str:
+        return _require().config.trial_dir
+
+    def get_experiment_name(self) -> str:
+        return _require().config.experiment_name
+
+    def get_metadata(self) -> Dict[str, Any]:
+        return dict(_require().config.metadata)
+
+
+def _require() -> _TrainSession:
+    s = get_session()
+    if s is None:
+        raise RuntimeError(
+            "No train session active — call this from inside a "
+            "train_loop_per_worker")
+    return s
+
+
+def report(metrics: Dict[str, Any],
+           checkpoint: Optional[Checkpoint] = None) -> None:
+    _require().report(metrics, checkpoint)
+
+
+def get_checkpoint() -> Optional[Checkpoint]:
+    return _require().get_checkpoint()
+
+
+def get_context() -> TrainContext:
+    _require()
+    return TrainContext()
+
+
+def get_dataset_shard(name: str = "train"):
+    """Per-worker split of a dataset passed to the trainer.
+
+    Reference: `python/ray/train/_internal/session.py` get_dataset_shard +
+    `python/ray/data/_internal/iterator/stream_split_iterator.py:32`.
+    """
+    s = _require()
+    shard = s.datasets.get(name)
+    if shard is None:
+        raise KeyError(f"no dataset shard named {name!r}; available: "
+                       f"{sorted(s.datasets)}")
+    return shard

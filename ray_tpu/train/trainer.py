@@ -1,0 +1,205 @@
+"""Trainers: BaseTrainer + DataParallelTrainer (JaxTrainer).
+
+Reference: `python/ray/train/base_trainer.py:567` (`BaseTrainer.fit` wraps
+the trainer as a Tune Trainable and runs a one-trial Tuner — Train runs ON
+TOP of Tune) and `python/ray/train/data_parallel_trainer.py:25,428`
+(`DataParallelTrainer.training_loop` drives the BackendExecutor).
+
+This implementation keeps the same layering: `fit()` constructs a
+single-trial `ray_tpu.tune.Tuner` when Tune is importable, falling back to
+driving the controller loop inline. The controller loop itself
+(`_run_training_loop`) is what the reference runs inside the Trainable
+actor.
+"""
+
+from __future__ import annotations
+
+import os
+import uuid
+from typing import Any, Callable, Dict, Optional
+
+from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.air.config import (
+    CheckpointConfig,
+    FailureConfig,
+    RunConfig,
+    ScalingConfig,
+)
+from ray_tpu.air.result import Result
+from ray_tpu.train._internal.backend_executor import (
+    BackendExecutor,
+    TrainingFailedError,
+)
+from ray_tpu.train._internal.checkpoint_manager import CheckpointManager
+from ray_tpu.train.backend import BackendConfig, JaxConfig
+
+
+class BaseTrainer:
+    def __init__(
+        self,
+        *,
+        scaling_config: Optional[ScalingConfig] = None,
+        run_config: Optional[RunConfig] = None,
+        resume_from_checkpoint: Optional[Checkpoint] = None,
+        metadata: Optional[Dict[str, Any]] = None,
+    ):
+        self.scaling_config = scaling_config or ScalingConfig()
+        self.run_config = run_config or RunConfig()
+        self.resume_from_checkpoint = resume_from_checkpoint
+        self.metadata = metadata or {}
+
+    def training_loop(self) -> None:
+        raise NotImplementedError
+
+    def fit(self) -> Result:
+        """Run via Tune when available (reference layering), else inline."""
+        try:
+            from ray_tpu.tune.tuner import Tuner
+        except ImportError:
+            return self._fit_inline()
+        tuner = Tuner(
+            self.as_trainable(),
+            run_config=self.run_config,
+        )
+        grid = tuner.fit()
+        return grid[0]
+
+    def as_trainable(self):
+        """Wrap as a Tune trainable function (reference
+        `BaseTrainer.as_trainable`, `base_trainer.py:760`)."""
+        from ray_tpu.tune import trainable as trainable_mod
+        trainer = self
+
+        def train_func(config):
+            from ray_tpu.tune.trainable import session_report
+            trainer._run_training_loop(report_fn=session_report)
+
+        train_func.__name__ = type(self).__name__
+        tr = trainable_mod.wrap_function(train_func)
+        # Trial actors must reserve the whole worker fleet's resources via
+        # their own PG; trial resources = trainer bundle only (workers make
+        # their own PG) — matches reference PlacementGroupFactory shape.
+        tr._trainer_resources = self.scaling_config.trainer_resources or \
+            {"CPU": 1.0}
+        return tr
+
+    def _fit_inline(self) -> Result:
+        out: Dict[str, Any] = {}
+
+        def collect(metrics, checkpoint=None):
+            out["metrics"] = metrics
+            if checkpoint is not None:
+                out["checkpoint"] = checkpoint
+
+        self._run_training_loop(report_fn=collect)
+        return Result(metrics=out.get("metrics"),
+                      checkpoint=out.get("checkpoint"),
+                      path=self._trial_dir)
+
+    # subclasses implement
+    def _run_training_loop(self, report_fn: Optional[Callable]) -> None:
+        raise NotImplementedError
+
+
+class DataParallelTrainer(BaseTrainer):
+    """SPMD trainer: N identical workers, one jax process each.
+
+    Reference: `python/ray/train/data_parallel_trainer.py:25`. The "data
+    parallel" here is about the *worker fleet*; within and across workers
+    the model may still be sharded DP/FSDP/TP/SP via the mesh the train
+    loop builds (ray_tpu.parallel) — the trainer provides the gang +
+    rendezvous + report plumbing.
+    """
+
+    _backend_config_cls = BackendConfig
+
+    def __init__(
+        self,
+        train_loop_per_worker: Callable,
+        *,
+        train_loop_config: Optional[Dict[str, Any]] = None,
+        backend_config: Optional[BackendConfig] = None,
+        scaling_config: Optional[ScalingConfig] = None,
+        run_config: Optional[RunConfig] = None,
+        datasets: Optional[Dict[str, Any]] = None,
+        resume_from_checkpoint: Optional[Checkpoint] = None,
+        metadata: Optional[Dict[str, Any]] = None,
+    ):
+        super().__init__(scaling_config=scaling_config,
+                         run_config=run_config,
+                         resume_from_checkpoint=resume_from_checkpoint,
+                         metadata=metadata)
+        self.train_loop_per_worker = train_loop_per_worker
+        self.train_loop_config = train_loop_config or {}
+        self.backend_config = backend_config or self._backend_config_cls()
+        self.datasets = datasets or {}
+        self._trial_dir: Optional[str] = None
+
+    def _run_training_loop(self, report_fn: Optional[Callable]) -> None:
+        """The controller loop (runs in the Trainable actor under Tune,
+        or inline in the driver)."""
+        name = self.run_config.name or f"{type(self).__name__}_" \
+            f"{uuid.uuid4().hex[:8]}"
+        trial_id = uuid.uuid4().hex[:8]
+        executor = BackendExecutor(
+            self.backend_config, self.scaling_config,
+            experiment_name=name,
+            storage_path=self.run_config.storage_path,
+            trial_id=trial_id,
+        )
+        self._trial_dir = os.path.join(self.run_config.storage_path, name,
+                                       trial_id)
+        ckpt_manager = CheckpointManager(self.run_config.checkpoint_config)
+        max_failures = self.run_config.failure_config.max_failures
+        attempts = 0
+        restore_checkpoint = self.resume_from_checkpoint
+        while True:
+            try:
+                executor.start()
+                executor.start_training(
+                    self.train_loop_per_worker,
+                    config=self.train_loop_config,
+                    datasets=self.datasets,
+                    checkpoint=restore_checkpoint,
+                )
+                last_metrics: Optional[Dict[str, Any]] = None
+                while True:
+                    results = executor.get_next_results()
+                    if results is None:
+                        break
+                    # Lowest live world rank speaks for the step; its
+                    # checkpoint is the canonical (rank-0) one only while
+                    # rank 0 is still reporting.
+                    lead = min(results, key=lambda r: r["world_rank"])
+                    last_metrics = lead["metrics"]
+                    checkpoint = None
+                    if lead.get("checkpoint_path") and \
+                            lead["world_rank"] == 0:
+                        checkpoint = Checkpoint(lead["checkpoint_path"])
+                        ckpt_manager.register_checkpoint(
+                            checkpoint, last_metrics)
+                    if report_fn is not None:
+                        report_fn(last_metrics, checkpoint=checkpoint)
+                executor.shutdown()
+                return
+            except TrainingFailedError:
+                executor.shutdown()
+                attempts += 1
+                if max_failures >= 0 and attempts > max_failures:
+                    raise
+                restore_checkpoint = ckpt_manager.latest_checkpoint or \
+                    restore_checkpoint
+            except BaseException:
+                executor.shutdown()
+                raise
+
+
+class JaxTrainer(DataParallelTrainer):
+    """The flagship trainer: jax.distributed + mesh-parallel training.
+
+    Reference analogue: `TorchTrainer` (`python/ray/train/torch/
+    torch_trainer.py`) — with `JaxConfig` replacing `TorchConfig`
+    (NCCL → XLA/ICI collectives; see `ray_tpu/train/backend.py`).
+    """
+
+    _backend_config_cls = JaxConfig
