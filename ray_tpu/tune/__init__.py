@@ -1,0 +1,70 @@
+"""ray_tpu.tune — hyperparameter search & experiment execution.
+
+Reference: `python/ray/tune/` — see SURVEY.md §2.4. Trials are Trainable
+actors driven by a controller event loop; searchers generate configs,
+schedulers make early-stopping / PBT decisions, stoppers/loggers observe.
+"""
+
+from ray_tpu.tune.schedulers import (
+    AsyncHyperBandScheduler,
+    FIFOScheduler,
+    HyperBandScheduler,
+    MedianStoppingRule,
+    PopulationBasedTraining,
+    TrialScheduler,
+)
+from ray_tpu.tune.search import (
+    BasicVariantGenerator,
+    Searcher,
+    choice,
+    grid_search,
+    sample_from,
+    loguniform,
+    quniform,
+    randint,
+    uniform,
+)
+from ray_tpu.tune.stopper import (
+    CombinedStopper,
+    FunctionStopper,
+    MaximumIterationStopper,
+    Stopper,
+    TrialPlateauStopper,
+)
+from ray_tpu.tune.trainable import (
+    Trainable,
+    get_checkpoint,
+    session_report as report,
+    wrap_function,
+)
+from ray_tpu.tune.tuner import ResultGrid, TuneConfig, Tuner
+
+__all__ = [
+    "AsyncHyperBandScheduler",
+    "BasicVariantGenerator",
+    "CombinedStopper",
+    "FIFOScheduler",
+    "FunctionStopper",
+    "HyperBandScheduler",
+    "MaximumIterationStopper",
+    "MedianStoppingRule",
+    "PopulationBasedTraining",
+    "ResultGrid",
+    "Searcher",
+    "Stopper",
+    "Trainable",
+    "TrialPlateauStopper",
+    "TrialScheduler",
+    "TuneConfig",
+    "Tuner",
+    "choice",
+    "get_checkpoint",
+    "grid_search",
+    "loguniform",
+    "quniform",
+    "randint",
+    "sample_from",
+    "report",
+    "uniform",
+    "wrap_function",
+]

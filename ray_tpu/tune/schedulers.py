@@ -1,0 +1,255 @@
+"""Trial schedulers: FIFO, ASHA, HyperBand, Median-stopping, PBT.
+
+Reference: `python/ray/tune/schedulers/` — `async_hyperband.py:19` (ASHA),
+`hyperband.py:42`, `median_stopping_rule.py`, `pbt.py:221`. The controller
+calls `on_trial_result` for every report and acts on the returned decision;
+PBT additionally drives exploit/explore through the controller's
+checkpoint/restart hooks.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from collections import defaultdict
+from typing import Any, Callable, Dict, List, Optional
+
+
+class TrialScheduler:
+    CONTINUE = "CONTINUE"
+    STOP = "STOP"
+
+    def set_metric(self, metric: str, mode: str) -> None:
+        if getattr(self, "metric", None) is None:
+            self.metric = metric
+        if getattr(self, "mode", None) is None:
+            self.mode = mode
+
+    def on_trial_add(self, controller, trial) -> None:
+        pass
+
+    def on_trial_result(self, controller, trial, result: Dict) -> str:
+        return self.CONTINUE
+
+    def on_trial_complete(self, controller, trial, result: Dict) -> None:
+        pass
+
+
+class FIFOScheduler(TrialScheduler):
+    pass
+
+
+class AsyncHyperBandScheduler(TrialScheduler):
+    """ASHA (reference `async_hyperband.py:19`): asynchronous successive
+    halving — at each rung milestone a trial stops unless it is in the top
+    1/reduction_factor of results recorded at that rung."""
+
+    def __init__(self, metric: Optional[str] = None, mode: str = "max",
+                 time_attr: str = "training_iteration",
+                 max_t: int = 100, grace_period: int = 1,
+                 reduction_factor: float = 4, brackets: int = 1):
+        self.metric = metric
+        self.mode = mode
+        self.time_attr = time_attr
+        self.max_t = max_t
+        self.grace_period = grace_period
+        self.rf = reduction_factor
+        # rung levels: grace * rf^k up to max_t
+        self.rungs: List[float] = []
+        t = grace_period
+        while t < max_t:
+            self.rungs.append(t)
+            t *= reduction_factor
+        # rung -> recorded metric values
+        self._rung_results: Dict[float, List[float]] = defaultdict(list)
+        self._trial_rung: Dict[str, int] = {}
+
+    def _val(self, result: Dict) -> float:
+        v = float(result[self.metric])
+        return v if self.mode == "max" else -v
+
+    def on_trial_add(self, controller, trial) -> None:
+        self._trial_rung[trial.trial_id] = 0
+
+    def on_trial_result(self, controller, trial, result: Dict) -> str:
+        if self.metric not in result or self.time_attr not in result:
+            return self.CONTINUE
+        t = result[self.time_attr]
+        if t >= self.max_t:
+            return self.STOP
+        rung_i = self._trial_rung.get(trial.trial_id, 0)
+        decision = self.CONTINUE
+        while rung_i < len(self.rungs) and t >= self.rungs[rung_i]:
+            rung = self.rungs[rung_i]
+            val = self._val(result)
+            recorded = self._rung_results[rung]
+            recorded.append(val)
+            cutoff_n = max(1, int(len(recorded) / self.rf))
+            top = sorted(recorded, reverse=True)[:cutoff_n]
+            if val < top[-1]:
+                decision = self.STOP
+            rung_i += 1
+        self._trial_rung[trial.trial_id] = rung_i
+        return decision
+
+
+class HyperBandScheduler(TrialScheduler):
+    """Bracketed successive halving (reference `hyperband.py:42`).
+
+    Trials are assigned round-robin to brackets with different grace
+    periods; each bracket is an ASHA instance (asynchronous-mode
+    simplification of the reference's synchronized brackets).
+    """
+
+    def __init__(self, metric: Optional[str] = None, mode: str = "max",
+                 time_attr: str = "training_iteration",
+                 max_t: int = 81, reduction_factor: float = 3):
+        self.metric = metric
+        self.mode = mode
+        s_max = int(math.log(max_t) / math.log(reduction_factor))
+        self._brackets = [
+            AsyncHyperBandScheduler(
+                metric=metric, mode=mode, time_attr=time_attr, max_t=max_t,
+                grace_period=max(1, int(max_t * reduction_factor ** (-s))),
+                reduction_factor=reduction_factor)
+            for s in range(s_max + 1)
+        ]
+        self._assignment: Dict[str, AsyncHyperBandScheduler] = {}
+        self._next = 0
+
+    def set_metric(self, metric: str, mode: str) -> None:
+        super().set_metric(metric, mode)
+        for b in self._brackets:
+            b.set_metric(metric, mode)
+
+    def on_trial_add(self, controller, trial) -> None:
+        b = self._brackets[self._next % len(self._brackets)]
+        self._next += 1
+        self._assignment[trial.trial_id] = b
+        b.on_trial_add(controller, trial)
+
+    def on_trial_result(self, controller, trial, result: Dict) -> str:
+        return self._assignment[trial.trial_id].on_trial_result(
+            controller, trial, result)
+
+
+class MedianStoppingRule(TrialScheduler):
+    """Stop a trial whose best result is worse than the median of running
+    averages at the same timestep (reference `median_stopping_rule.py`)."""
+
+    def __init__(self, metric: Optional[str] = None, mode: str = "max",
+                 time_attr: str = "training_iteration",
+                 grace_period: int = 1, min_samples_required: int = 3):
+        self.metric = metric
+        self.mode = mode
+        self.time_attr = time_attr
+        self.grace_period = grace_period
+        self.min_samples = min_samples_required
+        self._history: Dict[str, List[float]] = defaultdict(list)
+
+    def _val(self, result: Dict) -> float:
+        v = float(result[self.metric])
+        return v if self.mode == "max" else -v
+
+    def on_trial_result(self, controller, trial, result: Dict) -> str:
+        if self.metric not in result:
+            return self.CONTINUE
+        t = result.get(self.time_attr, 0)
+        self._history[trial.trial_id].append(self._val(result))
+        if t < self.grace_period or \
+                len(self._history) < self.min_samples:
+            return self.CONTINUE
+        avgs = [sum(h) / len(h) for tid, h in self._history.items()
+                if tid != trial.trial_id and h]
+        if len(avgs) + 1 < self.min_samples:
+            return self.CONTINUE
+        avgs.sort()
+        median = avgs[len(avgs) // 2]
+        best = max(self._history[trial.trial_id])
+        return self.STOP if best < median else self.CONTINUE
+
+
+class PopulationBasedTraining(TrialScheduler):
+    """PBT (reference `pbt.py:221`): every `perturbation_interval` steps,
+    bottom-quantile trials exploit a top-quantile donor's checkpoint and
+    explore a perturbed config. The controller supplies
+    `checkpoint_trial(trial)` and `exploit_trial(trial, config, ckpt)`.
+    """
+
+    def __init__(self, metric: Optional[str] = None, mode: str = "max",
+                 time_attr: str = "training_iteration",
+                 perturbation_interval: int = 4,
+                 hyperparam_mutations: Optional[Dict[str, Any]] = None,
+                 quantile_fraction: float = 0.25,
+                 resample_probability: float = 0.25,
+                 seed: Optional[int] = None):
+        self.metric = metric
+        self.mode = mode
+        self.time_attr = time_attr
+        self.interval = perturbation_interval
+        self.mutations = hyperparam_mutations or {}
+        self.quantile = quantile_fraction
+        self.resample_prob = resample_probability
+        self.rng = random.Random(seed)
+        self._last_perturb: Dict[str, int] = {}
+        self._latest: Dict[str, float] = {}
+        self._ckpts: Dict[str, str] = {}
+
+    def _val(self, result: Dict) -> float:
+        v = float(result[self.metric])
+        return v if self.mode == "max" else -v
+
+    def _mutate(self, config: Dict[str, Any]) -> Dict[str, Any]:
+        """Explore: perturb each mutation key by 0.8/1.2x or resample
+        (reference `pbt.py` `_explore`)."""
+        new = dict(config)
+        for key, spec in self.mutations.items():
+            if self.rng.random() < self.resample_prob or \
+                    key not in new or not isinstance(new[key], (int, float)):
+                if callable(spec):
+                    new[key] = spec()
+                elif isinstance(spec, list):
+                    new[key] = self.rng.choice(spec)
+                elif hasattr(spec, "sample"):
+                    new[key] = spec.sample(self.rng)
+            else:
+                factor = self.rng.choice([0.8, 1.2])
+                val = new[key] * factor
+                if isinstance(spec, list):
+                    # snap to nearest allowed value
+                    val = min(spec, key=lambda s: abs(s - val))
+                new[key] = type(config[key])(val) \
+                    if isinstance(config[key], int) else val
+        return new
+
+    def on_trial_result(self, controller, trial, result: Dict) -> str:
+        if self.metric not in result:
+            return self.CONTINUE
+        t = result.get(self.time_attr, 0)
+        tid = trial.trial_id
+        self._latest[tid] = self._val(result)
+        last = self._last_perturb.get(tid, 0)
+        if t - last < self.interval:
+            return self.CONTINUE
+        self._last_perturb[tid] = t
+        # refresh this trial's checkpoint so others can exploit it
+        try:
+            self._ckpts[tid] = controller.checkpoint_trial(trial)
+        except Exception:
+            pass
+        scores = sorted(self._latest.items(), key=lambda kv: kv[1])
+        n = len(scores)
+        if n < 2:
+            return self.CONTINUE
+        k = max(1, int(n * self.quantile))
+        bottom = {tid_ for tid_, _ in scores[:k]}
+        top = [tid_ for tid_, _ in scores[-k:]]
+        if tid in bottom:
+            donors = [d for d in top if d in self._ckpts and d != tid]
+            if donors:
+                donor = self.rng.choice(donors)
+                donor_trial = controller.get_trial(donor)
+                new_config = self._mutate(donor_trial.config)
+                controller.exploit_trial(trial, new_config,
+                                         self._ckpts[donor])
+        return self.CONTINUE

@@ -1,0 +1,197 @@
+"""Search spaces + searchers.
+
+Reference: `python/ray/tune/search/` — sample domains (`sample.py`),
+`BasicVariantGenerator` (`basic_variant.py` — grid/random resolution),
+`Searcher` ABC (`searcher.py`). Model-based searchers (Optuna/HyperOpt/…)
+are wrappers in the reference; here `Searcher` is the plug point and
+grid/random are built in.
+"""
+
+from __future__ import annotations
+
+import itertools
+import math
+import random
+from typing import Any, Dict, List, Optional
+
+
+# ---------------------------------------------------------------------------
+# sample domains (reference: python/ray/tune/search/sample.py)
+# ---------------------------------------------------------------------------
+
+
+class Domain:
+    def sample(self, rng: random.Random) -> Any:
+        raise NotImplementedError
+
+
+class Uniform(Domain):
+    def __init__(self, low: float, high: float):
+        self.low, self.high = low, high
+
+    def sample(self, rng):
+        return rng.uniform(self.low, self.high)
+
+
+class LogUniform(Domain):
+    def __init__(self, low: float, high: float):
+        assert low > 0 and high > 0
+        self.low, self.high = low, high
+
+    def sample(self, rng):
+        return math.exp(rng.uniform(math.log(self.low), math.log(self.high)))
+
+
+class QUniform(Domain):
+    def __init__(self, low: float, high: float, q: float):
+        self.low, self.high, self.q = low, high, q
+
+    def sample(self, rng):
+        return round(rng.uniform(self.low, self.high) / self.q) * self.q
+
+
+class Randint(Domain):
+    def __init__(self, low: int, high: int):
+        self.low, self.high = low, high
+
+    def sample(self, rng):
+        return rng.randrange(self.low, self.high)
+
+
+class Choice(Domain):
+    def __init__(self, categories: List[Any]):
+        self.categories = list(categories)
+
+    def sample(self, rng):
+        return rng.choice(self.categories)
+
+
+class GridSearch:
+    def __init__(self, values: List[Any]):
+        self.values = list(values)
+
+
+class sample_from:  # noqa: N801 — matches the reference's API name
+    """Explicit lazy-evaluated config value (reference
+    `tune/search/sample.py` `sample_from`). Bare callables in a
+    param_space are treated as constants."""
+
+    def __init__(self, fn):
+        self.fn = fn
+
+
+def uniform(low, high) -> Uniform:
+    return Uniform(low, high)
+
+
+def loguniform(low, high) -> LogUniform:
+    return LogUniform(low, high)
+
+
+def quniform(low, high, q) -> QUniform:
+    return QUniform(low, high, q)
+
+
+def randint(low, high) -> Randint:
+    return Randint(low, high)
+
+
+def choice(categories) -> Choice:
+    return Choice(categories)
+
+
+def grid_search(values) -> GridSearch:
+    return GridSearch(values)
+
+
+# ---------------------------------------------------------------------------
+# searchers
+# ---------------------------------------------------------------------------
+
+
+class Searcher:
+    """Reference: `python/ray/tune/search/searcher.py`."""
+
+    def __init__(self, metric: Optional[str] = None, mode: str = "max"):
+        self.metric = metric
+        self.mode = mode
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        raise NotImplementedError
+
+    def on_trial_result(self, trial_id: str, result: Dict[str, Any]) -> None:
+        pass
+
+    def on_trial_complete(self, trial_id: str,
+                          result: Optional[Dict[str, Any]] = None,
+                          error: bool = False) -> None:
+        pass
+
+
+class BasicVariantGenerator(Searcher):
+    """Grid x random resolution of a param_space.
+
+    Reference: `python/ray/tune/search/basic_variant.py` — grid values
+    produce the cross product; Domain leaves are sampled per variant;
+    `num_samples` repeats the whole sweep.
+    """
+
+    def __init__(self, param_space: Dict[str, Any], num_samples: int = 1,
+                 seed: Optional[int] = None):
+        super().__init__()
+        self.param_space = param_space
+        self.num_samples = num_samples
+        self.rng = random.Random(seed)
+        self._variants = self._generate()
+        self._i = 0
+
+    def _generate(self) -> List[Dict[str, Any]]:
+        grid_keys: List[str] = []
+        grid_vals: List[List[Any]] = []
+
+        def find_grids(prefix: str, space: Dict[str, Any]):
+            for k, v in space.items():
+                path = f"{prefix}.{k}" if prefix else k
+                if isinstance(v, GridSearch):
+                    grid_keys.append(path)
+                    grid_vals.append(v.values)
+                elif isinstance(v, dict):
+                    find_grids(path, v)
+
+        find_grids("", self.param_space)
+        combos = list(itertools.product(*grid_vals)) if grid_keys else [()]
+        variants = []
+        for _ in range(self.num_samples):
+            for combo in combos:
+                overrides = dict(zip(grid_keys, combo))
+                variants.append(self._resolve("", self.param_space,
+                                              overrides))
+        return variants
+
+    def _resolve(self, prefix: str, space: Dict[str, Any],
+                 overrides: Dict[str, Any]) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        for k, v in space.items():
+            path = f"{prefix}.{k}" if prefix else k
+            if isinstance(v, GridSearch):
+                out[k] = overrides[path]
+            elif isinstance(v, Domain):
+                out[k] = v.sample(self.rng)
+            elif isinstance(v, dict):
+                out[k] = self._resolve(path, v, overrides)
+            elif isinstance(v, sample_from):
+                out[k] = v.fn(out)
+            else:
+                out[k] = v
+        return out
+
+    @property
+    def total_trials(self) -> int:
+        return len(self._variants)
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        if self._i >= len(self._variants):
+            return None
+        cfg = self._variants[self._i]
+        self._i += 1
+        return cfg

@@ -1,0 +1,226 @@
+"""The Tune event loop.
+
+Reference: `python/ray/tune/execution/tune_controller.py:68` — one
+Trainable actor per trial; the controller pumps `step()` calls, feeds
+results to searcher/scheduler/stopper/loggers, restarts failed trials from
+their last checkpoint, and serves PBT's exploit hook.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.tune import experiment as exp
+from ray_tpu.tune.experiment import Trial
+from ray_tpu.tune.loggers import LoggerCallback
+from ray_tpu.tune.schedulers import TrialScheduler
+from ray_tpu.tune.search import Searcher
+from ray_tpu.tune.stopper import Stopper
+from ray_tpu.tune.trainable import _TrialActor
+
+
+class TuneController:
+    def __init__(
+        self,
+        trainable_cls: type,
+        *,
+        searcher: Searcher,
+        scheduler: TrialScheduler,
+        stopper: Stopper,
+        loggers: List[LoggerCallback],
+        experiment_dir: str,
+        max_concurrent: int = 0,
+        max_failures: int = 0,
+        trial_resources: Optional[Dict[str, float]] = None,
+        metric: Optional[str] = None,
+        mode: str = "max",
+    ):
+        self.trainable_cls = trainable_cls
+        self.searcher = searcher
+        self.scheduler = scheduler
+        self.stopper = stopper
+        self.loggers = loggers
+        self.experiment_dir = experiment_dir
+        self.max_concurrent = max_concurrent
+        self.max_failures = max_failures
+        self.trial_resources = trial_resources or {"CPU": 1.0}
+        self.metric = metric
+        self.mode = mode
+        if metric:
+            self.scheduler.set_metric(metric, mode)
+        self.trials: List[Trial] = []
+        self._actors: Dict[str, Any] = {}
+        self._pending_step: Dict[Any, str] = {}  # step ref -> trial_id
+        self._actor_cls = ray_tpu.remote(_TrialActor)
+
+    # -- public hooks used by schedulers (PBT) -----------------------------
+
+    def get_trial(self, trial_id: str) -> Trial:
+        for t in self.trials:
+            if t.trial_id == trial_id:
+                return t
+        raise KeyError(trial_id)
+
+    def checkpoint_trial(self, trial: Trial) -> str:
+        """Latest checkpoint path for a trial. Function trainables
+        checkpoint through report(); class trainables on demand."""
+        if trial.checkpoint_path:
+            return trial.checkpoint_path
+        actor = self._actors.get(trial.trial_id)
+        if actor is None:
+            raise RuntimeError(f"trial {trial.trial_id} has no live actor")
+        path = ray_tpu.get(actor.save.remote(), timeout=60)
+        trial.checkpoint_path = path
+        return path
+
+    def exploit_trial(self, trial: Trial, new_config: Dict[str, Any],
+                      checkpoint_path: str) -> None:
+        """PBT exploit/explore: restart `trial` from a donor checkpoint
+        with a mutated config (reference `pbt.py` `_exploit`)."""
+        self._stop_actor(trial, kill=True)
+        trial.config = new_config
+        trial.checkpoint_path = checkpoint_path
+        self._start_actor(trial, restore_from=checkpoint_path)
+
+    # -- actor management --------------------------------------------------
+
+    def _start_actor(self, trial: Trial, restore_from: Optional[str] = None):
+        res = dict(self.trial_resources)
+        num_cpus = res.pop("CPU", 1.0)
+        num_tpus = res.pop("TPU", None)
+        opts: Dict[str, Any] = dict(num_cpus=num_cpus, resources=res,
+                                    max_concurrency=2)
+        if num_tpus:
+            opts["num_tpus"] = num_tpus
+        actor = self._actor_cls.options(**opts).remote(
+            self.trainable_cls, trial.config, trial.trial_id,
+            trial.trial_dir, restore_from)
+        self._actors[trial.trial_id] = actor
+        trial.status = exp.RUNNING
+        ref = actor.step.remote()
+        self._pending_step[ref] = trial.trial_id
+
+    def _stop_actor(self, trial: Trial, kill: bool = False) -> None:
+        actor = self._actors.pop(trial.trial_id, None)
+        # drop any in-flight step ref for this trial
+        for ref, tid in list(self._pending_step.items()):
+            if tid == trial.trial_id:
+                del self._pending_step[ref]
+        if actor is None:
+            return
+        try:
+            if not kill:
+                ray_tpu.get(actor.stop.remote(), timeout=10)
+        except Exception:
+            pass
+        try:
+            ray_tpu.kill(actor)
+        except Exception:
+            pass
+
+    def _terminate(self, trial: Trial, status: str,
+                   error: Optional[str] = None) -> None:
+        self._stop_actor(trial, kill=(status == exp.ERROR))
+        trial.status = status
+        trial.error = error
+        self.searcher.on_trial_complete(
+            trial.trial_id, trial.last_result, error=(status == exp.ERROR))
+        self.scheduler.on_trial_complete(self, trial, trial.last_result or {})
+        for lg in self.loggers:
+            lg.on_trial_complete(trial)
+
+    # -- main loop ---------------------------------------------------------
+
+    def _make_trials(self) -> None:
+        while True:
+            t = Trial(config={}, resources=dict(self.trial_resources))
+            cfg = self.searcher.suggest(t.trial_id)
+            if cfg is None:
+                break
+            t.config = cfg
+            t.trial_dir = os.path.join(self.experiment_dir, t.trial_id)
+            self.trials.append(t)
+            self.scheduler.on_trial_add(self, t)
+
+    def run(self, timeout: Optional[float] = None) -> List[Trial]:
+        self._make_trials()
+        deadline = time.monotonic() + timeout if timeout else None
+        stop_all = False
+        while True:
+            # top up running actors
+            if not stop_all:
+                running = sum(1 for t in self.trials
+                              if t.status == exp.RUNNING)
+                for t in self.trials:
+                    if self.max_concurrent and \
+                            running >= self.max_concurrent:
+                        break
+                    if t.status == exp.PENDING:
+                        self._start_actor(
+                            t, restore_from=t.checkpoint_path)
+                        for lg in self.loggers:
+                            lg.on_trial_start(t)
+                        running += 1
+            if not self._pending_step:
+                break
+            if deadline and time.monotonic() > deadline:
+                for t in self.trials:
+                    if not t.is_finished:
+                        self._terminate(t, exp.TERMINATED)
+                break
+            ready, _ = ray_tpu.wait(
+                list(self._pending_step), num_returns=1, timeout=1.0)
+            if not ready:
+                continue
+            ref = ready[0]
+            trial_id = self._pending_step.pop(ref, None)
+            if trial_id is None:
+                continue
+            trial = self.get_trial(trial_id)
+            try:
+                result = ray_tpu.get(ref, timeout=30)
+            except Exception as e:  # worker died or train fn raised
+                trial.num_failures += 1
+                self._stop_actor(trial, kill=True)
+                if trial.num_failures <= self.max_failures or \
+                        self.max_failures < 0:
+                    trial.status = exp.PENDING  # restart from last ckpt
+                else:
+                    self._terminate(trial, exp.ERROR, error=str(e))
+                continue
+            if result.get("_trial_finished"):
+                self._terminate(trial, exp.TERMINATED)
+                continue
+            self._on_result(trial, result)
+            # A PBT exploit inside _on_result restarts the actor and
+            # enqueues its own first step — don't double-pump.
+            if trial.status == exp.RUNNING and \
+                    trial.trial_id not in self._pending_step.values():
+                actor = self._actors[trial.trial_id]
+                nref = actor.step.remote()
+                self._pending_step[nref] = trial.trial_id
+            if self.stopper.stop_all():
+                stop_all = True
+                for t in self.trials:
+                    if not t.is_finished:
+                        self._terminate(t, exp.TERMINATED)
+        return self.trials
+
+    def _on_result(self, trial: Trial, result: Dict[str, Any]) -> None:
+        ckpt = result.pop("_checkpoint_path", None)
+        if ckpt:
+            trial.checkpoint_path = ckpt
+        trial.last_result = result
+        trial.metrics_history.append(result)
+        for lg in self.loggers:
+            lg.on_trial_result(trial, result)
+        self.searcher.on_trial_result(trial.trial_id, result)
+        if self.stopper(trial.trial_id, result):
+            self._terminate(trial, exp.TERMINATED)
+            return
+        decision = self.scheduler.on_trial_result(self, trial, result)
+        if decision == TrialScheduler.STOP:
+            self._terminate(trial, exp.TERMINATED)

@@ -1,0 +1,144 @@
+"""Tuner + TuneConfig + ResultGrid.
+
+Reference: `python/ray/tune/tuner.py:44,344` (Tuner.fit),
+`python/ray/tune/tune_config.py` (TuneConfig),
+`python/ray/tune/result_grid.py` (ResultGrid).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import inspect
+import uuid
+from typing import Any, Callable, Dict, List, Optional, Union
+
+from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.air.config import RunConfig
+from ray_tpu.air.result import Result
+from ray_tpu.tune import experiment as exp
+from ray_tpu.tune.experiment import Trial
+from ray_tpu.tune.loggers import DEFAULT_LOGGERS
+from ray_tpu.tune.schedulers import FIFOScheduler, TrialScheduler
+from ray_tpu.tune.search import BasicVariantGenerator, Searcher
+from ray_tpu.tune.stopper import resolve_stop_criteria
+from ray_tpu.tune.trainable import Trainable, wrap_function
+from ray_tpu.tune.tune_controller import TuneController
+
+
+@dataclasses.dataclass
+class TuneConfig:
+    """Reference: `python/ray/tune/tune_config.py`."""
+
+    metric: Optional[str] = None
+    mode: str = "max"
+    num_samples: int = 1
+    max_concurrent_trials: int = 0
+    scheduler: Optional[TrialScheduler] = None
+    search_alg: Optional[Searcher] = None
+    trial_resources: Optional[Dict[str, float]] = None
+    time_budget_s: Optional[float] = None
+    seed: Optional[int] = None
+
+
+class ResultGrid:
+    """Reference: `python/ray/tune/result_grid.py`."""
+
+    def __init__(self, results: List[Result], trials: List[Trial]):
+        self._results = results
+        self._trials = trials
+
+    def __getitem__(self, i: int) -> Result:
+        return self._results[i]
+
+    def __len__(self) -> int:
+        return len(self._results)
+
+    def __iter__(self):
+        return iter(self._results)
+
+    @property
+    def errors(self) -> List[Exception]:
+        return [r.error for r in self._results if r.error is not None]
+
+    def get_best_result(self, metric: Optional[str] = None,
+                        mode: str = "max") -> Result:
+        candidates = [r for r in self._results if r.metrics]
+        if metric:
+            candidates = [r for r in candidates if metric in r.metrics]
+        if not candidates:
+            raise ValueError("no trial produced results"
+                             + (f" with metric {metric!r}" if metric else ""))
+        if metric is None:
+            return candidates[0]
+        sign = 1 if mode == "max" else -1
+        return max(candidates,
+                   key=lambda r: sign * float(r.metrics[metric]))
+
+
+class Tuner:
+    def __init__(
+        self,
+        trainable: Union[Callable, type, "Any"],
+        *,
+        param_space: Optional[Dict[str, Any]] = None,
+        tune_config: Optional[TuneConfig] = None,
+        run_config: Optional[RunConfig] = None,
+    ):
+        self.trainable = trainable
+        self.param_space = param_space or {}
+        self.tune_config = tune_config or TuneConfig()
+        self.run_config = run_config or RunConfig()
+
+    def _resolve_trainable(self) -> type:
+        t = self.trainable
+        if inspect.isclass(t) and issubclass(t, Trainable):
+            return t
+        if callable(t):
+            return wrap_function(t)
+        raise TypeError(f"invalid trainable: {t!r}")
+
+    def fit(self) -> ResultGrid:
+        import os
+
+        trainable_cls = self._resolve_trainable()
+        tc = self.tune_config
+        searcher = tc.search_alg or BasicVariantGenerator(
+            self.param_space, num_samples=tc.num_samples, seed=tc.seed)
+        scheduler = tc.scheduler or FIFOScheduler()
+        name = self.run_config.name or \
+            f"tune_{getattr(self.trainable, '__name__', 'exp')}_" \
+            f"{uuid.uuid4().hex[:6]}"
+        experiment_dir = os.path.join(self.run_config.storage_path, name)
+        os.makedirs(experiment_dir, exist_ok=True)
+        loggers = [cls() for cls in DEFAULT_LOGGERS]
+        if self.run_config.callbacks:
+            loggers.extend(self.run_config.callbacks)
+        resources = tc.trial_resources or \
+            getattr(trainable_cls, "_trainer_resources", None) or \
+            {"CPU": 1.0}
+        controller = TuneController(
+            trainable_cls,
+            searcher=searcher,
+            scheduler=scheduler,
+            stopper=resolve_stop_criteria(self.run_config.stop),
+            loggers=loggers,
+            experiment_dir=experiment_dir,
+            max_concurrent=tc.max_concurrent_trials,
+            max_failures=(self.run_config.failure_config.max_failures
+                          if self.run_config.failure_config else 0),
+            trial_resources=resources,
+            metric=tc.metric,
+            mode=tc.mode,
+        )
+        trials = controller.run(timeout=tc.time_budget_s)
+        results = []
+        for t in trials:
+            results.append(Result(
+                metrics=t.last_result,
+                checkpoint=(Checkpoint(t.checkpoint_path)
+                            if t.checkpoint_path else None),
+                error=(RuntimeError(t.error) if t.error else None),
+                path=t.trial_dir,
+                metrics_history=t.metrics_history,
+            ))
+        return ResultGrid(results, trials)
