@@ -12,7 +12,7 @@ from __future__ import annotations
 import math
 import random
 from collections import defaultdict
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Dict, List, Optional
 
 
 class TrialScheduler:
@@ -20,10 +20,15 @@ class TrialScheduler:
     STOP = "STOP"
 
     def set_metric(self, metric: str, mode: str) -> None:
+        """Fill in metric/mode from TuneConfig unless the scheduler was
+        constructed with explicit values."""
         if getattr(self, "metric", None) is None:
             self.metric = metric
         if getattr(self, "mode", None) is None:
             self.mode = mode
+
+    def _sign(self) -> int:
+        return 1 if (self.mode or "max") == "max" else -1
 
     def on_trial_add(self, controller, trial) -> None:
         pass
@@ -44,7 +49,8 @@ class AsyncHyperBandScheduler(TrialScheduler):
     halving — at each rung milestone a trial stops unless it is in the top
     1/reduction_factor of results recorded at that rung."""
 
-    def __init__(self, metric: Optional[str] = None, mode: str = "max",
+    def __init__(self, metric: Optional[str] = None,
+                 mode: Optional[str] = None,
                  time_attr: str = "training_iteration",
                  max_t: int = 100, grace_period: int = 1,
                  reduction_factor: float = 4, brackets: int = 1):
@@ -65,8 +71,7 @@ class AsyncHyperBandScheduler(TrialScheduler):
         self._trial_rung: Dict[str, int] = {}
 
     def _val(self, result: Dict) -> float:
-        v = float(result[self.metric])
-        return v if self.mode == "max" else -v
+        return self._sign() * float(result[self.metric])
 
     def on_trial_add(self, controller, trial) -> None:
         self._trial_rung[trial.trial_id] = 0
@@ -101,7 +106,8 @@ class HyperBandScheduler(TrialScheduler):
     simplification of the reference's synchronized brackets).
     """
 
-    def __init__(self, metric: Optional[str] = None, mode: str = "max",
+    def __init__(self, metric: Optional[str] = None,
+                 mode: Optional[str] = None,
                  time_attr: str = "training_iteration",
                  max_t: int = 81, reduction_factor: float = 3):
         self.metric = metric
@@ -137,7 +143,8 @@ class MedianStoppingRule(TrialScheduler):
     """Stop a trial whose best result is worse than the median of running
     averages at the same timestep (reference `median_stopping_rule.py`)."""
 
-    def __init__(self, metric: Optional[str] = None, mode: str = "max",
+    def __init__(self, metric: Optional[str] = None,
+                 mode: Optional[str] = None,
                  time_attr: str = "training_iteration",
                  grace_period: int = 1, min_samples_required: int = 3):
         self.metric = metric
@@ -148,8 +155,7 @@ class MedianStoppingRule(TrialScheduler):
         self._history: Dict[str, List[float]] = defaultdict(list)
 
     def _val(self, result: Dict) -> float:
-        v = float(result[self.metric])
-        return v if self.mode == "max" else -v
+        return self._sign() * float(result[self.metric])
 
     def on_trial_result(self, controller, trial, result: Dict) -> str:
         if self.metric not in result:
@@ -176,7 +182,8 @@ class PopulationBasedTraining(TrialScheduler):
     `checkpoint_trial(trial)` and `exploit_trial(trial, config, ckpt)`.
     """
 
-    def __init__(self, metric: Optional[str] = None, mode: str = "max",
+    def __init__(self, metric: Optional[str] = None,
+                 mode: Optional[str] = None,
                  time_attr: str = "training_iteration",
                  perturbation_interval: int = 4,
                  hyperparam_mutations: Optional[Dict[str, Any]] = None,
@@ -196,8 +203,7 @@ class PopulationBasedTraining(TrialScheduler):
         self._ckpts: Dict[str, str] = {}
 
     def _val(self, result: Dict) -> float:
-        v = float(result[self.metric])
-        return v if self.mode == "max" else -v
+        return self._sign() * float(result[self.metric])
 
     def _mutate(self, config: Dict[str, Any]) -> Dict[str, Any]:
         """Explore: perturb each mutation key by 0.8/1.2x or resample

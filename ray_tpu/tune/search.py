@@ -115,6 +115,18 @@ class Searcher:
     def __init__(self, metric: Optional[str] = None, mode: str = "max"):
         self.metric = metric
         self.mode = mode
+        self.param_space: Optional[Dict[str, Any]] = None
+
+    def set_search_properties(self, metric: Optional[str], mode: str,
+                              param_space: Dict[str, Any]) -> bool:
+        """Called by the Tuner before the run with the experiment's
+        metric/mode/param_space (reference Searcher contract)."""
+        if metric:
+            self.metric = metric
+        if mode:
+            self.mode = mode
+        self.param_space = param_space
+        return True
 
     def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
         raise NotImplementedError

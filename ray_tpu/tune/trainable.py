@@ -147,12 +147,14 @@ class _TrialActor:
 
     def __init__(self, trainable_cls: type, config: Dict[str, Any],
                  trial_id: str, trial_dir: str,
-                 restore_from: Optional[str] = None):
+                 restore_from: Optional[str] = None,
+                 start_iteration: int = 0):
         os.makedirs(trial_dir, exist_ok=True)
         self._trainable: Trainable = trainable_cls()
         self._trainable.trial_id = trial_id
         self._trainable.trial_dir = trial_dir
         self._trainable.config = config
+        self._trainable.iteration = start_iteration
         self._restore_from = restore_from
         self._setup_done = False
         self._config = config

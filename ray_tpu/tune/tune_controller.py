@@ -37,6 +37,7 @@ class TuneController:
         trial_resources: Optional[Dict[str, float]] = None,
         metric: Optional[str] = None,
         mode: str = "max",
+        max_trials: Optional[int] = None,
     ):
         self.trainable_cls = trainable_cls
         self.searcher = searcher
@@ -51,10 +52,12 @@ class TuneController:
         self.mode = mode
         if metric:
             self.scheduler.set_metric(metric, mode)
+        self.max_trials = max_trials
         self.trials: List[Trial] = []
         self._actors: Dict[str, Any] = {}
         self._pending_step: Dict[Any, str] = {}  # step ref -> trial_id
         self._actor_cls = ray_tpu.remote(_TrialActor)
+        self._searcher_done = False
 
     # -- public hooks used by schedulers (PBT) -----------------------------
 
@@ -66,8 +69,15 @@ class TuneController:
 
     def checkpoint_trial(self, trial: Trial) -> str:
         """Latest checkpoint path for a trial. Function trainables
-        checkpoint through report(); class trainables on demand."""
-        if trial.checkpoint_path:
+        checkpoint through report() (their save() would write an empty
+        dir); class trainables save on demand so the donor state is fresh."""
+        from ray_tpu.tune.trainable import FunctionTrainable
+        if issubclass(self.trainable_cls, FunctionTrainable):
+            if not trial.checkpoint_path:
+                raise RuntimeError(
+                    f"trial {trial.trial_id} has not reported a checkpoint; "
+                    "PBT with function trainables requires "
+                    "tune.report(..., checkpoint=...)")
             return trial.checkpoint_path
         actor = self._actors.get(trial.trial_id)
         if actor is None:
@@ -95,9 +105,12 @@ class TuneController:
                                     max_concurrency=2)
         if num_tpus:
             opts["num_tpus"] = num_tpus
+        # training_iteration continues across restarts (retry / PBT exploit)
+        start_iteration = (trial.last_result or {}).get(
+            "training_iteration", 0)
         actor = self._actor_cls.options(**opts).remote(
             self.trainable_cls, trial.config, trial.trial_id,
-            trial.trial_dir, restore_from)
+            trial.trial_dir, restore_from, start_iteration)
         self._actors[trial.trial_id] = actor
         trial.status = exp.RUNNING
         ref = actor.step.remote()
@@ -134,36 +147,46 @@ class TuneController:
 
     # -- main loop ---------------------------------------------------------
 
-    def _make_trials(self) -> None:
-        while True:
-            t = Trial(config={}, resources=dict(self.trial_resources))
-            cfg = self.searcher.suggest(t.trial_id)
-            if cfg is None:
-                break
-            t.config = cfg
-            t.trial_dir = os.path.join(self.experiment_dir, t.trial_id)
-            self.trials.append(t)
-            self.scheduler.on_trial_add(self, t)
+    def _suggest_next(self) -> Optional[Trial]:
+        """Lazily pull one new trial from the searcher (so adaptive
+        searchers see results before later suggests; reference controller
+        generates trials on demand, not upfront)."""
+        if self._searcher_done:
+            return None
+        if self.max_trials is not None and \
+                len(self.trials) >= self.max_trials:
+            return None
+        t = Trial(config={}, resources=dict(self.trial_resources))
+        cfg = self.searcher.suggest(t.trial_id)
+        if cfg is None:
+            self._searcher_done = True
+            return None
+        t.config = cfg
+        t.trial_dir = os.path.join(self.experiment_dir, t.trial_id)
+        self.trials.append(t)
+        self.scheduler.on_trial_add(self, t)
+        return t
+
+    def _fill_slots(self) -> None:
+        running = sum(1 for t in self.trials if t.status == exp.RUNNING)
+        while not self.max_concurrent or running < self.max_concurrent:
+            trial = next((t for t in self.trials
+                          if t.status == exp.PENDING), None)
+            if trial is None:
+                trial = self._suggest_next()
+            if trial is None:
+                return
+            self._start_actor(trial, restore_from=trial.checkpoint_path)
+            for lg in self.loggers:
+                lg.on_trial_start(trial)
+            running += 1
 
     def run(self, timeout: Optional[float] = None) -> List[Trial]:
-        self._make_trials()
         deadline = time.monotonic() + timeout if timeout else None
         stop_all = False
         while True:
-            # top up running actors
             if not stop_all:
-                running = sum(1 for t in self.trials
-                              if t.status == exp.RUNNING)
-                for t in self.trials:
-                    if self.max_concurrent and \
-                            running >= self.max_concurrent:
-                        break
-                    if t.status == exp.PENDING:
-                        self._start_actor(
-                            t, restore_from=t.checkpoint_path)
-                        for lg in self.loggers:
-                            lg.on_trial_start(t)
-                        running += 1
+                self._fill_slots()
             if not self._pending_step:
                 break
             if deadline and time.monotonic() > deadline:

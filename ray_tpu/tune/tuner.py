@@ -15,7 +15,6 @@ from typing import Any, Callable, Dict, List, Optional, Union
 from ray_tpu.air.checkpoint import Checkpoint
 from ray_tpu.air.config import RunConfig
 from ray_tpu.air.result import Result
-from ray_tpu.tune import experiment as exp
 from ray_tpu.tune.experiment import Trial
 from ray_tpu.tune.loggers import DEFAULT_LOGGERS
 from ray_tpu.tune.schedulers import FIFOScheduler, TrialScheduler
@@ -102,8 +101,17 @@ class Tuner:
 
         trainable_cls = self._resolve_trainable()
         tc = self.tune_config
-        searcher = tc.search_alg or BasicVariantGenerator(
-            self.param_space, num_samples=tc.num_samples, seed=tc.seed)
+        if tc.search_alg is not None:
+            searcher = tc.search_alg
+            searcher.set_search_properties(tc.metric, tc.mode,
+                                           self.param_space)
+            # num_samples bounds custom searchers (reference semantics);
+            # BasicVariantGenerator is self-limiting instead
+            max_trials = tc.num_samples
+        else:
+            searcher = BasicVariantGenerator(
+                self.param_space, num_samples=tc.num_samples, seed=tc.seed)
+            max_trials = None
         scheduler = tc.scheduler or FIFOScheduler()
         name = self.run_config.name or \
             f"tune_{getattr(self.trainable, '__name__', 'exp')}_" \
@@ -129,6 +137,7 @@ class Tuner:
             trial_resources=resources,
             metric=tc.metric,
             mode=tc.mode,
+            max_trials=max_trials,
         )
         trials = controller.run(timeout=tc.time_budget_s)
         results = []

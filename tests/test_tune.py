@@ -202,3 +202,63 @@ def test_train_runs_on_tune(storage):
     result = trainer.fit()
     assert result.metrics["step"] == 1
     assert result.error is None
+
+
+def test_min_mode_propagates_to_scheduler(storage):
+    """TuneConfig(mode='min') must reach the scheduler (ASHA keeps the
+    LOWEST-loss trials)."""
+    def objective(config):
+        for i in range(10):
+            tune.report({"loss": config["l"] * (i + 1),
+                         "training_iteration": i + 1})
+
+    sched = tune.AsyncHyperBandScheduler(max_t=10, grace_period=2,
+                                         reduction_factor=2)
+    tuner = tune.Tuner(
+        objective,
+        # low-loss (good) trials first so ASHA culls the later bad ones
+        param_space={"l": tune.grid_search([0.1, 0.2, 5.0, 10.0])},
+        tune_config=tune.TuneConfig(metric="loss", mode="min",
+                                    scheduler=sched,
+                                    max_concurrent_trials=2),
+        run_config=RunConfig(storage_path=storage, name="min_mode"),
+    )
+    grid = tuner.fit()
+    by_l = {r.metrics_history[0]["loss"]: len(r.metrics_history)
+            for r in grid}
+    # the high-loss trials must have been stopped early
+    assert min(len(r.metrics_history) for r in grid) < 10
+    # and a low-loss trial survived to the end
+    assert by_l[0.1] >= 9
+
+
+def test_adaptive_searcher_sees_results(storage):
+    """Custom searcher contract: suggests are lazy, so results from early
+    trials can shape later suggestions."""
+    class Adaptive(tune.Searcher):
+        def __init__(self):
+            super().__init__(metric="score", mode="max")
+            self.observed = []
+
+        def suggest(self, trial_id):
+            if not self.observed:
+                return {"x": 1.0}
+            return {"x": max(self.observed) + 1.0}
+
+        def on_trial_complete(self, trial_id, result=None, error=False):
+            if result:
+                self.observed.append(result["score"])
+
+    def objective(config):
+        tune.report({"score": config["x"]})
+
+    tuner = tune.Tuner(
+        objective,
+        tune_config=tune.TuneConfig(num_samples=3, max_concurrent_trials=1,
+                                    search_alg=Adaptive(),
+                                    metric="score", mode="max"),
+        run_config=RunConfig(storage_path=storage, name="adaptive"),
+    )
+    grid = tuner.fit()
+    scores = sorted(r.metrics["score"] for r in grid)
+    assert scores == [1.0, 2.0, 3.0]  # each suggest built on the last
