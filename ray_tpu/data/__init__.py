@@ -1,0 +1,112 @@
+"""ray_tpu.data — streaming distributed datasets.
+
+Reference: `python/ray/data/` (SURVEY.md §2.4): lazy logical plan →
+fusion optimizer → streaming execution over ray_tpu tasks, with columnar
+numpy blocks (jax-ready) in the shared-memory object store.
+"""
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.data import logical as _L
+from ray_tpu.data.block import Block, BlockAccessor
+from ray_tpu.data.context import DataContext
+from ray_tpu.data.dataset import Dataset, GroupedData
+from ray_tpu.data.datasource import (
+    BinaryDatasource,
+    CSVDatasource,
+    Datasource,
+    ImageDatasource,
+    ItemsDatasource,
+    JSONDatasource,
+    NumpyDatasource,
+    ParquetDatasource,
+    RangeDatasource,
+    TextDatasource,
+)
+from ray_tpu.data.iterator import DataIterator
+
+
+def _default_parallelism() -> int:
+    return DataContext.get_current().read_parallelism
+
+
+def read_datasource(ds: Datasource,
+                    parallelism: Optional[int] = None) -> Dataset:
+    return Dataset(_L.Read(ds, parallelism or _default_parallelism()))
+
+
+def range(n: int, *, parallelism: Optional[int] = None) -> Dataset:  # noqa: A001
+    return read_datasource(RangeDatasource(n), parallelism)
+
+
+def range_tensor(n: int, *, shape: tuple = (1,),
+                 parallelism: Optional[int] = None) -> Dataset:
+    return read_datasource(RangeDatasource(n, tensor_shape=shape),
+                           parallelism)
+
+
+def from_items(items: List[Any],
+               parallelism: Optional[int] = None) -> Dataset:
+    return read_datasource(ItemsDatasource(items), parallelism)
+
+
+def from_numpy(arrays, parallelism: Optional[int] = None) -> Dataset:
+    if isinstance(arrays, np.ndarray):
+        arrays = {"data": arrays}
+    return read_datasource(NumpyDatasource(arrays), parallelism)
+
+
+def from_pandas(df, parallelism: Optional[int] = None) -> Dataset:
+    return read_datasource(
+        NumpyDatasource(BlockAccessor.from_pandas(df)), parallelism)
+
+
+def read_csv(paths, parallelism: Optional[int] = None) -> Dataset:
+    return read_datasource(CSVDatasource(paths), parallelism)
+
+
+def read_json(paths, parallelism: Optional[int] = None) -> Dataset:
+    return read_datasource(JSONDatasource(paths), parallelism)
+
+
+def read_parquet(paths, parallelism: Optional[int] = None) -> Dataset:
+    return read_datasource(ParquetDatasource(paths), parallelism)
+
+
+def read_text(paths, parallelism: Optional[int] = None) -> Dataset:
+    return read_datasource(TextDatasource(paths), parallelism)
+
+
+def read_binary_files(paths, parallelism: Optional[int] = None) -> Dataset:
+    return read_datasource(BinaryDatasource(paths), parallelism)
+
+
+def read_images(paths, *, size: Optional[tuple] = None, mode: str = "RGB",
+                parallelism: Optional[int] = None) -> Dataset:
+    return read_datasource(ImageDatasource(paths, size=size, mode=mode),
+                           parallelism)
+
+
+__all__ = [
+    "Block",
+    "BlockAccessor",
+    "DataContext",
+    "DataIterator",
+    "Dataset",
+    "Datasource",
+    "GroupedData",
+    "from_items",
+    "from_numpy",
+    "from_pandas",
+    "range",
+    "range_tensor",
+    "read_binary_files",
+    "read_csv",
+    "read_datasource",
+    "read_images",
+    "read_json",
+    "read_parquet",
+    "read_text",
+]

@@ -1,0 +1,31 @@
+"""Global data-execution tunables (reference:
+`python/ray/data/context.py:141` DataContext)."""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Optional
+
+
+@dataclasses.dataclass
+class DataContext:
+    target_max_block_size: int = 128 * 1024 * 1024
+    target_min_block_size: int = 1 * 1024 * 1024
+    # concurrency cap for the streaming executor — the default
+    # backpressure policy (reference ConcurrencyCapBackpressurePolicy)
+    max_concurrent_tasks: int = 8
+    default_batch_size: int = 1024
+    read_parallelism: int = 8
+    shuffle_partitions: Optional[int] = None
+    eager_free: bool = True
+
+    _instance = None
+    _lock = threading.Lock()
+
+    @classmethod
+    def get_current(cls) -> "DataContext":
+        with cls._lock:
+            if cls._instance is None:
+                cls._instance = cls()
+            return cls._instance

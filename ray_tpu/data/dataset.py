@@ -1,0 +1,233 @@
+"""Dataset: the lazy, distributed dataset facade.
+
+Reference: `python/ray/data/dataset.py:137` — transformations append
+logical ops; execution happens at consumption (iteration, count, take,
+write) through the streaming executor. `ExecutionPlan` here is simply the
+logical-op chain plus a cached materialization
+(reference `_internal/plan.py:37`).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data import logical as L
+from ray_tpu.data.block import Block, BlockAccessor
+from ray_tpu.data.context import DataContext
+from ray_tpu.data.datasource import (
+    write_block_csv,
+    write_block_json,
+    write_block_parquet,
+)
+from ray_tpu.data.executor import StreamingExecutor, _count_rows
+from ray_tpu.data.iterator import DataIterator
+
+
+class Dataset:
+    def __init__(self, op: L.LogicalOp):
+        self._op = op
+        self._materialized: Optional[List[Any]] = None
+
+    # -- plan building (lazy) ----------------------------------------------
+
+    def _derive(self, op: L.LogicalOp) -> "Dataset":
+        return Dataset(op)
+
+    def map(self, fn: Callable[[Dict], Dict]) -> "Dataset":
+        return self._derive(L.MapRows(self._op, fn))
+
+    def map_batches(self, fn: Callable, *, batch_size: Optional[int] = None,
+                    fn_args: tuple = (),
+                    fn_kwargs: Optional[dict] = None) -> "Dataset":
+        return self._derive(L.MapBatches(self._op, fn, batch_size,
+                                         fn_args, fn_kwargs))
+
+    def filter(self, fn: Callable[[Dict], bool]) -> "Dataset":
+        return self._derive(L.Filter(self._op, fn))
+
+    def flat_map(self, fn: Callable[[Dict], List[Dict]]) -> "Dataset":
+        return self._derive(L.FlatMap(self._op, fn))
+
+    def add_column(self, col: str, fn: Callable) -> "Dataset":
+        return self._derive(L.AddColumn(self._op, col, fn))
+
+    def drop_columns(self, cols: List[str]) -> "Dataset":
+        return self._derive(L.DropColumns(self._op, cols))
+
+    def select_columns(self, cols: List[str]) -> "Dataset":
+        return self._derive(L.SelectColumns(self._op, cols))
+
+    def limit(self, n: int) -> "Dataset":
+        return self._derive(L.Limit(self._op, n))
+
+    def repartition(self, n: int) -> "Dataset":
+        return self._derive(L.Repartition(self._op, n))
+
+    def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
+        return self._derive(L.RandomShuffle(self._op, seed))
+
+    def sort(self, key: str, descending: bool = False) -> "Dataset":
+        return self._derive(L.Sort(self._op, key, descending))
+
+    def union(self, *others: "Dataset") -> "Dataset":
+        return self._derive(L.Union([self._op] + [o._op for o in others]))
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        return self._derive(L.Zip(self._op, other._op))
+
+    def groupby(self, key: Optional[str]) -> "GroupedData":
+        return GroupedData(self, key)
+
+    # -- execution ---------------------------------------------------------
+
+    def _execute(self) -> List[Any]:
+        if self._materialized is None:
+            self._materialized = StreamingExecutor().execute(self._op)
+        return self._materialized
+
+    def materialize(self) -> "Dataset":
+        refs = self._execute()
+        ds = Dataset(L.InputBlocks(refs))
+        ds._materialized = refs
+        return ds
+
+    def num_blocks(self) -> int:
+        return len(self._execute())
+
+    def count(self) -> int:
+        refs = self._execute()
+        if not refs:
+            return 0
+        # fresh RemoteFunction per call: a cached one would hold a function
+        # key from a previous cluster across shutdown()/init() cycles
+        rf = ray_tpu.remote(_count_rows)
+        return int(sum(ray_tpu.get([rf.remote(b) for b in refs],
+                                   timeout=600)))
+
+    def schema(self) -> Dict[str, str]:
+        for block in DataIterator(self._execute())._iter_blocks():
+            if BlockAccessor(block).num_rows():
+                return BlockAccessor(block).schema()
+        return {}
+
+    def take(self, n: int = 20) -> List[Dict[str, Any]]:
+        out: List[Dict[str, Any]] = []
+        for row in DataIterator(self._execute()).iter_rows():
+            out.append(row)
+            if len(out) >= n:
+                break
+        return out
+
+    def take_all(self) -> List[Dict[str, Any]]:
+        return list(DataIterator(self._execute()).iter_rows())
+
+    def show(self, n: int = 20) -> None:
+        for row in self.take(n):
+            print(row)
+
+    def to_pandas(self):
+        return BlockAccessor(
+            DataIterator(self._execute()).materialize_numpy()).to_pandas()
+
+    def to_numpy(self) -> Block:
+        return DataIterator(self._execute()).materialize_numpy()
+
+    # -- consumption -------------------------------------------------------
+
+    def iter_rows(self) -> Iterator[Dict[str, Any]]:
+        return DataIterator(self._execute()).iter_rows()
+
+    def iter_batches(self, **kwargs) -> Iterator[Any]:
+        return DataIterator(self._execute()).iter_batches(**kwargs)
+
+    def iterator(self) -> DataIterator:
+        return DataIterator(self._execute())
+
+    def split(self, n: int) -> List["Dataset"]:
+        """Split into n datasets by round-robin over blocks (reference
+        `Dataset.split`). Repartitions first if fewer blocks than splits."""
+        refs = self._execute()
+        if len(refs) < n:
+            # repartition the materialized blocks, not the original plan —
+            # re-running the upstream pipeline would double all its work
+            refs = Dataset(L.InputBlocks(refs)).repartition(n)._execute()
+        shards = [refs[i::n] for i in range(n)]
+        out = []
+        for s in shards:
+            ds = Dataset(L.InputBlocks(s))
+            ds._materialized = s
+            out.append(ds)
+        return out
+
+    def streaming_split(self, n: int) -> List[DataIterator]:
+        """Per-train-worker iterators (reference
+        `StreamSplitDataIterator`, `stream_split_iterator.py:32`)."""
+        return [DataIterator(ds._execute()) for ds in self.split(n)]
+
+    # -- writes ------------------------------------------------------------
+
+    def _write(self, path: str, ext: str, write_fn) -> List[str]:
+        os.makedirs(path, exist_ok=True)
+        refs = self._execute()
+        rf = ray_tpu.remote(_make_writer(write_fn))
+        outs = [os.path.join(path, f"part_{i:05d}.{ext}")
+                for i in range(len(refs))]
+        ray_tpu.get([rf.remote(b, p) for b, p in zip(refs, outs)],
+                    timeout=600)
+        return outs
+
+    def write_csv(self, path: str) -> List[str]:
+        return self._write(path, "csv", write_block_csv)
+
+    def write_json(self, path: str) -> List[str]:
+        return self._write(path, "json", write_block_json)
+
+    def write_parquet(self, path: str) -> List[str]:
+        return self._write(path, "parquet", write_block_parquet)
+
+    def __repr__(self) -> str:
+        return f"Dataset(op={self._op.name})"
+
+
+def _make_writer(write_fn):
+    def write(block, path):
+        write_fn(block, path)
+        return path
+    return write
+
+
+class GroupedData:
+    """Reference: `python/ray/data/grouped_data.py`."""
+
+    def __init__(self, ds: Dataset, key: Optional[str]):
+        self._ds = ds
+        self._key = key
+
+    def _agg(self, aggs: List[Tuple[str, Optional[str], str]]) -> Dataset:
+        return self._ds._derive(
+            L.GroupByAggregate(self._ds._op, self._key, aggs))
+
+    def count(self) -> Dataset:
+        return self._agg([("count", None, "count()")])
+
+    def sum(self, on: str) -> Dataset:
+        return self._agg([("sum", on, f"sum({on})")])
+
+    def min(self, on: str) -> Dataset:
+        return self._agg([("min", on, f"min({on})")])
+
+    def max(self, on: str) -> Dataset:
+        return self._agg([("max", on, f"max({on})")])
+
+    def mean(self, on: str) -> Dataset:
+        return self._agg([("mean", on, f"mean({on})")])
+
+    def std(self, on: str) -> Dataset:
+        return self._agg([("std", on, f"std({on})")])
+
+    def aggregate(self, *aggs: Tuple[str, Optional[str], str]) -> Dataset:
+        return self._agg(list(aggs))

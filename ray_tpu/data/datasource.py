@@ -1,0 +1,207 @@
+"""Datasources & sinks.
+
+Reference: `python/ray/data/datasource/` (~35 sources). Each datasource
+yields `ReadTask`s — serializable zero-arg callables returning one block —
+which the executor runs as ray_tpu tasks (reference
+`datasource.py` ReadTask protocol).
+"""
+
+from __future__ import annotations
+
+import glob as glob_mod
+import json
+import os
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.data.block import Block, BlockAccessor
+
+ReadTask = Callable[[], Block]
+
+
+class Datasource:
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        raise NotImplementedError
+
+    def estimate_inmemory_data_size(self) -> Optional[int]:
+        return None
+
+
+def _expand_paths(paths) -> List[str]:
+    if isinstance(paths, str):
+        paths = [paths]
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            out.extend(sorted(
+                f for f in glob_mod.glob(os.path.join(p, "**", "*"),
+                                         recursive=True)
+                if os.path.isfile(f)))
+        elif any(c in p for c in "*?["):
+            out.extend(sorted(glob_mod.glob(p)))
+        else:
+            out.append(p)
+    if not out:
+        raise FileNotFoundError(f"no files match {paths}")
+    return out
+
+
+class RangeDatasource(Datasource):
+    def __init__(self, n: int, tensor_shape: Optional[tuple] = None):
+        self.n = n
+        self.tensor_shape = tensor_shape
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        n, shape = self.n, self.tensor_shape
+        parallelism = max(1, min(parallelism, n)) if n else 1
+        chunk = (n + parallelism - 1) // parallelism if n else 0
+        tasks: List[ReadTask] = []
+        for i in range(parallelism):
+            lo, hi = i * chunk, min((i + 1) * chunk, n)
+            if lo >= hi:
+                break
+
+            def read(lo=lo, hi=hi) -> Block:
+                ids = np.arange(lo, hi, dtype=np.int64)
+                if shape is None:
+                    return {"id": ids}
+                data = np.stack([np.full(shape, v, dtype=np.int64)
+                                 for v in ids]) if hi > lo else \
+                    np.zeros((0,) + shape, dtype=np.int64)
+                return {"data": data}
+
+            tasks.append(read)
+        return tasks
+
+    def estimate_inmemory_data_size(self):
+        per = 8 if self.tensor_shape is None else \
+            8 * int(np.prod(self.tensor_shape))
+        return self.n * per
+
+
+class ItemsDatasource(Datasource):
+    def __init__(self, items: List[Any]):
+        self.items = items
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        items = self.items
+        n = len(items)
+        parallelism = max(1, min(parallelism, n)) if n else 1
+        chunk = (n + parallelism - 1) // parallelism if n else 0
+        tasks = []
+        for i in range(parallelism):
+            part = items[i * chunk:(i + 1) * chunk]
+            if not part:
+                break
+            tasks.append(lambda part=part: BlockAccessor.from_items(part))
+        return tasks
+
+
+class NumpyDatasource(Datasource):
+    def __init__(self, arrays: Dict[str, np.ndarray]):
+        self.arrays = arrays
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        n = len(next(iter(self.arrays.values())))
+        parallelism = max(1, min(parallelism, n)) if n else 1
+        chunk = (n + parallelism - 1) // parallelism if n else 0
+        tasks = []
+        for i in range(parallelism):
+            part = {k: v[i * chunk:(i + 1) * chunk]
+                    for k, v in self.arrays.items()}
+            if not len(next(iter(part.values()))):
+                break
+            tasks.append(lambda part=part: part)
+        return tasks
+
+
+class _FileDatasource(Datasource):
+    """One read task per file (reference FileBasedDatasource)."""
+
+    def __init__(self, paths):
+        self.paths = _expand_paths(paths)
+
+    def _read_file(self, path: str) -> Block:
+        raise NotImplementedError
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        read_file = self._read_file
+        return [lambda p=p: read_file(p) for p in self.paths]
+
+
+class CSVDatasource(_FileDatasource):
+    def _read_file(self, path: str) -> Block:
+        import pandas as pd
+        return BlockAccessor.from_pandas(pd.read_csv(path))
+
+
+class JSONDatasource(_FileDatasource):
+    """JSONL or a top-level JSON array per file."""
+
+    def _read_file(self, path: str) -> Block:
+        with open(path) as f:
+            first = f.read(1)
+            f.seek(0)
+            if first == "[":
+                rows = json.load(f)
+            else:
+                rows = [json.loads(line) for line in f if line.strip()]
+        return BlockAccessor.from_rows(rows)
+
+
+class ParquetDatasource(_FileDatasource):
+    def _read_file(self, path: str) -> Block:
+        import pyarrow.parquet as pq
+        return BlockAccessor.from_arrow(pq.read_table(path))
+
+
+class TextDatasource(_FileDatasource):
+    def _read_file(self, path: str) -> Block:
+        with open(path) as f:
+            lines = [ln.rstrip("\n") for ln in f]
+        return {"text": np.asarray(lines, dtype=object)}
+
+
+class BinaryDatasource(_FileDatasource):
+    def _read_file(self, path: str) -> Block:
+        with open(path, "rb") as f:
+            data = f.read()
+        arr = np.empty(1, dtype=object)
+        arr[0] = data
+        return {"bytes": arr, "path": np.asarray([path], dtype=object)}
+
+
+class ImageDatasource(_FileDatasource):
+    def __init__(self, paths, size: Optional[tuple] = None,
+                 mode: str = "RGB"):
+        super().__init__(paths)
+        self.size = size
+        self.mode = mode
+
+    def _read_file(self, path: str) -> Block:
+        from PIL import Image
+        img = Image.open(path).convert(self.mode)
+        if self.size:
+            img = img.resize(self.size)
+        return {"image": np.expand_dims(np.asarray(img), 0),
+                "path": np.asarray([path], dtype=object)}
+
+
+# ---------------------------------------------------------------------------
+# sinks
+# ---------------------------------------------------------------------------
+
+
+def write_block_csv(block: Block, path: str) -> None:
+    BlockAccessor(block).to_pandas().to_csv(path, index=False)
+
+
+def write_block_json(block: Block, path: str) -> None:
+    df = BlockAccessor(block).to_pandas()
+    df.to_json(path, orient="records", lines=True)
+
+
+def write_block_parquet(block: Block, path: str) -> None:
+    import pyarrow.parquet as pq
+    pq.write_table(BlockAccessor(block).to_arrow(), path)

@@ -1,0 +1,345 @@
+"""Streaming plan executor.
+
+Reference: `python/ray/data/_internal/execution/streaming_executor.py:48` +
+`streaming_executor_state.py:165` (pull-based OpState loop with
+backpressure) and `_internal/planner/exchange/` (shuffle/sort exchanges).
+
+Execution here is ray_tpu tasks over block refs with a bounded in-flight
+window per stage (the ConcurrencyCap backpressure policy); all-to-all ops
+(repartition/shuffle/sort/groupby) are two-stage map/reduce exchanges like
+the reference's push-based shuffle.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data import logical as L
+from ray_tpu.data.block import Block, BlockAccessor
+from ray_tpu.data.context import DataContext
+
+
+# ---------------------------------------------------------------------------
+# remote task bodies (module-level so they pickle by reference-by-value once)
+# ---------------------------------------------------------------------------
+
+
+def _run_read(read_task) -> Block:
+    return read_task()
+
+
+def _run_transform(transform, block: Block) -> Block:
+    return transform(block)
+
+
+def _count_rows(block: Block) -> int:
+    return BlockAccessor(block).num_rows()
+
+
+def _slice_block(block: Block, start: int, end: int) -> Block:
+    return BlockAccessor(block).slice(start, end)
+
+
+def _split_for_partition(block: Block, assign_fn, p: int) -> List[Block]:
+    """Map side of an exchange: split one block into p partition pieces."""
+    acc = BlockAccessor(block)
+    n = acc.num_rows()
+    if n == 0:
+        return [dict() for _ in range(p)]
+    assignment = assign_fn(acc)
+    return [acc.take(np.nonzero(assignment == i)[0]) for i in range(p)]
+
+
+def _reduce_concat(*parts: Block) -> Block:
+    return BlockAccessor.concat(list(parts))
+
+
+def _reduce_shuffle(seed: Optional[int], part_idx: int = 0,
+                    *parts: Block) -> Block:
+    block = BlockAccessor.concat(list(parts))
+    acc = BlockAccessor(block)
+    n = acc.num_rows()
+    if n == 0:
+        return block
+    rng = np.random.default_rng(None if seed is None else seed + part_idx)
+    return acc.take(rng.permutation(n))
+
+
+def _reduce_sort(key: str, descending: bool, *parts: Block) -> Block:
+    block = BlockAccessor.concat(list(parts))
+    acc = BlockAccessor(block)
+    if acc.num_rows() == 0:
+        return block
+    order = np.argsort(block[key], kind="stable")
+    if descending:
+        order = order[::-1]
+    return acc.take(order)
+
+
+def _sample_block(block: Block, key: str, k: int) -> np.ndarray:
+    acc = BlockAccessor(block)
+    n = acc.num_rows()
+    if n == 0:
+        return np.asarray([])
+    idx = np.random.default_rng(0).choice(n, size=min(k, n), replace=False)
+    return np.asarray(block[key])[idx]
+
+
+_AGG_FNS = {
+    "count": lambda v: len(v),
+    "sum": lambda v: np.sum(v),
+    "min": lambda v: np.min(v),
+    "max": lambda v: np.max(v),
+    "mean": lambda v: np.mean(v),
+    "std": lambda v: np.std(v),
+}
+
+
+def _reduce_groupby(key: Optional[str], aggs, *parts: Block) -> Block:
+    block = BlockAccessor.concat(list(parts))
+    acc = BlockAccessor(block)
+    if acc.num_rows() == 0:
+        return {}
+    if key is None:
+        row: Dict[str, Any] = {}
+        for agg_name, on, out_name in aggs:
+            vals = block[on] if on else next(iter(block.values()))
+            row[out_name] = _AGG_FNS[agg_name](vals)
+        return BlockAccessor.from_rows([row])
+    keys = block[key]
+    uniq = np.unique(keys)
+    rows = []
+    for kv in uniq:
+        mask = keys == kv
+        row = {key: kv}
+        for agg_name, on, out_name in aggs:
+            vals = (block[on] if on else keys)[mask]
+            row[out_name] = _AGG_FNS[agg_name](vals)
+        rows.append(row)
+    return BlockAccessor.from_rows(rows)
+
+
+def _zip_blocks(left: Block, right: Block) -> Block:
+    nl = BlockAccessor(left).num_rows()
+    nr = BlockAccessor(right).num_rows()
+    if nl != nr:
+        raise ValueError(
+            f"zip requires equal rows per paired block ({nl} vs {nr}); "
+            "repartition both datasets to aligned blocks first")
+    out = dict(left)
+    for k, v in right.items():
+        out[k if k not in out else f"{k}_1"] = v
+    return out
+
+
+# ---------------------------------------------------------------------------
+# executor
+# ---------------------------------------------------------------------------
+
+
+class _RemoteCache:
+    """Lazily-created RemoteFunction wrappers (one GCS function push each)."""
+
+    def __init__(self):
+        self._cache: Dict[Tuple[Callable, int], Any] = {}
+
+    def get(self, fn: Callable, num_returns: int = 1):
+        key = (fn, num_returns)
+        if key not in self._cache:
+            rf = ray_tpu.remote(fn)
+            if num_returns != 1:
+                rf = rf.options(num_returns=num_returns)
+            self._cache[key] = rf
+        return self._cache[key]
+
+
+class StreamingExecutor:
+    def __init__(self, ctx: Optional[DataContext] = None):
+        self.ctx = ctx or DataContext.get_current()
+        self._remote = _RemoteCache()
+
+    # -- bounded-window submission (the backpressure policy) ---------------
+
+    def _windowed(self, submit_fns: List[Callable[[], Any]]) -> List[Any]:
+        cap = max(1, self.ctx.max_concurrent_tasks)
+        out: List[Any] = [None] * len(submit_fns)
+        in_flight: Dict[Any, int] = {}
+        next_i = 0
+        while next_i < len(submit_fns) or in_flight:
+            while next_i < len(submit_fns) and len(in_flight) < cap:
+                ref = submit_fns[next_i]()
+                out[next_i] = ref
+                # multi-return tasks yield a list; any one ref tracks
+                # task completion for the backpressure window
+                in_flight[ref[0] if isinstance(ref, list) else ref] = next_i
+                next_i += 1
+            if in_flight:
+                ready, _ = ray_tpu.wait(list(in_flight), num_returns=1,
+                                        timeout=30.0)
+                for r in ready:
+                    in_flight.pop(r, None)
+        return out
+
+    # -- plan walk ---------------------------------------------------------
+
+    def execute(self, op: L.LogicalOp) -> List[Any]:
+        """Returns the output block refs of the (optimized) plan."""
+        op = L.optimize(op)
+        return self._exec(op)
+
+    def _exec(self, op: L.LogicalOp) -> List[Any]:
+        if isinstance(op, L.InputBlocks):
+            return list(op.block_refs)
+        if isinstance(op, L.Read):
+            tasks = op.datasource.get_read_tasks(op.parallelism)
+            rf = self._remote.get(_run_read)
+            return self._windowed([
+                (lambda t=t: rf.remote(t)) for t in tasks])
+        if isinstance(op, L.AbstractMap):
+            inputs = self._exec(op.input_op)
+            transform = op.make_transform()
+            rf = self._remote.get(_run_transform)
+            return self._windowed([
+                (lambda b=b: rf.remote(transform, b)) for b in inputs])
+        if isinstance(op, L.Limit):
+            return self._exec_limit(op)
+        if isinstance(op, L.Repartition):
+            inputs = self._exec(op.input_op)
+            return self._exchange(
+                inputs, op.n, _round_robin_assigner(op.n), _reduce_concat)
+        if isinstance(op, L.RandomShuffle):
+            inputs = self._exec(op.input_op)
+            p = self.ctx.shuffle_partitions or max(1, len(inputs))
+            seed = op.seed
+            return self._exchange(
+                inputs, p, _random_assigner(p, seed),
+                _reduce_shuffle, extra_args=lambda i: (seed, i))
+        if isinstance(op, L.Sort):
+            return self._exec_sort(op)
+        if isinstance(op, L.GroupByAggregate):
+            return self._exec_groupby(op)
+        if isinstance(op, L.Union):
+            out: List[Any] = []
+            for child in op.inputs:
+                out.extend(self._exec(child))
+            return out
+        if isinstance(op, L.Zip):
+            left = self._exec(op.left)
+            right = self._exec(op.right)
+            if len(left) != len(right):
+                raise ValueError(
+                    f"zip requires equal block counts ({len(left)} vs "
+                    f"{len(right)}); repartition first")
+            rf = self._remote.get(_zip_blocks)
+            return self._windowed([
+                (lambda l=l, r=r: rf.remote(l, r))
+                for l, r in zip(left, right)])
+        raise TypeError(f"unknown logical op {op!r}")
+
+    # -- all-to-all exchange (map: split into p, reduce: combine) ----------
+
+    def _exchange(self, inputs: List[Any], p: int, assign_fn,
+                  reduce_fn, extra_args=lambda i: ()) -> List[Any]:
+        """Two-stage exchange. `reduce_fn(*extra_args(i), *parts)` combines
+        partition i; one cached RemoteFunction serves all partitions."""
+        if not inputs:
+            return []
+        rf = self._remote.get(reduce_fn)
+        if p == 1:
+            # degenerate exchange: one reduce over all input blocks
+            return [rf.remote(*extra_args(0), *inputs)]
+        split_rf = self._remote.get(_split_for_partition, num_returns=p)
+        cols = self._windowed([
+            (lambda b=b: split_rf.remote(b, assign_fn, p)) for b in inputs])
+        submit = []
+        for i in range(p):
+            parts_i = [cols[j][i] for j in range(len(inputs))]
+            submit.append(lambda i=i, parts=parts_i:
+                          rf.remote(*extra_args(i), *parts))
+        return self._windowed(submit)
+
+    def _exec_limit(self, op: L.Limit) -> List[Any]:
+        inputs = self._exec(op.input_op)
+        rf_count = self._remote.get(_count_rows)
+        rf_slice = self._remote.get(_slice_block)
+        out: List[Any] = []
+        remaining = op.n
+        for b in inputs:
+            if remaining <= 0:
+                break
+            n = ray_tpu.get(rf_count.remote(b), timeout=120)
+            if n <= remaining:
+                out.append(b)
+                remaining -= n
+            else:
+                out.append(rf_slice.remote(b, 0, remaining))
+                remaining = 0
+        return out
+
+    def _exec_sort(self, op: L.Sort) -> List[Any]:
+        inputs = self._exec(op.input_op)
+        if not inputs:
+            return []
+        p = max(1, len(inputs))
+        key = op.key
+        rf_sample = self._remote.get(_sample_block)
+        samples = ray_tpu.get(
+            [rf_sample.remote(b, key, 16) for b in inputs], timeout=300)
+        allv = np.concatenate([s for s in samples if len(s)]) \
+            if any(len(s) for s in samples) else np.asarray([0])
+        qs = np.linspace(0, 100, p + 1)[1:-1]
+        bounds = np.percentile(allv, qs) if len(qs) else np.asarray([])
+        descending = op.descending
+        refs = self._exchange(
+            inputs, p, _range_assigner(key, bounds),
+            _reduce_sort, extra_args=lambda i: (key, descending))
+        # partitions ascend by range; for descending output reverse them
+        return list(reversed(refs)) if descending else refs
+
+    def _exec_groupby(self, op: L.GroupByAggregate) -> List[Any]:
+        inputs = self._exec(op.input_op)
+        if not inputs:
+            return []
+        key, aggs = op.key, op.aggs
+        if key is None:
+            rf = self._remote.get(_reduce_groupby)
+            return [rf.remote(None, aggs, *inputs)]
+        p = min(len(inputs), 8)
+        return self._exchange(
+            inputs, p, _hash_assigner(key, p),
+            _reduce_groupby, extra_args=lambda i: (key, aggs))
+
+
+# assigner factories (picklable closures shipped to map tasks)
+
+def _round_robin_assigner(p: int):
+    def assign(acc: BlockAccessor) -> np.ndarray:
+        return np.arange(acc.num_rows()) % p
+    return assign
+
+
+def _random_assigner(p: int, seed: Optional[int]):
+    def assign(acc: BlockAccessor) -> np.ndarray:
+        rng = np.random.default_rng(seed)
+        return rng.integers(0, p, size=acc.num_rows())
+    return assign
+
+
+def _range_assigner(key: str, bounds: np.ndarray):
+    def assign(acc: BlockAccessor) -> np.ndarray:
+        return np.searchsorted(bounds, acc.block[key], side="right")
+    return assign
+
+
+def _hash_assigner(key: str, p: int):
+    def assign(acc: BlockAccessor) -> np.ndarray:
+        vals = acc.block[key]
+        # stable hash via string digest (object/str cols) or modulo (ints)
+        if vals.dtype.kind in "iu":
+            return vals % p
+        import zlib
+        return np.asarray([zlib.crc32(str(v).encode()) % p for v in vals])
+    return assign

@@ -1,0 +1,89 @@
+"""DataIterator: batched consumption of a set of block refs.
+
+Reference: `python/ray/data/iterator.py:68,106` (`iter_batches`) and
+`_internal/iterator/stream_split_iterator.py:32` (per-train-worker
+splits). An iterator is picklable (block refs serialize), so train workers
+can consume shards created by the driver.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator, List, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data.block import Block, BlockAccessor
+
+
+class DataIterator:
+    def __init__(self, block_refs: List[Any]):
+        self._block_refs = block_refs
+
+    def _iter_blocks(self) -> Iterator[Block]:
+        for ref in self._block_refs:
+            yield ray_tpu.get(ref, timeout=600)
+
+    def iter_batches(
+        self,
+        *,
+        batch_size: int = 256,
+        batch_format: str = "numpy",
+        drop_last: bool = False,
+        local_shuffle_buffer_size: Optional[int] = None,
+        local_shuffle_seed: Optional[int] = None,
+    ) -> Iterator[Any]:
+        """Yield dict-of-numpy (or pandas) batches of exactly batch_size
+        (except possibly the last)."""
+        carry: Optional[Block] = None
+        rng = (np.random.default_rng(local_shuffle_seed)
+               if local_shuffle_buffer_size else None)
+
+        def emit(block: Block):
+            if batch_format == "pandas":
+                return BlockAccessor(block).to_pandas()
+            return block
+
+        def shuffled_blocks() -> Iterator[Block]:
+            """Block stream, optionally re-chunked through a local
+            shuffle buffer (reference local_shuffle_buffer_size)."""
+            buf: List[Block] = []
+            buf_rows = 0
+            for block in self._iter_blocks():
+                if not block or not BlockAccessor(block).num_rows():
+                    continue
+                if rng is None:
+                    yield block
+                    continue
+                buf.append(block)
+                buf_rows += BlockAccessor(block).num_rows()
+                if buf_rows >= local_shuffle_buffer_size:
+                    acc = BlockAccessor(BlockAccessor.concat(buf))
+                    yield acc.take(rng.permutation(acc.num_rows()))
+                    buf, buf_rows = [], 0
+            if buf:
+                acc = BlockAccessor(BlockAccessor.concat(buf))
+                yield acc.take(rng.permutation(acc.num_rows()))
+
+        for block in shuffled_blocks():
+            if carry is not None:
+                block = BlockAccessor.concat([carry, block])
+                carry = None
+            acc = BlockAccessor(block)
+            n = acc.num_rows()
+            lo = 0
+            while n - lo >= batch_size:
+                yield emit(acc.slice(lo, lo + batch_size))
+                lo += batch_size
+            if lo < n:
+                carry = acc.slice(lo, n)
+        if carry is not None and not drop_last:
+            if BlockAccessor(carry).num_rows():
+                yield emit(carry)
+
+    def iter_rows(self) -> Iterator[Dict[str, Any]]:
+        for block in self._iter_blocks():
+            yield from BlockAccessor(block).iter_rows()
+
+    def materialize_numpy(self) -> Block:
+        return BlockAccessor.concat(list(self._iter_blocks()))

@@ -1,0 +1,266 @@
+"""Logical plan operators + the fusion optimizer.
+
+Reference: `python/ray/data/_internal/logical/{operators,rules,
+optimizers.py}` — the key rule rebuilt here is **operator fusion**:
+adjacent one-to-one transforms collapse into a single task per block
+(reference `rules/operator_fusion.py`), which is also the XLA-ish thing to
+do — fewer task launches, fewer object-store round trips.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ray_tpu.data.block import (
+    Block,
+    BlockAccessor,
+    normalize_batch_output,
+)
+from ray_tpu.data.datasource import Datasource
+
+
+class LogicalOp:
+    def __init__(self, input_op: Optional["LogicalOp"] = None):
+        self.input_op = input_op
+
+    @property
+    def name(self) -> str:
+        return type(self).__name__
+
+
+class Read(LogicalOp):
+    def __init__(self, datasource: Datasource, parallelism: int):
+        super().__init__(None)
+        self.datasource = datasource
+        self.parallelism = parallelism
+
+
+class InputBlocks(LogicalOp):
+    """Already-materialized input (from_blocks / materialized datasets)."""
+
+    def __init__(self, block_refs: List[Any]):
+        super().__init__(None)
+        self.block_refs = block_refs
+
+
+class AbstractMap(LogicalOp):
+    """One-to-one block transform; fusable."""
+
+    def make_transform(self) -> Callable[[Block], Block]:
+        raise NotImplementedError
+
+
+class MapBatches(AbstractMap):
+    def __init__(self, input_op, fn: Callable, batch_size: Optional[int],
+                 fn_args: tuple = (), fn_kwargs: Optional[dict] = None):
+        super().__init__(input_op)
+        self.fn = fn
+        self.batch_size = batch_size
+        self.fn_args = fn_args
+        self.fn_kwargs = fn_kwargs or {}
+
+    def make_transform(self):
+        fn, bs = self.fn, self.batch_size
+        args, kwargs = self.fn_args, self.fn_kwargs
+
+        def transform(block: Block) -> Block:
+            acc = BlockAccessor(block)
+            n = acc.num_rows()
+            if n == 0:
+                return block
+            size = bs or n
+            outs = []
+            for lo in range(0, n, size):
+                batch = acc.slice(lo, min(lo + size, n))
+                outs.append(normalize_batch_output(
+                    fn(batch, *args, **kwargs)))
+            return BlockAccessor.concat(outs)
+
+        return transform
+
+
+class MapRows(AbstractMap):
+    def __init__(self, input_op, fn: Callable):
+        super().__init__(input_op)
+        self.fn = fn
+
+    def make_transform(self):
+        fn = self.fn
+
+        def transform(block: Block) -> Block:
+            rows = [fn(r) for r in BlockAccessor(block).iter_rows()]
+            return BlockAccessor.from_rows(rows)
+
+        return transform
+
+
+class Filter(AbstractMap):
+    def __init__(self, input_op, fn: Callable):
+        super().__init__(input_op)
+        self.fn = fn
+
+    def make_transform(self):
+        fn = self.fn
+
+        def transform(block: Block) -> Block:
+            acc = BlockAccessor(block)
+            keep = np.asarray([bool(fn(r)) for r in acc.iter_rows()],
+                              dtype=bool)
+            return acc.take(np.nonzero(keep)[0]) if len(keep) else block
+
+        return transform
+
+
+class FlatMap(AbstractMap):
+    def __init__(self, input_op, fn: Callable):
+        super().__init__(input_op)
+        self.fn = fn
+
+    def make_transform(self):
+        fn = self.fn
+
+        def transform(block: Block) -> Block:
+            rows: List[dict] = []
+            for r in BlockAccessor(block).iter_rows():
+                rows.extend(fn(r))
+            return BlockAccessor.from_rows(rows)
+
+        return transform
+
+
+class AddColumn(AbstractMap):
+    def __init__(self, input_op, col: str, fn: Callable):
+        super().__init__(input_op)
+        self.col = col
+        self.fn = fn
+
+    def make_transform(self):
+        col, fn = self.col, self.fn
+
+        def transform(block: Block) -> Block:
+            out = dict(block)
+            out[col] = np.asarray(fn(BlockAccessor(block)))
+            return out
+
+        return transform
+
+
+class DropColumns(AbstractMap):
+    def __init__(self, input_op, cols: List[str]):
+        super().__init__(input_op)
+        self.cols = cols
+
+    def make_transform(self):
+        cols = set(self.cols)
+        return lambda block: {k: v for k, v in block.items()
+                              if k not in cols}
+
+
+class SelectColumns(AbstractMap):
+    def __init__(self, input_op, cols: List[str]):
+        super().__init__(input_op)
+        self.cols = cols
+
+    def make_transform(self):
+        cols = list(self.cols)
+        return lambda block: {k: block[k] for k in cols}
+
+
+class FusedMap(AbstractMap):
+    """Fusion product: run several transforms in one task."""
+
+    def __init__(self, input_op, transforms: List[Callable[[Block], Block]],
+                 fused_names: List[str]):
+        super().__init__(input_op)
+        self.transforms = transforms
+        self.fused_names = fused_names
+
+    @property
+    def name(self) -> str:
+        return "Fused[" + "->".join(self.fused_names) + "]"
+
+    def make_transform(self):
+        transforms = self.transforms
+
+        def transform(block: Block) -> Block:
+            for t in transforms:
+                block = t(block)
+            return block
+
+        return transform
+
+
+class Limit(LogicalOp):
+    def __init__(self, input_op, n: int):
+        super().__init__(input_op)
+        self.n = n
+
+
+class Repartition(LogicalOp):
+    def __init__(self, input_op, n: int):
+        super().__init__(input_op)
+        self.n = n
+
+
+class RandomShuffle(LogicalOp):
+    def __init__(self, input_op, seed: Optional[int] = None):
+        super().__init__(input_op)
+        self.seed = seed
+
+
+class Sort(LogicalOp):
+    def __init__(self, input_op, key: str, descending: bool = False):
+        super().__init__(input_op)
+        self.key = key
+        self.descending = descending
+
+
+class GroupByAggregate(LogicalOp):
+    def __init__(self, input_op, key: Optional[str],
+                 aggs: List[Tuple[str, Optional[str], str]]):
+        """aggs: list of (agg_name, on_column, out_name)."""
+        super().__init__(input_op)
+        self.key = key
+        self.aggs = aggs
+
+
+class Union(LogicalOp):
+    def __init__(self, inputs: List[LogicalOp]):
+        super().__init__(None)
+        self.inputs = inputs
+
+
+class Zip(LogicalOp):
+    def __init__(self, left: LogicalOp, right: LogicalOp):
+        super().__init__(None)
+        self.left = left
+        self.right = right
+
+
+def optimize(op: LogicalOp) -> LogicalOp:
+    """Bottom-up fusion of AbstractMap chains (reference
+    `logical/rules/operator_fusion.py`)."""
+    if isinstance(op, Union):
+        op.inputs = [optimize(i) for i in op.inputs]
+        return op
+    if isinstance(op, Zip):
+        op.left, op.right = optimize(op.left), optimize(op.right)
+        return op
+    if op.input_op is not None:
+        op.input_op = optimize(op.input_op)
+    if isinstance(op, AbstractMap) and isinstance(op.input_op, AbstractMap):
+        child = op.input_op
+        child_transforms = (child.transforms
+                            if isinstance(child, FusedMap)
+                            else [child.make_transform()])
+        child_names = (child.fused_names if isinstance(child, FusedMap)
+                       else [child.name])
+        return FusedMap(
+            child.input_op,
+            child_transforms + [op.make_transform()],
+            child_names + [op.name],
+        )
+    return op
