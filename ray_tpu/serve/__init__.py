@@ -1,0 +1,134 @@
+"""ray_tpu.serve — online model serving.
+
+Reference: `python/ray/serve/` (SURVEY.md §2.4): declarative deployments
+reconciled by a controller actor into replica actors; pow-2 routed handles;
+request-rate autoscaling; batching for MXU-friendly inference; HTTP proxy.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import ray_tpu
+from ray_tpu.serve.batching import batch
+from ray_tpu.serve.controller import CONTROLLER_NAME, ServeController
+from ray_tpu.serve.deployment import (
+    Application,
+    AutoscalingConfig,
+    Deployment,
+    DeploymentConfig,
+    deployment,
+)
+from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
+
+_state: Dict[str, Any] = {"controller": None, "proxy": None}
+
+
+def _get_or_start_controller():
+    if _state["controller"] is not None:
+        return _state["controller"]
+    try:
+        ctrl = ray_tpu.get_actor(CONTROLLER_NAME)
+    except ValueError:
+        cls = ray_tpu.remote(ServeController)
+        ctrl = cls.options(name=CONTROLLER_NAME, lifetime="detached",
+                           max_concurrency=8, num_cpus=0).remote()
+        # fire-and-forget reconcile loop (health checks + autoscaling)
+        ctrl.run_control_loop.remote()
+    _state["controller"] = ctrl
+    return ctrl
+
+
+def run(app: Application, *, name: str = "default",
+        route_prefix: Optional[str] = "/", _blocking: bool = False,
+        http_port: int = 0) -> DeploymentHandle:
+    """Deploy an application graph; returns the ingress handle
+    (reference `python/ray/serve/api.py:545`)."""
+    ctrl = _get_or_start_controller()
+    nodes = app._flatten()
+    handles: Dict[int, DeploymentHandle] = {}
+    for node in nodes:
+        dep = node.deployment
+        # composed Applications become handles of already-deployed deps
+        def resolve(v):
+            if isinstance(v, Application):
+                return handles[id(v)]
+            return v
+        init_args = tuple(resolve(a) for a in node.init_args)
+        init_kwargs = {k: resolve(v) for k, v in node.init_kwargs.items()}
+        is_ingress = node is nodes[-1]
+        ray_tpu.get(ctrl.deploy.remote(
+            dep.name, dep.func_or_class, init_args, init_kwargs,
+            dep.config,
+            (route_prefix if is_ingress else dep.route_prefix),
+        ), timeout=120)
+        handles[id(node)] = DeploymentHandle(ctrl, dep.name)
+    ingress = nodes[-1]
+    if http_port:
+        _start_proxy(http_port)
+    return handles[id(ingress)]
+
+
+def _start_proxy(port: int):
+    from ray_tpu.serve.proxy import HTTPProxy
+    if _state["proxy"] is not None:
+        return
+    cls = ray_tpu.remote(HTTPProxy)
+    proxy = cls.options(max_concurrency=16, num_cpus=0).remote(
+        _state["controller"], "127.0.0.1", port)
+    ray_tpu.get(proxy.ready.remote(), timeout=60)
+    _state["proxy"] = proxy
+
+
+def get_deployment_handle(deployment_name: str,
+                          app_name: str = "default") -> DeploymentHandle:
+    return DeploymentHandle(_get_or_start_controller(), deployment_name)
+
+
+def status() -> Dict[str, Any]:
+    ctrl = _get_or_start_controller()
+    return ray_tpu.get(ctrl.list_deployments.remote(), timeout=30)
+
+
+def delete(deployment_name: str) -> None:
+    ctrl = _get_or_start_controller()
+    ray_tpu.get(ctrl.delete_deployment.remote(deployment_name), timeout=60)
+
+
+def shutdown() -> None:
+    ctrl = _state.get("controller")
+    if ctrl is None:
+        try:
+            ctrl = ray_tpu.get_actor(CONTROLLER_NAME)
+        except Exception:
+            ctrl = None
+    if ctrl is not None:
+        try:
+            ray_tpu.get(ctrl.shutdown.remote(), timeout=60)
+            ray_tpu.kill(ctrl)
+        except Exception:
+            pass
+    if _state.get("proxy") is not None:
+        try:
+            ray_tpu.kill(_state["proxy"])
+        except Exception:
+            pass
+    _state["controller"] = None
+    _state["proxy"] = None
+
+
+__all__ = [
+    "Application",
+    "AutoscalingConfig",
+    "Deployment",
+    "DeploymentConfig",
+    "DeploymentHandle",
+    "DeploymentResponse",
+    "batch",
+    "delete",
+    "deployment",
+    "get_deployment_handle",
+    "run",
+    "shutdown",
+    "status",
+]

@@ -1,0 +1,214 @@
+"""ServeController: reconciles declarative deployment specs into replicas.
+
+Reference: `python/ray/serve/_private/controller.py:86` (ServeController),
+`deployment_state.py:1226` (DeploymentState reconciliation),
+`autoscaling_state.py:262` (request-rate autoscaling decisions). The
+controller is a detached named actor; a reconcile loop (long-running actor
+call) diffs desired vs live replicas, restarts dead ones, and resizes
+autoscaled deployments from polled replica metrics.
+
+Concurrency: the controller actor runs with max_concurrency > 1 (the
+control loop occupies one slot forever), so all state mutation happens
+under one lock. Replica polls (one combined metrics/health RPC per
+replica per tick) are fired concurrently and gathered once.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import ray_tpu
+from ray_tpu.serve.deployment import AutoscalingConfig, DeploymentConfig
+from ray_tpu.serve.replica import Replica
+
+CONTROLLER_NAME = "SERVE_CONTROLLER"
+
+
+class _DeploymentState:
+    def __init__(self, name: str, func_or_class, init_args, init_kwargs,
+                 config: DeploymentConfig, route_prefix: Optional[str]):
+        self.name = name
+        self.func_or_class = func_or_class
+        self.init_args = init_args
+        self.init_kwargs = init_kwargs
+        self.config = config
+        self.route_prefix = route_prefix
+        self.target_replicas = (
+            config.autoscaling_config.min_replicas
+            if config.autoscaling_config else config.num_replicas)
+        self.replicas: List[Any] = []
+        self.version = 0
+        # autoscaling: scale only after the condition holds continuously
+        # for the configured delay (reference autoscaling semantics)
+        self.upscale_pending_since: Optional[float] = None
+        self.downscale_pending_since: Optional[float] = None
+
+
+class ServeController:
+    def __init__(self):
+        self._deployments: Dict[str, _DeploymentState] = {}
+        self._replica_cls = ray_tpu.remote(Replica)
+        self._running = True
+        self._lock = threading.RLock()
+
+    # -- API ---------------------------------------------------------------
+
+    def deploy(self, name: str, func_or_class, init_args, init_kwargs,
+               config: DeploymentConfig,
+               route_prefix: Optional[str]) -> None:
+        with self._lock:
+            if route_prefix:
+                for other, st_o in self._deployments.items():
+                    if other != name and st_o.route_prefix == route_prefix:
+                        raise ValueError(
+                            f"route_prefix {route_prefix!r} already used "
+                            f"by deployment {other!r}")
+            existing = self._deployments.get(name)
+            st = _DeploymentState(name, func_or_class, init_args,
+                                  init_kwargs, config, route_prefix)
+            if existing is not None:
+                st.version = existing.version + 1
+                for r in existing.replicas:
+                    self._kill(r)
+            self._deployments[name] = st
+            self._reconcile_one(st)
+
+    def delete_deployment(self, name: str) -> None:
+        with self._lock:
+            st = self._deployments.pop(name, None)
+            if st:
+                for r in st.replicas:
+                    self._kill(r)
+
+    def get_replicas(self, name: str) -> Dict[str, Any]:
+        with self._lock:
+            st = self._deployments.get(name)
+            if st is None:
+                return {"version": -1, "replicas": []}
+            return {"version": st.version, "replicas": list(st.replicas)}
+
+    def list_deployments(self) -> Dict[str, Dict[str, Any]]:
+        with self._lock:
+            return {
+                name: {
+                    "num_replicas": len(st.replicas),
+                    "target_replicas": st.target_replicas,
+                    "route_prefix": st.route_prefix,
+                    "version": st.version,
+                }
+                for name, st in self._deployments.items()
+            }
+
+    def get_routes(self) -> Dict[str, str]:
+        with self._lock:
+            return {st.route_prefix: name
+                    for name, st in self._deployments.items()
+                    if st.route_prefix}
+
+    def shutdown(self) -> None:
+        self._running = False
+        with self._lock:
+            for name in list(self._deployments):
+                self.delete_deployment(name)
+
+    # -- reconciliation ----------------------------------------------------
+
+    def run_control_loop(self, period_s: float = 0.5,
+                         max_iters: int = 0) -> None:
+        """Long-running reconcile loop (invoked fire-and-forget by
+        serve.run; needs controller max_concurrency > 1)."""
+        iters = 0
+        while self._running:
+            self.reconcile_now()
+            iters += 1
+            if max_iters and iters >= max_iters:
+                return
+            time.sleep(period_s)
+
+    def reconcile_now(self) -> None:
+        with self._lock:
+            names = list(self._deployments)
+        for name in names:
+            with self._lock:
+                st = self._deployments.get(name)
+                if st is None:
+                    continue
+                try:
+                    alive, total_ongoing = self._poll_replicas(st)
+                    st.replicas = alive
+                    self._autoscale(st, total_ongoing)
+                    self._reconcile_one(st)
+                except Exception:
+                    pass
+
+    def _poll_replicas(self, st: _DeploymentState
+                       ) -> Tuple[List[Any], float]:
+        """One concurrent get_metrics round: liveness + load in one RPC.
+        Dead (or unresponsive) replicas are killed so they can't leak."""
+        refs = [(r, r.get_metrics.remote()) for r in st.replicas]
+        alive: List[Any] = []
+        total_ongoing = 0.0
+        for r, ref in refs:
+            try:
+                m = ray_tpu.get(ref, timeout=10)
+                alive.append(r)
+                total_ongoing += m["ongoing"]
+            except Exception:
+                self._kill(r)
+        return alive, total_ongoing
+
+    def _reconcile_one(self, st: _DeploymentState) -> None:
+        changed = False
+        while len(st.replicas) < st.target_replicas:
+            opts = dict(st.config.ray_actor_options or {})
+            # reserve slots beyond user requests so control RPCs
+            # (get_metrics) still answer when the replica is saturated
+            opts.setdefault("max_concurrency",
+                            st.config.max_ongoing_requests + 2)
+            r = self._replica_cls.options(**opts).remote(
+                st.func_or_class, st.init_args, st.init_kwargs,
+                st.config.user_config)
+            st.replicas.append(r)
+            changed = True
+        while len(st.replicas) > st.target_replicas:
+            self._kill(st.replicas.pop())
+            changed = True
+        if changed:
+            st.version += 1
+
+    def _autoscale(self, st: _DeploymentState,
+                   total_ongoing: float) -> None:
+        asc: Optional[AutoscalingConfig] = st.config.autoscaling_config
+        if asc is None or not st.replicas:
+            return
+        desired = math.ceil(total_ongoing / asc.target_ongoing_requests) \
+            if asc.target_ongoing_requests > 0 else asc.min_replicas
+        desired = max(asc.min_replicas, min(asc.max_replicas, desired))
+        now = time.monotonic()
+        if desired > st.target_replicas:
+            st.downscale_pending_since = None
+            if st.upscale_pending_since is None:
+                st.upscale_pending_since = now
+            if now - st.upscale_pending_since >= asc.upscale_delay_s:
+                st.target_replicas = desired
+                st.upscale_pending_since = None
+        elif desired < st.target_replicas:
+            st.upscale_pending_since = None
+            if st.downscale_pending_since is None:
+                st.downscale_pending_since = now
+            if now - st.downscale_pending_since >= asc.downscale_delay_s:
+                st.target_replicas = desired
+                st.downscale_pending_since = None
+        else:
+            st.upscale_pending_since = None
+            st.downscale_pending_since = None
+
+    @staticmethod
+    def _kill(replica) -> None:
+        try:
+            ray_tpu.kill(replica)
+        except Exception:
+            pass

@@ -1,0 +1,141 @@
+"""Deployment handles + the pow-2 router.
+
+Reference: `python/ray/serve/handle.py:711,453`
+(DeploymentHandle/DeploymentResponse) and
+`python/ray/serve/_private/replica_scheduler/pow_2_scheduler.py:49` —
+power-of-two-choices over the router's local view of per-replica in-flight
+counts, with the replica list refreshed from the controller (the
+reference's LongPollClient push becomes a pull with a short TTL).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+
+
+class DeploymentResponse:
+    """Future for one request (reference `handle.py:453`).
+
+    Completion feedback: the router's local in-flight count for the chosen
+    replica is decremented when the result is fetched (or the response is
+    dropped), keeping the pow-2 view accurate without a waiter thread.
+    """
+
+    def __init__(self, ref, router: Optional["Router"] = None,
+                 replica_idx: int = -1):
+        self._ref = ref
+        self._router = router
+        self._replica_idx = replica_idx
+        self._done = False
+
+    def _mark_done(self):
+        if not self._done and self._router is not None:
+            self._done = True
+            self._router.done(self._replica_idx)
+
+    def result(self, timeout: Optional[float] = 60.0) -> Any:
+        try:
+            return ray_tpu.get(self._ref, timeout=timeout)
+        finally:
+            self._mark_done()
+
+    @property
+    def ref(self):
+        return self._ref
+
+    def __del__(self):
+        try:
+            self._mark_done()
+        except Exception:
+            pass
+
+
+class Router:
+    """Pow-2 replica chooser with a locally-tracked in-flight view."""
+
+    _REFRESH_S = 2.0
+
+    def __init__(self, controller, deployment_name: str):
+        self._controller = controller
+        self._name = deployment_name
+        self._replicas: List[Any] = []
+        self._version = -1
+        self._inflight: Dict[int, int] = {}
+        self._last_refresh = 0.0
+        self._lock = threading.Lock()
+
+    def _refresh(self, force: bool = False):
+        now = time.monotonic()
+        if not force and now - self._last_refresh < self._REFRESH_S:
+            return
+        info = ray_tpu.get(
+            self._controller.get_replicas.remote(self._name), timeout=30)
+        with self._lock:
+            self._last_refresh = now
+            if info["version"] != self._version:
+                self._version = info["version"]
+                self._replicas = info["replicas"]
+                self._inflight = {i: 0 for i in range(len(self._replicas))}
+
+    def choose(self) -> tuple:
+        self._refresh()
+        deadline = time.monotonic() + 30.0
+        while not self._replicas:
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"no replicas available for {self._name!r}")
+            time.sleep(0.1)
+            self._refresh(force=True)
+        with self._lock:
+            n = len(self._replicas)
+            if n == 1:
+                idx = 0
+            else:
+                a, b = random.sample(range(n), 2)
+                idx = a if self._inflight.get(a, 0) <= \
+                    self._inflight.get(b, 0) else b
+            self._inflight[idx] = self._inflight.get(idx, 0) + 1
+            return idx, self._replicas[idx]
+
+    def done(self, idx: int):
+        with self._lock:
+            if idx in self._inflight and self._inflight[idx] > 0:
+                self._inflight[idx] -= 1
+
+
+class DeploymentHandle:
+    def __init__(self, controller, deployment_name: str,
+                 method: str = "__call__"):
+        self._controller = controller
+        self._name = deployment_name
+        self._method = method
+        self._router = Router(controller, deployment_name)
+
+    def options(self, method_name: str) -> "DeploymentHandle":
+        h = DeploymentHandle(self._controller, self._name, method_name)
+        h._router = self._router  # share the local view
+        return h
+
+    def __getattr__(self, name: str) -> "DeploymentHandle":
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return self.options(name)
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        # unwrap composed responses so refs resolve in the replica
+        args = tuple(a.ref if isinstance(a, DeploymentResponse) else a
+                     for a in args)
+        kwargs = {k: (v.ref if isinstance(v, DeploymentResponse) else v)
+                  for k, v in kwargs.items()}
+        idx, replica = self._router.choose()
+        ref = replica.handle_request.remote(self._method, args, kwargs)
+        return DeploymentResponse(ref, self._router, idx)
+
+    def __reduce__(self):
+        return (DeploymentHandle,
+                (self._controller, self._name, self._method))

@@ -1,0 +1,93 @@
+"""HTTP proxy actor.
+
+Reference: `python/ray/serve/_private/proxy.py:748,1112` (HTTPProxy /
+ProxyActor). An aiohttp server inside an actor routes
+`{route_prefix}` → deployment handle; JSON bodies become the request
+argument, results are JSON-encoded.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+from typing import Any, Dict, Optional
+
+import ray_tpu
+from ray_tpu.serve.handle import DeploymentHandle
+
+
+class HTTPProxy:
+    def __init__(self, controller, host: str = "127.0.0.1",
+                 port: int = 8000):
+        self._controller = controller
+        self._host = host
+        self._port = port
+        self._handles: Dict[str, DeploymentHandle] = {}
+        self._started = threading.Event()
+        self._thread = threading.Thread(target=self._serve, daemon=True,
+                                        name="http_proxy")
+        self._thread.start()
+        self._started.wait(timeout=30)
+
+    def ready(self) -> Dict[str, Any]:
+        return {"host": self._host, "port": self._port}
+
+    def _match_route(self, path: str) -> Optional[str]:
+        routes = ray_tpu.get(self._controller.get_routes.remote(),
+                             timeout=30)
+        best = None
+        for prefix, name in routes.items():
+            if prefix and (path == prefix or
+                           path.startswith(prefix.rstrip("/") + "/")):
+                if best is None or len(prefix) > len(best[0]):
+                    best = (prefix, name)
+        return best[1] if best else None
+
+    def _serve(self):
+        from aiohttp import web
+
+        def dispatch_blocking(path: str, body):
+            """Route + dispatch + await — everything that can block on
+            controller/replica RPCs runs in the executor, never on the
+            event loop."""
+            name = self._match_route(path)
+            if name is None:
+                return 404, {"error": f"no route for {path}"}
+            if name not in self._handles:
+                self._handles[name] = DeploymentHandle(
+                    self._controller, name)
+            handle = self._handles[name]
+            resp = handle.remote(body) if body is not None \
+                else handle.remote()
+            return 200, resp.result(timeout=60)
+
+        async def handler(request: "web.Request") -> "web.Response":
+            if request.can_read_body:
+                try:
+                    body = await request.json()
+                except json.JSONDecodeError:
+                    body = (await request.read()).decode()
+            else:
+                body = None
+            loop = asyncio.get_event_loop()
+            try:
+                status, result = await loop.run_in_executor(
+                    None, dispatch_blocking, request.path, body)
+            except Exception as e:  # noqa: BLE001 — surfaced as HTTP 500
+                return web.json_response({"error": str(e)}, status=500)
+            try:
+                return web.json_response(result, status=status)
+            except TypeError:
+                return web.Response(text=str(result), status=status)
+
+        app = web.Application()
+        app.router.add_route("*", "/{tail:.*}", handler)
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        runner = web.AppRunner(app)
+        loop.run_until_complete(runner.setup())
+        site = web.TCPSite(runner, self._host, self._port)
+        loop.run_until_complete(site.start())
+        self._started.set()
+        loop.run_forever()

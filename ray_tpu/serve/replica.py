@@ -1,0 +1,54 @@
+"""Replica actor: hosts one copy of a deployment's callable.
+
+Reference: `python/ray/serve/_private/replica.py` — runs the user
+callable, tracks ongoing-request count (for pow-2 routing + autoscaling),
+supports reconfigure(user_config) and health checks.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+
+class Replica:
+    def __init__(self, func_or_class: Any, init_args: tuple,
+                 init_kwargs: dict, user_config: Optional[Dict] = None):
+        self._is_function = not isinstance(func_or_class, type)
+        if self._is_function:
+            self._callable = func_or_class
+        else:
+            self._callable = func_or_class(*init_args, **init_kwargs)
+            if user_config is not None and \
+                    hasattr(self._callable, "reconfigure"):
+                self._callable.reconfigure(user_config)
+        self._ongoing = 0
+        self._total = 0
+        self._lock = threading.Lock()
+
+    def handle_request(self, method: str, args: tuple, kwargs: dict) -> Any:
+        with self._lock:
+            self._ongoing += 1
+            self._total += 1
+        try:
+            if self._is_function:
+                return self._callable(*args, **kwargs)
+            return getattr(self._callable, method)(*args, **kwargs)
+        finally:
+            with self._lock:
+                self._ongoing -= 1
+
+    def get_metrics(self) -> Dict[str, float]:
+        with self._lock:
+            return {"ongoing": float(self._ongoing),
+                    "total": float(self._total)}
+
+    def reconfigure(self, user_config: Dict) -> None:
+        if not self._is_function and hasattr(self._callable, "reconfigure"):
+            self._callable.reconfigure(user_config)
+
+    def check_health(self) -> bool:
+        if not self._is_function and hasattr(self._callable,
+                                             "check_health"):
+            return bool(self._callable.check_health())
+        return True
