@@ -1,0 +1,92 @@
+"""ConnectorV2 pipelines — episodes → train batch.
+
+Reference: `rllib/connectors/connector_v2.py:18` and the learner-pipeline
+GAE connector (`rllib/connectors/learner/
+general_advantage_estimation.py`). Kept as plain composable callables:
+each connector takes and returns the (episodes, batch) pair.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Tuple
+
+import numpy as np
+
+from ray_tpu.rllib.core.rl_module import Columns
+from ray_tpu.rllib.env.env_runner import Episode
+
+Batch = Dict[str, np.ndarray]
+
+
+class ConnectorPipeline:
+    def __init__(self, connectors: List[Callable]):
+        self.connectors = list(connectors)
+
+    def __call__(self, episodes: List[Episode], batch: Batch) -> Batch:
+        for c in self.connectors:
+            batch = c(episodes, batch)
+        return batch
+
+
+def columns_from_episodes(episodes: List[Episode], batch: Batch) -> Batch:
+    """Flatten episode fragments into columnar arrays."""
+    batch[Columns.OBS] = np.concatenate(
+        [np.stack(ep.obs) for ep in episodes]).astype(np.float32)
+    batch[Columns.ACTIONS] = np.concatenate(
+        [np.asarray(ep.actions) for ep in episodes])
+    batch[Columns.REWARDS] = np.concatenate(
+        [np.asarray(ep.rewards, np.float32) for ep in episodes])
+    batch[Columns.ACTION_LOGP] = np.concatenate(
+        [np.asarray(ep.logps, np.float32) for ep in episodes])
+    batch[Columns.VF_PREDS] = np.concatenate(
+        [np.asarray(ep.vf_preds, np.float32) for ep in episodes])
+    return batch
+
+
+class GAE:
+    """Generalized advantage estimation over episode fragments.
+
+    Reference: the learner GAE connector + `rllib/evaluation/
+    postprocessing.py` compute_advantages. Truncated/open fragments are
+    bootstrapped with the module's value of `last_obs`."""
+
+    def __init__(self, gamma: float = 0.99, lambda_: float = 0.95,
+                 module=None, params_getter: Callable = None):
+        self.gamma = gamma
+        self.lambda_ = lambda_
+        self.module = module
+        self.params_getter = params_getter
+
+    def _bootstrap_value(self, ep: Episode) -> float:
+        if ep.terminated or self.module is None or \
+                self.params_getter is None:
+            return 0.0
+        out = self.module.forward_inference(
+            self.params_getter(), ep.last_obs[None, :])
+        return float(np.asarray(out[Columns.VF_PREDS])[0])
+
+    def __call__(self, episodes: List[Episode], batch: Batch) -> Batch:
+        advs, targets = [], []
+        for ep in episodes:
+            rewards = np.asarray(ep.rewards, np.float32)
+            values = np.asarray(ep.vf_preds, np.float32)
+            last_v = self._bootstrap_value(ep)
+            next_values = np.append(values[1:], last_v)
+            deltas = rewards + self.gamma * next_values - values
+            adv = np.zeros_like(deltas)
+            acc = 0.0
+            for t in range(len(deltas) - 1, -1, -1):
+                acc = deltas[t] + self.gamma * self.lambda_ * acc
+                adv[t] = acc
+            advs.append(adv)
+            targets.append(adv + values)
+        batch[Columns.ADVANTAGES] = np.concatenate(advs)
+        batch[Columns.VALUE_TARGETS] = np.concatenate(targets)
+        return batch
+
+
+def standardize_advantages(episodes: List[Episode], batch: Batch) -> Batch:
+    adv = batch[Columns.ADVANTAGES]
+    batch[Columns.ADVANTAGES] = (adv - adv.mean()) / \
+        max(1e-6, adv.std())
+    return batch

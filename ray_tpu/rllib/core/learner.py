@@ -1,0 +1,198 @@
+"""Learner: gradient-based update of one RLModule, in JAX.
+
+Reference: `rllib/core/learner/learner.py:107` —
+`compute_gradients`/`apply_gradients`/`update_from_batch` (:456,:586,
+:1074). TPU-first delta: instead of torch DDP wrappers
+(`torch_learner.py:265`), the update step is one jitted function; on TPU
+the learner's device mesh does DP/FSDP via pjit inside the jit — no NCCL,
+no wrapper classes. Multi-learner scaling happens in LearnerGroup.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib.core.rl_module import (
+    Columns,
+    RLModule,
+    RLModuleSpec,
+    params_to_numpy,
+)
+
+
+class Learner:
+    """Owns params + optimizer state; subclasses define compute_loss."""
+
+    def __init__(self, spec: RLModuleSpec,
+                 config: Optional[Dict[str, Any]] = None, seed: int = 0):
+        self.spec = spec
+        self.config = dict(config or {})
+        self.module: RLModule = spec.build()
+        self.rng = jax.random.PRNGKey(seed)
+        self.params = self.module.init_params(self.rng)
+        lr = self.config.get("lr", 3e-4)
+        clip = self.config.get("grad_clip", 0.5)
+        self.tx = optax.chain(optax.clip_by_global_norm(clip),
+                              optax.adam(lr))
+        self.opt_state = self.tx.init(self.params)
+        self._update_jit = jax.jit(self._update)
+
+    # -- to be provided by algorithm-specific subclasses -------------------
+
+    def compute_loss(self, params, batch: Dict,
+                     aux: Any = None) -> Tuple[jnp.ndarray, Dict]:
+        raise NotImplementedError
+
+    def _aux_state(self) -> Any:
+        """Extra (non-trained) state threaded through the jitted update —
+        e.g. DQN's target params. Passed as a jit argument rather than
+        closed over so updates are visible without retracing."""
+        return None
+
+    # -- update machinery --------------------------------------------------
+
+    def _update(self, params, opt_state, batch, aux):
+        (loss, stats), grads = jax.value_and_grad(
+            self.compute_loss, has_aux=True)(params, batch, aux)
+        updates, opt_state = self.tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        stats["total_loss"] = loss
+        stats["grad_norm"] = optax.global_norm(grads)
+        return params, opt_state, stats
+
+    def update_from_batch(self, batch: Dict[str, np.ndarray]
+                          ) -> Dict[str, float]:
+        batch = {k: jnp.asarray(v) for k, v in batch.items()}
+        self.params, self.opt_state, stats = self._update_jit(
+            self.params, self.opt_state, batch, self._aux_state())
+        return {k: float(v) for k, v in stats.items()}
+
+    def compute_gradients(self, batch: Dict[str, np.ndarray]):
+        """Grads without applying (LearnerGroup DP averaging path)."""
+        batch = {k: jnp.asarray(v) for k, v in batch.items()}
+        (_, stats), grads = jax.value_and_grad(
+            self.compute_loss, has_aux=True)(
+                self.params, batch, self._aux_state())
+        return params_to_numpy(grads), {k: float(v)
+                                        for k, v in stats.items()}
+
+    def apply_gradients(self, grads) -> None:
+        grads = jax.tree_util.tree_map(jnp.asarray, grads)
+        updates, self.opt_state = self.tx.update(grads, self.opt_state,
+                                                 self.params)
+        self.params = optax.apply_updates(self.params, updates)
+
+    # -- weights -----------------------------------------------------------
+
+    def get_weights(self):
+        return params_to_numpy(self.params)
+
+    def set_weights(self, weights) -> None:
+        self.params = jax.tree_util.tree_map(jnp.asarray, weights)
+
+    def get_state(self) -> Dict[str, Any]:
+        return {"weights": self.get_weights()}
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        self.set_weights(state["weights"])
+
+
+class PPOLearner(Learner):
+    """Clipped-surrogate PPO loss (reference `rllib/algorithms/ppo/
+    torch/ppo_torch_learner.py` — rebuilt in jax)."""
+
+    def compute_loss(self, params, batch, aux=None):
+        out = self.module.forward_train(params, batch)
+        logits = out[Columns.ACTION_DIST_INPUTS]
+        values = out[Columns.VF_PREDS]
+        logp_all = jax.nn.log_softmax(logits)
+        actions = batch[Columns.ACTIONS].astype(jnp.int32)
+        logp = logp_all[jnp.arange(logits.shape[0]), actions]
+        ratio = jnp.exp(logp - batch[Columns.ACTION_LOGP])
+        adv = batch[Columns.ADVANTAGES]
+        clip_eps = self.config.get("clip_param", 0.2)
+        surrogate = jnp.minimum(
+            ratio * adv,
+            jnp.clip(ratio, 1 - clip_eps, 1 + clip_eps) * adv)
+        policy_loss = -jnp.mean(surrogate)
+        vf_loss = jnp.mean((values - batch[Columns.VALUE_TARGETS]) ** 2)
+        probs = jax.nn.softmax(logits)
+        entropy = -jnp.mean(jnp.sum(probs * logp_all, axis=-1))
+        vf_coeff = self.config.get("vf_loss_coeff", 0.5)
+        ent_coeff = self.config.get("entropy_coeff", 0.0)
+        loss = policy_loss + vf_coeff * vf_loss - ent_coeff * entropy
+        return loss, {"policy_loss": policy_loss, "vf_loss": vf_loss,
+                      "entropy": entropy,
+                      "mean_kl": jnp.mean(batch[Columns.ACTION_LOGP] -
+                                          logp)}
+
+
+class DQNLearner(Learner):
+    """Double-DQN loss with a target network (reference
+    `rllib/algorithms/dqn/torch/dqn_torch_learner.py`)."""
+
+    def __init__(self, spec: RLModuleSpec,
+                 config: Optional[Dict[str, Any]] = None, seed: int = 0):
+        super().__init__(spec, config, seed)
+        self.target_params = self.params
+        self._steps = 0
+        self.target_update_freq = self.config.get("target_update_freq", 100)
+
+    def _aux_state(self):
+        return self.target_params
+
+    def compute_loss(self, params, batch, aux=None):
+        target_params = aux if aux is not None else self.target_params
+        q = self.module.forward_train(params, batch)["q_values"]
+        actions = batch[Columns.ACTIONS].astype(jnp.int32)
+        q_taken = q[jnp.arange(q.shape[0]), actions]
+        # double-DQN: online net picks argmax, target net evaluates
+        q_next_online = self.module.forward_train(
+            params, {Columns.OBS: batch[Columns.NEXT_OBS]})["q_values"]
+        q_next_target = self.module.forward_train(
+            target_params,
+            {Columns.OBS: batch[Columns.NEXT_OBS]})["q_values"]
+        next_a = jnp.argmax(q_next_online, axis=-1)
+        q_next = q_next_target[jnp.arange(q.shape[0]), next_a]
+        gamma = self.config.get("gamma", 0.99)
+        not_done = 1.0 - batch[Columns.TERMINATEDS].astype(jnp.float32)
+        target = batch[Columns.REWARDS] + gamma * not_done * \
+            jax.lax.stop_gradient(q_next)
+        td = q_taken - target
+        if "weights" in batch:  # prioritized replay IS weights
+            loss = jnp.mean(batch["weights"] * td ** 2)
+        else:
+            loss = jnp.mean(td ** 2)
+        return loss, {"td_error_mean": jnp.mean(jnp.abs(td)),
+                      "q_mean": jnp.mean(q_taken)}
+
+    def update_from_batch(self, batch):
+        stats = super().update_from_batch(batch)
+        self._steps += 1
+        if self._steps % self.target_update_freq == 0:
+            self.target_params = self.params
+        return stats
+
+    def td_errors(self, batch: Dict[str, np.ndarray]) -> np.ndarray:
+        """|TD| per transition (for prioritized-replay updates)."""
+        b = {k: jnp.asarray(v) for k, v in batch.items()}
+        q = self.module.forward_train(self.params, b)["q_values"]
+        actions = b[Columns.ACTIONS].astype(jnp.int32)
+        q_taken = q[jnp.arange(q.shape[0]), actions]
+        q_next_online = self.module.forward_train(
+            self.params, {Columns.OBS: b[Columns.NEXT_OBS]})["q_values"]
+        q_next_target = self.module.forward_train(
+            self.target_params,
+            {Columns.OBS: b[Columns.NEXT_OBS]})["q_values"]
+        next_a = jnp.argmax(q_next_online, axis=-1)
+        q_next = q_next_target[jnp.arange(q.shape[0]), next_a]
+        gamma = self.config.get("gamma", 0.99)
+        not_done = 1.0 - b[Columns.TERMINATEDS].astype(jnp.float32)
+        target = b[Columns.REWARDS] + gamma * not_done * q_next
+        return np.asarray(jnp.abs(q_taken - target))

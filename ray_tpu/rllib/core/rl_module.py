@@ -1,0 +1,167 @@
+"""RLModule: the framework-pluggable model abstraction, in Flax.
+
+Reference: `rllib/core/rl_module/rl_module.py:251` — three forward passes
+(`forward_inference` :638, `forward_exploration` :661, `forward_train`
+:686). TPU-first: modules are pure-functional Flax; params live with the
+Learner (device) and ship to env runners as numpy trees; all three
+forwards are jit-compiled once.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+try:
+    import flax.linen as nn
+except ImportError:  # pragma: no cover
+    nn = None
+
+Columns = type("Columns", (), {
+    "OBS": "obs", "ACTIONS": "actions", "REWARDS": "rewards",
+    "TERMINATEDS": "terminateds", "TRUNCATEDS": "truncateds",
+    "NEXT_OBS": "next_obs", "ACTION_LOGP": "action_logp",
+    "VF_PREDS": "vf_preds", "ADVANTAGES": "advantages",
+    "VALUE_TARGETS": "value_targets", "ACTION_DIST_INPUTS":
+    "action_dist_inputs",
+})
+
+
+@dataclasses.dataclass
+class RLModuleSpec:
+    """Reference: `rllib/core/rl_module/rl_module.py` RLModuleSpec."""
+
+    observation_dim: int
+    action_dim: int
+    hidden: Tuple[int, ...] = (64, 64)
+    discrete: bool = True
+    module_class: Optional[type] = None
+
+    def build(self) -> "RLModule":
+        cls = self.module_class or ActorCriticModule
+        return cls(self)
+
+
+class RLModule:
+    """Base: wraps a flax module + pure forward fns."""
+
+    def __init__(self, spec: RLModuleSpec):
+        self.spec = spec
+
+    def init_params(self, rng: jax.Array):
+        raise NotImplementedError
+
+    def forward_inference(self, params, obs: jnp.ndarray) -> Dict:
+        """Deterministic action computation (greedy)."""
+        raise NotImplementedError
+
+    def forward_exploration(self, params, obs: jnp.ndarray,
+                            rng: jax.Array) -> Dict:
+        """Stochastic sampling for rollout collection."""
+        raise NotImplementedError
+
+    def forward_train(self, params, batch: Dict) -> Dict:
+        """Differentiable pass used inside the learner's loss."""
+        raise NotImplementedError
+
+
+class _MLPTorso(nn.Module):
+    hidden: Sequence[int]
+
+    @nn.compact
+    def __call__(self, x):
+        for h in self.hidden:
+            x = nn.tanh(nn.Dense(h)(x))
+        return x
+
+
+class _ActorCriticNet(nn.Module):
+    hidden: Sequence[int]
+    action_dim: int
+
+    @nn.compact
+    def __call__(self, obs):
+        torso = _MLPTorso(self.hidden)(obs)
+        logits = nn.Dense(self.action_dim)(torso)
+        value = nn.Dense(1)(_MLPTorso(self.hidden)(obs))
+        return logits, jnp.squeeze(value, -1)
+
+
+class ActorCriticModule(RLModule):
+    """Discrete-action actor-critic (the default PPO module).
+
+    Reference analogue: `rllib/core/rl_module/torch/
+    default_torch_rl_module.py` — rebuilt in flax."""
+
+    def __init__(self, spec: RLModuleSpec):
+        super().__init__(spec)
+        self.net = _ActorCriticNet(spec.hidden, spec.action_dim)
+
+    def init_params(self, rng: jax.Array):
+        dummy = jnp.zeros((1, self.spec.observation_dim), jnp.float32)
+        return self.net.init(rng, dummy)
+
+    def forward_inference(self, params, obs):
+        logits, value = self.net.apply(params, obs)
+        return {"actions": jnp.argmax(logits, axis=-1),
+                Columns.ACTION_DIST_INPUTS: logits,
+                Columns.VF_PREDS: value}
+
+    def forward_exploration(self, params, obs, rng):
+        logits, value = self.net.apply(params, obs)
+        actions = jax.random.categorical(rng, logits)
+        logp = jax.nn.log_softmax(logits)[
+            jnp.arange(logits.shape[0]), actions]
+        return {"actions": actions, Columns.ACTION_LOGP: logp,
+                Columns.ACTION_DIST_INPUTS: logits,
+                Columns.VF_PREDS: value}
+
+    def forward_train(self, params, batch):
+        logits, value = self.net.apply(params, batch[Columns.OBS])
+        return {Columns.ACTION_DIST_INPUTS: logits,
+                Columns.VF_PREDS: value}
+
+
+class _QNet(nn.Module):
+    hidden: Sequence[int]
+    action_dim: int
+
+    @nn.compact
+    def __call__(self, obs):
+        return nn.Dense(self.action_dim)(_MLPTorso(self.hidden)(obs))
+
+
+class QModule(RLModule):
+    """Q-network module for DQN."""
+
+    def __init__(self, spec: RLModuleSpec):
+        super().__init__(spec)
+        self.net = _QNet(spec.hidden, spec.action_dim)
+
+    def init_params(self, rng: jax.Array):
+        dummy = jnp.zeros((1, self.spec.observation_dim), jnp.float32)
+        return self.net.init(rng, dummy)
+
+    def forward_inference(self, params, obs):
+        q = self.net.apply(params, obs)
+        return {"actions": jnp.argmax(q, axis=-1), "q_values": q}
+
+    def forward_exploration(self, params, obs, rng, epsilon: float = 0.1):
+        q = self.net.apply(params, obs)
+        greedy = jnp.argmax(q, axis=-1)
+        random_a = jax.random.randint(rng, greedy.shape, 0,
+                                      self.spec.action_dim)
+        explore = jax.random.uniform(rng, greedy.shape) < epsilon
+        return {"actions": jnp.where(explore, random_a, greedy),
+                "q_values": q}
+
+    def forward_train(self, params, batch):
+        return {"q_values": self.net.apply(params, batch[Columns.OBS])}
+
+
+def params_to_numpy(params) -> Any:
+    return jax.tree_util.tree_map(np.asarray, params)

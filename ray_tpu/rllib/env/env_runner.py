@@ -1,0 +1,184 @@
+"""EnvRunners: rollout collection actors.
+
+Reference: `rllib/env/env_runner.py:15` (ABC),
+`single_agent_env_runner.py:49` (gymnasium vector envs + RLModule
+forward_exploration through connector pipelines),
+`env_runner_group.py:66` (the fault-tolerant fleet). The runner holds
+numpy weights; the forward pass runs on the runner's local device (CPU for
+sim envs — the learner's TPU mesh stays dedicated to updates).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.core.rl_module import Columns, RLModuleSpec
+from ray_tpu.rllib.utils.actor_manager import FaultTolerantActorManager
+
+
+class Episode:
+    """One (possibly truncated) episode fragment of columnar data."""
+
+    def __init__(self):
+        self.obs: List[np.ndarray] = []
+        self.actions: List[int] = []
+        self.rewards: List[float] = []
+        self.logps: List[float] = []
+        self.vf_preds: List[float] = []
+        self.terminated = False
+        self.truncated = False
+        self.last_obs: Optional[np.ndarray] = None
+
+    @property
+    def length(self) -> int:
+        return len(self.actions)
+
+    @property
+    def total_reward(self) -> float:
+        return float(sum(self.rewards))
+
+
+class SingleAgentEnvRunner:
+    """Steps N vectorized gymnasium envs with the current module weights."""
+
+    def __init__(self, env_creator: Callable, spec: RLModuleSpec,
+                 num_envs: int = 1, seed: int = 0,
+                 explore_config: Optional[Dict[str, Any]] = None):
+        import gymnasium as gym
+        import jax
+
+        self._envs = gym.vector.SyncVectorEnv(
+            [lambda i=i: env_creator() for i in range(num_envs)])
+        self.num_envs = num_envs
+        self.module = spec.build()
+        self._params = None
+        self._rng = jax.random.PRNGKey(seed)
+        self._explore = dict(explore_config or {})
+        self._obs, _ = self._envs.reset(seed=seed)
+        self._open = [Episode() for _ in range(num_envs)]
+        self._completed_rewards: List[float] = []
+
+    def set_weights(self, weights) -> None:
+        import jax
+        import jax.numpy as jnp
+        self._params = jax.tree_util.tree_map(jnp.asarray, weights)
+
+    def sample(self, num_steps: int = 200,
+               explore: bool = True) -> List[Episode]:
+        """Collect ≥num_steps env steps; returns closed + open fragments."""
+        import jax
+        assert self._params is not None, "set_weights first"
+        episodes: List[Episode] = []
+        steps = 0
+        while steps < num_steps:
+            self._rng, key = jax.random.split(self._rng)
+            obs = np.asarray(self._obs, np.float32)
+            if explore:
+                fwd = self.module.forward_exploration(
+                    self._params, obs, key, **self._explore)
+            else:
+                fwd = self.module.forward_inference(self._params, obs)
+            actions = np.asarray(fwd["actions"])
+            logps = np.asarray(fwd.get(Columns.ACTION_LOGP,
+                                       np.zeros(self.num_envs)))
+            vfs = np.asarray(fwd.get(Columns.VF_PREDS,
+                                     np.zeros(self.num_envs)))
+            next_obs, rewards, terms, truncs, _ = self._envs.step(actions)
+            for i in range(self.num_envs):
+                ep = self._open[i]
+                ep.obs.append(obs[i])
+                ep.actions.append(int(actions[i]))
+                ep.rewards.append(float(rewards[i]))
+                ep.logps.append(float(logps[i]))
+                ep.vf_preds.append(float(vfs[i]))
+                if terms[i] or truncs[i]:
+                    ep.terminated = bool(terms[i])
+                    ep.truncated = bool(truncs[i])
+                    # vector envs auto-reset; final_obs only matters for
+                    # bootstrapping truncated episodes
+                    ep.last_obs = np.asarray(next_obs[i], np.float32)
+                    episodes.append(ep)
+                    self._completed_rewards.append(ep.total_reward)
+                    self._open[i] = Episode()
+            self._obs = next_obs
+            steps += self.num_envs
+        # flush open fragments (bootstrapped by the learner connector)
+        for i in range(self.num_envs):
+            ep = self._open[i]
+            if ep.length:
+                ep.last_obs = np.asarray(self._obs[i], np.float32)
+                episodes.append(ep)
+                self._open[i] = Episode()
+        return episodes
+
+    def get_metrics(self) -> Dict[str, Any]:
+        recent = self._completed_rewards[-100:]
+        return {
+            "episode_return_mean": (float(np.mean(recent))
+                                    if recent else None),
+            "num_episodes": len(self._completed_rewards),
+        }
+
+    def ping(self) -> bool:
+        return True
+
+
+class EnvRunnerGroup:
+    """Fleet of env-runner actors with fault tolerance.
+
+    Reference: `rllib/env/env_runner_group.py:66` — remote runners managed
+    by `FaultTolerantActorManager`; `num_env_runners=0` runs one local
+    runner in-process (the reference's local-worker mode).
+    """
+
+    def __init__(self, env_creator: Callable, spec: RLModuleSpec,
+                 num_env_runners: int = 0, num_envs_per_runner: int = 1,
+                 seed: int = 0,
+                 explore_config: Optional[Dict[str, Any]] = None):
+        self.num_env_runners = num_env_runners
+        if num_env_runners == 0:
+            self.local_runner = SingleAgentEnvRunner(
+                env_creator, spec, num_envs_per_runner, seed,
+                explore_config)
+            self.manager = None
+        else:
+            self.local_runner = None
+            cls = ray_tpu.remote(SingleAgentEnvRunner)
+            actors = [
+                cls.remote(env_creator, spec, num_envs_per_runner,
+                           seed + 1000 * (i + 1), explore_config)
+                for i in range(num_env_runners)
+            ]
+            restart = (lambda: cls.remote(
+                env_creator, spec, num_envs_per_runner, seed,
+                explore_config))
+            self.manager = FaultTolerantActorManager(actors, restart)
+
+    def sync_weights(self, weights) -> None:
+        if self.local_runner is not None:
+            self.local_runner.set_weights(weights)
+        else:
+            self.manager.foreach(lambda a: a.set_weights.remote(weights))
+
+    def sample(self, num_steps: int) -> List[Episode]:
+        if self.local_runner is not None:
+            return self.local_runner.sample(num_steps)
+        per = max(1, num_steps // max(1, self.manager.num_healthy()))
+        results = self.manager.foreach(
+            lambda a: a.sample.remote(per), timeout=600)
+        out: List[Episode] = []
+        for eps in results:
+            out.extend(eps)
+        return out
+
+    def get_metrics(self) -> List[Dict[str, Any]]:
+        if self.local_runner is not None:
+            return [self.local_runner.get_metrics()]
+        return self.manager.foreach(lambda a: a.get_metrics.remote())
+
+    def stop(self) -> None:
+        if self.manager is not None:
+            self.manager.stop()

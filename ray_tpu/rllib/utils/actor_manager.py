@@ -1,0 +1,80 @@
+"""FaultTolerantActorManager.
+
+Reference: `rllib/utils/actor_manager.py:196` — fan-out RPCs to a fleet,
+mark unhealthy actors, and restore them; used by EnvRunnerGroup (and
+LearnerGroup) so a dead sampler never sinks the training loop.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional
+
+import ray_tpu
+
+
+class FaultTolerantActorManager:
+    def __init__(self, actors: List[Any],
+                 restart_fn: Optional[Callable[[], Any]] = None,
+                 max_restarts: int = 3):
+        self._actors = list(actors)
+        self._healthy = [True] * len(actors)
+        self._restart_fn = restart_fn
+        self._restarts = [0] * len(actors)
+        self.max_restarts = max_restarts
+
+    def num_healthy(self) -> int:
+        return sum(self._healthy)
+
+    @property
+    def actors(self) -> List[Any]:
+        return [a for a, h in zip(self._actors, self._healthy) if h]
+
+    def foreach(self, fn: Callable[[Any], Any],
+                timeout: float = 300.0) -> List[Any]:
+        """fn(actor) -> ObjectRef for each healthy actor; gather results,
+        marking failures unhealthy (and restarting them if possible).
+        Returns results from the actors that succeeded."""
+        refs = []
+        idxs = []
+        for i, (a, h) in enumerate(zip(self._actors, self._healthy)):
+            if not h:
+                continue
+            refs.append(fn(a))
+            idxs.append(i)
+        results = []
+        for i, ref in zip(idxs, refs):
+            try:
+                results.append(ray_tpu.get(ref, timeout=timeout))
+            except Exception:
+                self._mark_unhealthy(i)
+        return results
+
+    def _mark_unhealthy(self, i: int) -> None:
+        self._healthy[i] = False
+        if self._restart_fn is not None and \
+                self._restarts[i] < self.max_restarts:
+            try:
+                ray_tpu.kill(self._actors[i])
+            except Exception:
+                pass
+            self._actors[i] = self._restart_fn()
+            self._restarts[i] += 1
+            self._healthy[i] = True
+
+    def probe_health(self, timeout: float = 10.0) -> int:
+        """Ping every actor (even marked-unhealthy ones after restart)."""
+        for i, a in enumerate(self._actors):
+            try:
+                ray_tpu.get(a.ping.remote(), timeout=timeout)
+                self._healthy[i] = True
+            except Exception:
+                self._mark_unhealthy(i)
+        return self.num_healthy()
+
+    def stop(self) -> None:
+        for a in self._actors:
+            try:
+                ray_tpu.kill(a)
+            except Exception:
+                pass
+        self._healthy = [False] * len(self._actors)
