@@ -676,6 +676,12 @@ class CoreWorker:
                         self._store_task_error(
                             spec, RayTaskError(f"worker died: {e}"))
                     return
+                # SPREAD asks for per-task placement decisions: draining the
+                # whole queue through one cached lease would funnel every
+                # task onto the first node that answered. One task per
+                # lease; the caller loop re-requests for the rest.
+                if spec.strategy == task_mod.STRATEGY_SPREAD:
+                    return
         finally:
             try:
                 raylet = await self._clients.get(raylet_addr)

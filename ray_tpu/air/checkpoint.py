@@ -26,6 +26,12 @@ class Checkpoint:
     _METADATA_FILE = ".metadata.json"
     _DICT_FILE = "_dict_checkpoint.pkl"
 
+    # Lifecycle hints consumed by train/tune sessions (not user API):
+    # _persisted — already in durable trial storage, pass by reference;
+    # _temp_source — staged in a throwaway tempdir, delete after persist.
+    _persisted = False
+    _temp_source = False
+
     def __init__(self, path: str):
         self.path = os.path.abspath(path)
 
@@ -46,7 +52,11 @@ class Checkpoint:
         d = tempfile.mkdtemp(prefix="ckpt_", dir=base_dir)
         with open(os.path.join(d, cls._DICT_FILE), "wb") as f:
             pickle.dump(data, f, protocol=pickle.HIGHEST_PROTOCOL)
-        return cls(d)
+        ckpt = cls(d)
+        # The tempdir exists only to carry this data to a persist step;
+        # sessions reclaim it after copying (session._persist_checkpoint).
+        ckpt._temp_source = True
+        return ckpt
 
     # -- access ------------------------------------------------------------
 

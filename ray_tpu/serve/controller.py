@@ -53,6 +53,10 @@ class ServeController:
         self._replica_cls = ray_tpu.remote(Replica)
         self._running = True
         self._lock = threading.RLock()
+        # Replicas removed from routing but still finishing in-flight
+        # requests: (replica, kill deadline). Reference: graceful replica
+        # shutdown in `deployment_state.py` (stop routing → drain → kill).
+        self._draining: List[Tuple[Any, float]] = []
 
     # -- API ---------------------------------------------------------------
 
@@ -71,8 +75,11 @@ class ServeController:
                                   init_kwargs, config, route_prefix)
             if existing is not None:
                 st.version = existing.version + 1
-                for r in existing.replicas:
-                    self._kill(r)
+                # Old replicas leave routing now (the bumped version makes
+                # routers drop them) but keep serving in-flight requests
+                # until drained — no hard cutover failures.
+                self._start_drain(existing.replicas,
+                                  existing.config.graceful_shutdown_timeout_s)
             self._deployments[name] = st
             self._reconcile_one(st)
 
@@ -113,6 +120,9 @@ class ServeController:
         with self._lock:
             for name in list(self._deployments):
                 self.delete_deployment(name)
+            for r, _ in self._draining:
+                self._kill(r)
+            self._draining = []
 
     # -- reconciliation ----------------------------------------------------
 
@@ -128,7 +138,41 @@ class ServeController:
                 return
             time.sleep(period_s)
 
+    def _start_drain(self, replicas: List[Any], timeout_s: float) -> None:
+        deadline = time.monotonic() + max(timeout_s, 0.0)
+        self._draining.extend((r, deadline) for r in replicas)
+
+    def _process_draining(self) -> None:
+        with self._lock:
+            entries, self._draining = self._draining, []
+        keep: List[Tuple[Any, float]] = []
+        now = time.monotonic()
+        # One concurrent poll round, same shape as _poll_replicas.
+        polls = [(r, deadline, r.get_metrics.remote())
+                 for r, deadline in entries if now < deadline]
+        for r, deadline in entries:
+            if now >= deadline:
+                self._kill(r)
+        for r, deadline, ref in polls:
+            try:
+                m = ray_tpu.get(ref, timeout=10)
+                if m["ongoing"] <= 0:
+                    self._kill(r)
+                else:
+                    keep.append((r, deadline))
+            except Exception:
+                self._kill(r)
+        with self._lock:
+            self._draining = keep + self._draining
+            if not self._running:
+                # shutdown() ran while we were polling: nothing will call
+                # this again, so don't strand the survivors.
+                for r, _ in self._draining:
+                    self._kill(r)
+                self._draining = []
+
     def reconcile_now(self) -> None:
+        self._process_draining()
         with self._lock:
             names = list(self._deployments)
         for name in names:
@@ -174,7 +218,8 @@ class ServeController:
             st.replicas.append(r)
             changed = True
         while len(st.replicas) > st.target_replicas:
-            self._kill(st.replicas.pop())
+            self._start_drain([st.replicas.pop()],
+                              st.config.graceful_shutdown_timeout_s)
             changed = True
         if changed:
             st.version += 1

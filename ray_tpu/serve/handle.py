@@ -27,11 +27,12 @@ class DeploymentResponse:
     """
 
     def __init__(self, ref, router: Optional["Router"] = None,
-                 replica_idx: int = -1):
+                 replica_idx: int = -1, resubmit=None):
         self._ref = ref
         self._router = router
         self._replica_idx = replica_idx
         self._done = False
+        self._resubmit = resubmit
 
     def _mark_done(self):
         if not self._done and self._router is not None:
@@ -39,8 +40,35 @@ class DeploymentResponse:
             self._router.done(self._replica_idx)
 
     def result(self, timeout: Optional[float] = 60.0) -> Any:
+        deadline = (time.monotonic() + timeout) if timeout is not None \
+            else None
         try:
             return ray_tpu.get(self._ref, timeout=timeout)
+        except ray_tpu.ActorDiedError:
+            # The chosen replica was torn down (reconfigure / autoscale
+            # down) before this request completed. One retry against a
+            # freshly-routed replica covers the transient window. The
+            # retry spends the caller's remaining budget, never more.
+            if self._resubmit is None:
+                raise
+            remaining = None if deadline is None \
+                else deadline - time.monotonic()
+            if remaining is not None and remaining <= 0:
+                raise
+            self._mark_done()
+            resubmit, self._resubmit = self._resubmit, None
+            if self._router is not None:
+                self._router._refresh(force=True)
+            retry = resubmit()
+            self._ref = retry._ref
+            self._router = retry._router
+            self._replica_idx = retry._replica_idx
+            self._done = False
+            # This object took over the retry's in-flight accounting;
+            # neuter the temporary so its __del__ can't double-decrement.
+            retry._done = True
+            retry._router = None
+            return ray_tpu.get(self._ref, timeout=remaining)
         finally:
             self._mark_done()
 
@@ -132,9 +160,14 @@ class DeploymentHandle:
                      for a in args)
         kwargs = {k: (v.ref if isinstance(v, DeploymentResponse) else v)
                   for k, v in kwargs.items()}
+        return self._submit(args, kwargs)
+
+    def _submit(self, args, kwargs) -> DeploymentResponse:
         idx, replica = self._router.choose()
         ref = replica.handle_request.remote(self._method, args, kwargs)
-        return DeploymentResponse(ref, self._router, idx)
+        return DeploymentResponse(
+            ref, self._router, idx,
+            resubmit=lambda: self._submit(args, kwargs))
 
     def __reduce__(self):
         return (DeploymentHandle,

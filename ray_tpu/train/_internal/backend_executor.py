@@ -163,7 +163,15 @@ class BackendExecutor:
                 raise TrainingFailedError(
                     f"workers {pending} produced no result within {timeout}s")
             refs = {i: wg.workers[i].next_result.remote(5.0) for i in pending}
-            got = ray_tpu.get(list(refs.values()), timeout=60.0)
+            try:
+                got = ray_tpu.get(list(refs.values()), timeout=60.0)
+            except (ray_tpu.ActorDiedError, ray_tpu.RayTaskError,
+                    ray_tpu.GetTimeoutError) as e:
+                # A worker actor dying must route through the same
+                # retry-from-checkpoint path as a train-fn exception —
+                # FailureConfig(max_failures) covers actual crashes too.
+                raise TrainingFailedError(
+                    f"train worker died or stopped responding: {e}") from e
             still = []
             for i, item in zip(pending, got):
                 if item is None:

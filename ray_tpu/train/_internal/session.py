@@ -13,6 +13,7 @@ from __future__ import annotations
 import dataclasses
 import os
 import queue
+import shutil
 import threading
 from typing import Any, Dict, Optional
 
@@ -50,7 +51,13 @@ class _TrainSession:
                checkpoint: Optional[Checkpoint] = None) -> None:
         persisted_path = None
         if checkpoint is not None:
-            persisted_path = self._persist_checkpoint(checkpoint)
+            if getattr(checkpoint, "_persisted", False):
+                # Already in durable trial storage (e.g. Train's controller
+                # reporting through the Tune session): pass by reference —
+                # a copy here would escape num_to_keep eviction.
+                persisted_path = checkpoint.path
+            else:
+                persisted_path = self._persist_checkpoint(checkpoint)
             self._last_checkpoint = Checkpoint(persisted_path)
         item = {
             "metrics": dict(metrics),
@@ -81,6 +88,14 @@ class _TrainSession:
                      else os.path.join(dest + "_shards",
                                        f"rank_{self.config.world_rank}"))
         checkpoint.to_directory(rank_dest)
+        if getattr(checkpoint, "_temp_source", False):
+            # from_dict() staged the data in a throwaway tempdir; it has
+            # been copied into trial storage, so reclaim it now (long runs
+            # would otherwise leak one /tmp dir per report). Re-point the
+            # user's object at the persisted copy so it stays readable.
+            shutil.rmtree(checkpoint.path, ignore_errors=True)
+            checkpoint.path = rank_dest
+            checkpoint._temp_source = False
         return dest if self.config.world_rank == 0 else rank_dest
 
 

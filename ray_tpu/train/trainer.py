@@ -52,7 +52,12 @@ class BaseTrainer:
         raise NotImplementedError
 
     def fit(self) -> Result:
-        """Run via Tune when available (reference layering), else inline."""
+        """Run via Tune when available (reference layering), else inline.
+
+        Failures surface as exceptions, not silently as ``Result.error``
+        (reference `BaseTrainer.fit` raises TrainingFailedError,
+        `base_trainer.py:567`).
+        """
         try:
             from ray_tpu.tune.tuner import Tuner
         except ImportError:
@@ -62,7 +67,12 @@ class BaseTrainer:
             run_config=self.run_config,
         )
         grid = tuner.fit()
-        return grid[0]
+        result = grid[0]
+        if result.error is not None:
+            if isinstance(result.error, TrainingFailedError):
+                raise result.error
+            raise TrainingFailedError(str(result.error)) from result.error
+        return result
 
     def as_trainable(self):
         """Wrap as a Tune trainable function (reference
@@ -71,8 +81,13 @@ class BaseTrainer:
         trainer = self
 
         def train_func(config):
-            from ray_tpu.tune.trainable import session_report
-            trainer._run_training_loop(report_fn=session_report)
+            from ray_tpu.tune import trainable as t_mod
+            # On a Tune-side trial restart the session carries the restore
+            # checkpoint; it supersedes the original resume_from_checkpoint.
+            sess = t_mod.session_mod.get_session()
+            if sess is not None and sess.get_checkpoint() is not None:
+                trainer.resume_from_checkpoint = sess.get_checkpoint()
+            trainer._run_training_loop(report_fn=t_mod.session_report)
 
         train_func.__name__ = type(self).__name__
         tr = trainable_mod.wrap_function(train_func)
@@ -176,6 +191,11 @@ class DataParallelTrainer(BaseTrainer):
                     if lead.get("checkpoint_path") and \
                             lead["world_rank"] == 0:
                         checkpoint = Checkpoint(lead["checkpoint_path"])
+                        # Already in trial storage: the Tune session must
+                        # reference it, not re-copy it (a second persisted
+                        # copy would double disk use and escape the
+                        # CheckpointManager's num_to_keep eviction).
+                        checkpoint._persisted = True
                         ckpt_manager.register_checkpoint(
                             checkpoint, last_metrics)
                     if report_fn is not None:
