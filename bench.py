@@ -1,10 +1,26 @@
-"""Benchmark harness — prints ONE JSON line.
+"""Benchmark suite — prints ONE JSON line.
 
-Headline metric: 1:1 sync actor call throughput, the reference's own
-microbenchmark headline (`release/perf_metrics/microbenchmark.json`
-`1_1_actor_calls_sync` = 2,097/s on m5.16xlarge; harness
-`python/ray/_private/ray_perf.py`). Same shape here: one driver, one actor,
-round-trip method calls, wall-clocked.
+Headline: GPT-2-125M single-chip training throughput (tokens/sec/chip)
+with computed MFU — BASELINE.json's north-star metric ("Ray Train GPT-2
+tokens/sec/chip"). The reference repo has no checked-in tokens/sec number
+(BASELINE.md "Not in-repo"), so vs_baseline for the headline is derived
+from hardware peaks: the north star asks for >=0.9x of an A100+NCCL
+baseline, and at the commonly reported ~30% MFU for GPT-2-class DDP
+training an A100 (312 bf16 TFLOP/s) yields `0.30 * 312e12 /
+flops_per_token` tokens/s/chip. vs_baseline = ours / (0.9 * that).
+On CPU (no TPU attached) the headline falls back to the control-plane
+metric so the line is still comparable.
+
+The `suite` field carries the rest of the reference's microbenchmark
+shapes (`python/ray/_private/ray_perf.py`,
+`release/perf_metrics/microbenchmark.json`), each with its own
+vs_baseline against BASELINE.md:
+- 1:1 sync actor calls        (baseline 2,097/s)
+- 1:1 async actor calls       (baseline 9,063/s)
+- n:n async actor calls       (baseline 27,688/s)
+- single-client async tasks   (baseline 8,194/s)
+- single-client put GB/s      (baseline 20.1 GB/s)
+- single-client plasma get/s  (baseline 10,270/s)
 """
 
 from __future__ import annotations
@@ -12,48 +28,240 @@ from __future__ import annotations
 import json
 import time
 
+BASELINES = {
+    "1_1_actor_calls_sync": 2097.0,
+    "1_1_actor_calls_async": 9063.0,
+    "n_n_actor_calls_async": 27688.0,
+    "single_client_tasks_async": 8194.0,
+    "single_client_put_gigabytes": 20.1,
+    "single_client_get_calls": 10270.0,
+}
 
-BASELINE_ACTOR_CALLS_SYNC = 2097.0  # release/perf_metrics/microbenchmark.json
+A100_BF16_PEAK = 312e12
+A100_ASSUMED_MFU = 0.30
+NORTH_STAR_FACTOR = 0.9
 
 
-def bench_actor_calls_sync(duration_s: float = 5.0) -> float:
+# --------------------------------------------------------------------------
+# Model benchmark (runs directly on the local accelerator, no cluster —
+# matching the reference's release/train_tests harnesses which measure the
+# framework's compute path, not the control plane).
+# --------------------------------------------------------------------------
+
+def _tpu_peak_bf16_flops(dev) -> float:
+    """Per-chip bf16 peak by device generation (public spec sheets)."""
+    kind = getattr(dev, "device_kind", "").lower()
+    if "v5 lite" in kind or "v5e" in kind or "v5litepod" in kind:
+        return 197e12
+    if "v5p" in kind or "v5" in kind:
+        return 459e12
+    if "v6" in kind:
+        return 918e12
+    return 275e12  # v4 default
+
+def bench_gpt2_tokens_per_sec(steps: int = 20):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from ray_tpu.models import GPT, GPTConfig
+    from ray_tpu.models.gpt import cross_entropy_loss
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    # sized for one chip; on CPU shrink so the bench stays fast
+    if on_tpu:
+        cfg = GPTConfig.gpt2_125m(remat=False)
+        batch, seq = 8, 1024
+        peak_flops = _tpu_peak_bf16_flops(dev)
+    else:
+        cfg = GPTConfig.tiny()
+        batch, seq = 4, 128
+        peak_flops = None
+
+    model = GPT(cfg)
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(
+        rng.integers(0, cfg.vocab_size, (batch, seq + 1), np.int32))
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    params = jax.jit(model.init)(jax.random.PRNGKey(0), inputs)
+    tx = optax.adamw(3e-4)
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def train_step(params, opt_state, inputs, targets):
+        def loss_fn(p):
+            return cross_entropy_loss(model.apply(p, inputs), targets)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    # compile + warm. Sync by pulling the scalar loss to the host: on the
+    # axon-tunneled TPU platform block_until_ready does not actually wait,
+    # so a (tiny) device->host transfer that depends on the final step is
+    # the only reliable fence.
+    params, opt_state, loss = train_step(params, opt_state, inputs, targets)
+    float(loss)
+
+    start = time.perf_counter()
+    for _ in range(steps):
+        params, opt_state, loss = train_step(params, opt_state, inputs,
+                                             targets)
+    float(loss)
+    elapsed = time.perf_counter() - start
+
+    tokens_per_step = batch * seq
+    tokens_per_sec = tokens_per_step * steps / elapsed
+
+    n_params = sum(
+        x.size for x in jax.tree_util.tree_leaves(params))
+    # PaLM appendix-B accounting: 6N matmul + 12*L*h*s attention
+    # flops per token (fwd+bwd).
+    flops_per_token = 6 * n_params + \
+        12 * cfg.n_layer * cfg.d_model * seq
+    result = {
+        "tokens_per_sec_per_chip": round(tokens_per_sec, 1),
+        "platform": dev.platform,
+        "params": int(n_params),
+        "batch": batch,
+        "seq": seq,
+    }
+    if peak_flops is not None:
+        mfu = tokens_per_sec * flops_per_token / peak_flops
+        a100_tokens = A100_ASSUMED_MFU * A100_BF16_PEAK / flops_per_token
+        result["mfu"] = round(mfu, 4)
+        result["vs_baseline"] = round(
+            tokens_per_sec / (NORTH_STAR_FACTOR * a100_tokens), 3)
+    return result
+
+
+# --------------------------------------------------------------------------
+# Control-plane microbenchmarks (reference ray_perf.py shapes).
+# --------------------------------------------------------------------------
+
+def bench_control_plane():
+    import numpy as np
+
     import ray_tpu
 
-    ray_tpu.init(num_cpus=4)
+    out = {}
+    ray_tpu.init(num_cpus=8, object_store_memory=1 << 30)
     try:
         @ray_tpu.remote
         class Sink:
             def ping(self):
                 return None
 
-        actor = Sink.remote()
-        ray_tpu.get(actor.ping.remote())  # warm-up / actor creation
+        @ray_tpu.remote
+        def noop():
+            return None
 
-        # Warm loop.
+        # -- 1:1 sync actor calls ---------------------------------------
+        actor = Sink.remote()
+        ray_tpu.get(actor.ping.remote())
         for _ in range(100):
             ray_tpu.get(actor.ping.remote())
-
-        n = 0
-        start = time.perf_counter()
-        while True:
+        n, start = 0, time.perf_counter()
+        while time.perf_counter() - start < 3.0:
             for _ in range(100):
                 ray_tpu.get(actor.ping.remote())
             n += 100
-            elapsed = time.perf_counter() - start
-            if elapsed >= duration_s:
-                return n / elapsed
+        out["1_1_actor_calls_sync"] = n / (time.perf_counter() - start)
+
+        # -- 1:1 async actor calls (pipelined, batched gets) ------------
+        n, start = 0, time.perf_counter()
+        while time.perf_counter() - start < 3.0:
+            refs = [actor.ping.remote() for _ in range(1000)]
+            ray_tpu.get(refs)
+            n += 1000
+        out["1_1_actor_calls_async"] = n / (time.perf_counter() - start)
+
+        # -- n:n async actor calls --------------------------------------
+        n_actors = 8
+        actors = [Sink.remote() for _ in range(n_actors)]
+        ray_tpu.get([a.ping.remote() for a in actors])
+        n, start = 0, time.perf_counter()
+        while time.perf_counter() - start < 3.0:
+            refs = [a.ping.remote() for a in actors for _ in range(200)]
+            ray_tpu.get(refs)
+            n += len(refs)
+        out["n_n_actor_calls_async"] = n / (time.perf_counter() - start)
+
+        # -- single-client async tasks ----------------------------------
+        ray_tpu.get(noop.remote())
+        n, start = 0, time.perf_counter()
+        while time.perf_counter() - start < 3.0:
+            refs = [noop.remote() for _ in range(1000)]
+            ray_tpu.get(refs)
+            n += 1000
+        out["single_client_tasks_async"] = n / (time.perf_counter() - start)
+
+        # -- put throughput (GB/s, zero-copy numpy into shm) ------------
+        arr = np.ones(64 * 1024 * 1024, np.uint8)  # 64 MiB
+        ray_tpu.put(arr)  # warm
+        n, start = 0, time.perf_counter()
+        while time.perf_counter() - start < 3.0:
+            ray_tpu.put(arr)
+            n += 1
+        out["single_client_put_gigabytes"] = (
+            n * arr.nbytes / (time.perf_counter() - start) / 1e9)
+
+        # -- plasma get calls/s (small objects through the store) -------
+        small_ref = ray_tpu.put(np.ones(1024, np.uint8))
+        for _ in range(100):
+            ray_tpu.get(small_ref)
+        n, start = 0, time.perf_counter()
+        while time.perf_counter() - start < 3.0:
+            for _ in range(100):
+                ray_tpu.get(small_ref)
+            n += 100
+        out["single_client_get_calls"] = n / (time.perf_counter() - start)
     finally:
         ray_tpu.shutdown()
+    return out
 
 
 def main():
-    value = bench_actor_calls_sync()
-    print(json.dumps({
-        "metric": "1_1_actor_calls_sync",
-        "value": round(value, 1),
-        "unit": "calls/s",
-        "vs_baseline": round(value / BASELINE_ACTOR_CALLS_SYNC, 3),
-    }))
+    suite = {}
+
+    try:
+        gpt2 = bench_gpt2_tokens_per_sec()
+    except Exception as e:  # noqa: BLE001
+        gpt2 = {"error": repr(e)[:300]}
+    suite["gpt2_125m_train"] = gpt2
+
+    try:
+        cp = bench_control_plane()
+        for k, v in cp.items():
+            suite[k] = {
+                "value": round(v, 2),
+                "vs_baseline": round(v / BASELINES[k], 3)
+                if k in BASELINES else None,
+            }
+    except Exception as e:  # noqa: BLE001
+        suite["control_plane_error"] = repr(e)[:300]
+
+    if "tokens_per_sec_per_chip" in gpt2 and gpt2.get("platform") == "tpu":
+        headline = {
+            "metric": "gpt2_125m_tokens_per_sec_per_chip",
+            "value": gpt2["tokens_per_sec_per_chip"],
+            "unit": "tokens/s",
+            "vs_baseline": gpt2.get("vs_baseline"),
+            "mfu": gpt2.get("mfu"),
+        }
+    else:
+        # no TPU attached: headline falls back to the control-plane number
+        cp_sync = suite.get("1_1_actor_calls_sync", {})
+        headline = {
+            "metric": "1_1_actor_calls_sync",
+            "value": cp_sync.get("value"),
+            "unit": "calls/s",
+            "vs_baseline": cp_sync.get("vs_baseline"),
+        }
+    headline["suite"] = suite
+    print(json.dumps(headline))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,32 @@
+"""ray_tpu.rllib — reinforcement learning on the new API stack.
+
+Reference: `rllib/` (new stack only: RLModule / Learner / EnvRunner /
+ConnectorV2 — SURVEY.md §2.5). JAX/Flax throughout; learner updates are
+jitted, scaled over local device meshes (GSPMD) and learner actors.
+"""
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
+from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
+from ray_tpu.rllib.core.learner import DQNLearner, Learner, PPOLearner
+from ray_tpu.rllib.core.learner_group import LearnerGroup
+from ray_tpu.rllib.core.rl_module import (
+    ActorCriticModule,
+    Columns,
+    QModule,
+    RLModule,
+    RLModuleSpec,
+)
+from ray_tpu.rllib.env.env_runner import (
+    EnvRunnerGroup,
+    Episode,
+    SingleAgentEnvRunner,
+)
+
+__all__ = [
+    "Algorithm", "AlgorithmConfig", "PPO", "PPOConfig", "DQN",
+    "DQNConfig", "Learner", "PPOLearner", "DQNLearner", "LearnerGroup",
+    "RLModule", "RLModuleSpec", "ActorCriticModule", "QModule",
+    "Columns", "EnvRunnerGroup", "SingleAgentEnvRunner", "Episode",
+]

@@ -1,0 +1,7 @@
+from ray_tpu.rllib.algorithms.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
+from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
+
+__all__ = ["Algorithm", "AlgorithmConfig", "PPO", "PPOConfig", "DQN",
+           "DQNConfig"]

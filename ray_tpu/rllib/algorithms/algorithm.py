@@ -1,0 +1,142 @@
+"""Algorithm: the top-level RL trainer, runnable standalone or under Tune.
+
+Reference: `rllib/algorithms/algorithm.py:213` — Algorithm subclasses
+Tune's `Trainable`; `setup` (:579) builds the `EnvRunnerGroup` +
+`LearnerGroup`, and each `train()`/`step()` call runs the per-algorithm
+`training_step` (:1586) that orchestrates sample → update → weight
+broadcast. Same shape here: subclass `ray_tpu.tune.Trainable`, so
+`Tuner(PPO, param_space=...)` works exactly like a Train/Tune run.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, Dict, Optional, Type
+
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.core.learner import Learner
+from ray_tpu.rllib.core.learner_group import LearnerGroup
+from ray_tpu.rllib.core.rl_module import RLModuleSpec
+from ray_tpu.rllib.env.env_runner import EnvRunnerGroup
+from ray_tpu.tune.trainable import Trainable
+
+
+class Algorithm(Trainable):
+    """Drive sample→update→broadcast; one `step()` = one training
+    iteration (reference `training_step`)."""
+
+    #: subclasses bind their Learner and default config
+    learner_cls: Type[Learner] = None
+    config_cls: Type[AlgorithmConfig] = AlgorithmConfig
+
+    def __init__(self, config: Optional[AlgorithmConfig] = None):
+        super().__init__()
+        self._algo_config = config
+        self.env_runner_group: Optional[EnvRunnerGroup] = None
+        self.learner_group: Optional[LearnerGroup] = None
+        self._setup_called = False
+        if config is not None:
+            # standalone construction (config.build_algo()) — Tune-hosted
+            # instances defer to setup(param_space_dict)
+            self.setup({})
+
+    # -- Trainable interface ----------------------------------------------
+
+    def setup(self, config: Dict[str, Any]) -> None:
+        if self._setup_called:
+            return
+        self._setup_called = True
+        cfg = (self._algo_config.copy() if self._algo_config is not None
+               else self.default_config())
+        if config:
+            cfg.update_from_dict(config)
+        self.algo_config = cfg
+        env_creator = cfg.env_creator()
+        probe = env_creator()
+        try:
+            obs_dim = int(np.prod(probe.observation_space.shape))
+            act_dim = int(probe.action_space.n)
+        finally:
+            probe.close()
+        self.spec = RLModuleSpec(
+            observation_dim=obs_dim, action_dim=act_dim,
+            hidden=cfg.hidden, module_class=cfg.module_class)
+        self.learner_group = LearnerGroup(
+            type(self).learner_cls, self.spec, cfg.learner_config(),
+            num_learners=cfg.num_learners,
+            num_devices_per_learner=cfg.num_devices_per_learner,
+            seed=cfg.seed,
+            resources_per_learner=cfg.resources_per_learner)
+        self.env_runner_group = EnvRunnerGroup(
+            env_creator, self.spec,
+            num_env_runners=cfg.num_env_runners,
+            num_envs_per_runner=cfg.num_envs_per_env_runner,
+            seed=cfg.seed, explore_config=cfg.explore_config)
+        self.env_runner_group.sync_weights(
+            self.learner_group.get_weights())
+        self._iteration = 0
+
+    @classmethod
+    def default_config(cls) -> AlgorithmConfig:
+        return cls.config_cls(algo_class=cls)
+
+    def step(self) -> Dict[str, Any]:
+        self._iteration += 1
+        results = self.training_step()
+        metrics = self.env_runner_group.get_metrics()
+        returns = [m["episode_return_mean"] for m in metrics
+                   if m.get("episode_return_mean") is not None]
+        results["episode_return_mean"] = (
+            float(np.mean(returns)) if returns else float("nan"))
+        results["num_episodes"] = int(
+            sum(m.get("num_episodes", 0) for m in metrics))
+        results["training_iteration"] = self._iteration
+        return results
+
+    def train(self) -> Dict[str, Any]:
+        """Standalone stepping (outside Tune): one training iteration."""
+        return self.step()
+
+    def training_step(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    # -- checkpointing -----------------------------------------------------
+
+    def save_checkpoint(self, checkpoint_dir: str) -> None:
+        state = {
+            "learner": self.learner_group.get_state(),
+            "iteration": self._iteration,
+            "algo_state": self.get_algo_state(),
+        }
+        with open(os.path.join(checkpoint_dir, "algorithm.pkl"),
+                  "wb") as f:
+            pickle.dump(state, f)
+
+    def load_checkpoint(self, checkpoint_dir: str) -> None:
+        with open(os.path.join(checkpoint_dir, "algorithm.pkl"),
+                  "rb") as f:
+            state = pickle.load(f)
+        self.learner_group.set_state(state["learner"])
+        self._iteration = state["iteration"]
+        self.set_algo_state(state.get("algo_state", {}))
+        self.env_runner_group.sync_weights(
+            self.learner_group.get_weights())
+
+    def get_algo_state(self) -> Dict[str, Any]:
+        """Algorithm-specific extra state (e.g. DQN epsilon schedule)."""
+        return {}
+
+    def set_algo_state(self, state: Dict[str, Any]) -> None:
+        pass
+
+    def cleanup(self) -> None:
+        self.stop()
+
+    def stop(self) -> None:
+        if self.env_runner_group is not None:
+            self.env_runner_group.stop()
+        if self.learner_group is not None:
+            self.learner_group.stop()

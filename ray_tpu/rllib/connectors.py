@@ -57,20 +57,22 @@ class GAE:
         self.module = module
         self.params_getter = params_getter
 
-    def _bootstrap_value(self, ep: Episode) -> float:
-        if ep.terminated or self.module is None or \
-                self.params_getter is None:
+    def _bootstrap_value(self, ep: Episode, params) -> float:
+        if ep.terminated or self.module is None or params is None:
             return 0.0
-        out = self.module.forward_inference(
-            self.params_getter(), ep.last_obs[None, :])
+        out = self.module.forward_inference(params, ep.last_obs[None, :])
         return float(np.asarray(out[Columns.VF_PREDS])[0])
 
     def __call__(self, episodes: List[Episode], batch: Batch) -> Batch:
+        # fetch weights once per batch — in remote-learner mode the
+        # getter is an actor round-trip
+        params = (self.params_getter()
+                  if self.params_getter is not None else None)
         advs, targets = [], []
         for ep in episodes:
             rewards = np.asarray(ep.rewards, np.float32)
             values = np.asarray(ep.vf_preds, np.float32)
-            last_v = self._bootstrap_value(ep)
+            last_v = self._bootstrap_value(ep, params)
             next_values = np.append(values[1:], last_v)
             deltas = rewards + self.gamma * next_values - values
             adv = np.zeros_like(deltas)

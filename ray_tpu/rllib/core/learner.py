@@ -27,10 +27,17 @@ from ray_tpu.rllib.core.rl_module import (
 
 
 class Learner:
-    """Owns params + optimizer state; subclasses define compute_loss."""
+    """Owns params + optimizer state; subclasses define compute_loss.
+
+    With ``num_devices > 1`` the learner shards the batch over a local
+    ``dp`` device mesh (`NamedSharding`): params stay replicated, XLA
+    inserts the gradient psum over ICI — the GSPMD replacement for the
+    reference's intra-learner DDP.
+    """
 
     def __init__(self, spec: RLModuleSpec,
-                 config: Optional[Dict[str, Any]] = None, seed: int = 0):
+                 config: Optional[Dict[str, Any]] = None, seed: int = 0,
+                 num_devices: int = 1):
         self.spec = spec
         self.config = dict(config or {})
         self.module: RLModule = spec.build()
@@ -42,6 +49,46 @@ class Learner:
                               optax.adam(lr))
         self.opt_state = self.tx.init(self.params)
         self._update_jit = jax.jit(self._update)
+        self.mesh = None
+        self._batch_sharding = None
+        if num_devices > 1:
+            from jax.sharding import Mesh, NamedSharding, PartitionSpec
+            devs = jax.devices()[:num_devices]
+            if len(devs) < num_devices:
+                raise ValueError(
+                    f"learner asked for {num_devices} devices, "
+                    f"have {len(devs)}")
+            self.mesh = Mesh(np.asarray(devs), ("dp",))
+            self._batch_sharding = NamedSharding(self.mesh,
+                                                 PartitionSpec("dp"))
+            self._replicated = NamedSharding(self.mesh, PartitionSpec())
+            self.params = jax.device_put(self.params, self._replicated)
+            self.opt_state = jax.device_put(self.opt_state,
+                                            self._replicated)
+
+    def _device_batch(self, batch: Dict[str, np.ndarray]) -> Dict:
+        """Move a host batch onto the learner's devices: row-sharded over
+        the dp mesh when present (trimming to a divisible size), else a
+        plain transfer."""
+        if self._batch_sharding is None:
+            return {k: jnp.asarray(v) for k, v in batch.items()}
+        n = self.mesh.shape["dp"]
+        rows = min(v.shape[0] for v in batch.values())
+        keep = (rows // n) * n
+        if keep == 0:
+            # fewer rows than devices: tile up to one row per device
+            # rather than producing an empty (NaN-gradient) batch
+            reps = -(-n // rows)
+            return {
+                k: jax.device_put(
+                    np.concatenate([np.asarray(v[:rows])] * reps)[:n],
+                    self._batch_sharding)
+                for k, v in batch.items()
+            }
+        return {
+            k: jax.device_put(np.asarray(v[:keep]), self._batch_sharding)
+            for k, v in batch.items()
+        }
 
     # -- to be provided by algorithm-specific subclasses -------------------
 
@@ -68,25 +115,36 @@ class Learner:
 
     def update_from_batch(self, batch: Dict[str, np.ndarray]
                           ) -> Dict[str, float]:
-        batch = {k: jnp.asarray(v) for k, v in batch.items()}
+        batch = self._device_batch(batch)
         self.params, self.opt_state, stats = self._update_jit(
             self.params, self.opt_state, batch, self._aux_state())
+        self._after_update()
         return {k: float(v) for k, v in stats.items()}
 
     def compute_gradients(self, batch: Dict[str, np.ndarray]):
         """Grads without applying (LearnerGroup DP averaging path)."""
-        batch = {k: jnp.asarray(v) for k, v in batch.items()}
-        (_, stats), grads = jax.value_and_grad(
+        batch = self._device_batch(batch)
+        (loss, stats), grads = jax.value_and_grad(
             self.compute_loss, has_aux=True)(
                 self.params, batch, self._aux_state())
-        return params_to_numpy(grads), {k: float(v)
-                                        for k, v in stats.items()}
+        out = {k: float(v) for k, v in stats.items()}
+        out["total_loss"] = float(loss)
+        return params_to_numpy(grads), out
 
     def apply_gradients(self, grads) -> None:
         grads = jax.tree_util.tree_map(jnp.asarray, grads)
         updates, self.opt_state = self.tx.update(grads, self.opt_state,
                                                  self.params)
         self.params = optax.apply_updates(self.params, updates)
+        self._after_update()
+
+    def _after_update(self) -> None:
+        """Post-optimizer-step hook (DQN target sync); runs on every
+        update path — local `update_from_batch` AND the LearnerGroup
+        grad-averaging `apply_gradients` path."""
+
+    def ping(self) -> bool:
+        return True
 
     # -- weights -----------------------------------------------------------
 
@@ -97,10 +155,17 @@ class Learner:
         self.params = jax.tree_util.tree_map(jnp.asarray, weights)
 
     def get_state(self) -> Dict[str, Any]:
-        return {"weights": self.get_weights()}
+        """Full learner state: weights AND optimizer moments — a restore
+        that drops Adam state silently resets the optimizer (reference
+        `Learner.get_state` also carries optimizer state)."""
+        return {"weights": self.get_weights(),
+                "opt_state": params_to_numpy(self.opt_state)}
 
     def set_state(self, state: Dict[str, Any]) -> None:
         self.set_weights(state["weights"])
+        if "opt_state" in state:
+            self.opt_state = jax.tree_util.tree_map(
+                jnp.asarray, state["opt_state"])
 
 
 class PPOLearner(Learner):
@@ -138,8 +203,9 @@ class DQNLearner(Learner):
     `rllib/algorithms/dqn/torch/dqn_torch_learner.py`)."""
 
     def __init__(self, spec: RLModuleSpec,
-                 config: Optional[Dict[str, Any]] = None, seed: int = 0):
-        super().__init__(spec, config, seed)
+                 config: Optional[Dict[str, Any]] = None, seed: int = 0,
+                 num_devices: int = 1):
+        super().__init__(spec, config, seed, num_devices)
         self.target_params = self.params
         self._steps = 0
         self.target_update_freq = self.config.get("target_update_freq", 100)
@@ -172,12 +238,23 @@ class DQNLearner(Learner):
         return loss, {"td_error_mean": jnp.mean(jnp.abs(td)),
                       "q_mean": jnp.mean(q_taken)}
 
-    def update_from_batch(self, batch):
-        stats = super().update_from_batch(batch)
+    def _after_update(self) -> None:
         self._steps += 1
         if self._steps % self.target_update_freq == 0:
             self.target_params = self.params
-        return stats
+
+    def get_state(self):
+        state = super().get_state()
+        state["target_params"] = params_to_numpy(self.target_params)
+        state["steps"] = self._steps
+        return state
+
+    def set_state(self, state) -> None:
+        super().set_state(state)
+        if "target_params" in state:
+            self.target_params = jax.tree_util.tree_map(
+                jnp.asarray, state["target_params"])
+            self._steps = state.get("steps", self._steps)
 
     def td_errors(self, batch: Dict[str, np.ndarray]) -> np.ndarray:
         """|TD| per transition (for prioritized-replay updates)."""

@@ -66,6 +66,11 @@ class SingleAgentEnvRunner:
         import jax.numpy as jnp
         self._params = jax.tree_util.tree_map(jnp.asarray, weights)
 
+    def set_explore_config(self, explore_config: Dict[str, Any]) -> None:
+        """Update exploration kwargs (e.g. DQN's decayed epsilon) passed
+        to the module's forward_exploration on subsequent samples."""
+        self._explore = dict(explore_config)
+
     def sample(self, num_steps: int = 200,
                explore: bool = True) -> List[Episode]:
         """Collect ≥num_steps env steps; returns closed + open fragments."""
@@ -162,6 +167,13 @@ class EnvRunnerGroup:
             self.local_runner.set_weights(weights)
         else:
             self.manager.foreach(lambda a: a.set_weights.remote(weights))
+
+    def set_explore_config(self, explore_config: Dict[str, Any]) -> None:
+        if self.local_runner is not None:
+            self.local_runner.set_explore_config(explore_config)
+        else:
+            self.manager.foreach(
+                lambda a: a.set_explore_config.remote(explore_config))
 
     def sample(self, num_steps: int) -> List[Episode]:
         if self.local_runner is not None:

@@ -21,6 +21,7 @@ class FaultTolerantActorManager:
         self._restart_fn = restart_fn
         self._restarts = [0] * len(actors)
         self.max_restarts = max_restarts
+        self._restarted_idxs: set = set()
 
     def num_healthy(self) -> int:
         return sum(self._healthy)
@@ -29,18 +30,11 @@ class FaultTolerantActorManager:
     def actors(self) -> List[Any]:
         return [a for a, h in zip(self._actors, self._healthy) if h]
 
-    def foreach(self, fn: Callable[[Any], Any],
-                timeout: float = 300.0) -> List[Any]:
-        """fn(actor) -> ObjectRef for each healthy actor; gather results,
-        marking failures unhealthy (and restarting them if possible).
-        Returns results from the actors that succeeded."""
-        refs = []
-        idxs = []
-        for i, (a, h) in enumerate(zip(self._actors, self._healthy)):
-            if not h:
-                continue
-            refs.append(fn(a))
-            idxs.append(i)
+    def _gather(self, refs: List[Any], idxs: List[int],
+                timeout: float) -> List[Any]:
+        """Collect results, marking failed actors unhealthy (and
+        restarting them when possible). Shared failure path for every
+        fan-out variant."""
         results = []
         for i, ref in zip(idxs, refs):
             try:
@@ -48,6 +42,44 @@ class FaultTolerantActorManager:
             except Exception:
                 self._mark_unhealthy(i)
         return results
+
+    def foreach(self, fn: Callable[[Any], Any],
+                timeout: float = 300.0) -> List[Any]:
+        """fn(actor) -> ObjectRef for each healthy actor; returns results
+        from the actors that succeeded."""
+        return self.foreach_zip(lambda a, _item: fn(a),
+                                [None] * len(self._actors),
+                                timeout=timeout)
+
+    def foreach_zip(self, fn: Callable[[Any, Any], Any], items: List[Any],
+                    timeout: float = 300.0) -> List[Any]:
+        """fn(actor, item) -> ObjectRef, pairing healthy actors with items
+        positionally; failures are marked unhealthy and dropped."""
+        refs, idxs = [], []
+        healthy = [(i, a) for i, (a, h)
+                   in enumerate(zip(self._actors, self._healthy)) if h]
+        for (i, a), item in zip(healthy, items):
+            refs.append(fn(a, item))
+            idxs.append(i)
+        return self._gather(refs, idxs, timeout)
+
+    def foreach_one(self, fn: Callable[[Any], Any],
+                    timeout: float = 300.0,
+                    exclude: Optional[set] = None) -> List[Any]:
+        """fn on the first healthy actor only (skipping ``exclude``
+        indices while an alternative exists); returns a one-element list
+        (empty if every actor is dead)."""
+        order = [i for i, h in enumerate(self._healthy) if h]
+        if exclude:
+            preferred = [i for i in order if i not in exclude]
+            order = preferred + [i for i in order if i in exclude]
+        for i in order:
+            if not self._healthy[i]:
+                continue
+            got = self._gather([fn(self._actors[i])], [i], timeout)
+            if got:
+                return got
+        return []
 
     def _mark_unhealthy(self, i: int) -> None:
         self._healthy[i] = False
@@ -60,6 +92,15 @@ class FaultTolerantActorManager:
             self._actors[i] = self._restart_fn()
             self._restarts[i] += 1
             self._healthy[i] = True
+            self._restarted_idxs.add(i)
+
+    def take_restarted(self) -> set:
+        """Indices of actors restarted since the last call — callers that
+        replicate state across the fleet (LearnerGroup) must re-sync the
+        fresh replicas (from a NON-restarted survivor) when non-empty."""
+        fired = self._restarted_idxs
+        self._restarted_idxs = set()
+        return fired
 
     def probe_health(self, timeout: float = 10.0) -> int:
         """Ping every actor (even marked-unhealthy ones after restart)."""
