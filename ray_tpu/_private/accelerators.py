@@ -1,0 +1,82 @@
+"""TPU accelerator abstraction: chip detection + pod-slice topology.
+
+Reference: `python/ray/_private/accelerators/tpu.py:75` —
+`TPUAcceleratorManager` detects chips via `/dev/accel*` (:104-120), reads
+pod topology from instance metadata (:199), advertises `TPU-{version}`
+accelerator resources (:312-315) and a one-per-slice
+`TPU-{pod_type}-head` resource on worker 0 (:363-388).
+
+TPU-first delta: the reference leaves the head-resource convention to
+user code (fan out one task per host by hand, doc comment tpu.py:341-369).
+Here the slice is promoted into the scheduler itself — raylets carry
+slice labels, and the GCS places slice-topology placement groups
+atomically (see `scheduling.place_slice_bundles`) — so gang scheduling a
+pod slice is a first-class primitive, not a convention.
+
+Slice metadata comes from env vars (set by the TPU-VM runtime or by the
+test Cluster): `TPU_ACCELERATOR_TYPE` (e.g. "v4-16"), `TPU_WORKER_ID`
+(host index in the slice), `TPU_SLICE_NAME` (unique slice identity;
+falls back to the pod name), `TPU_WORKER_HOSTNAMES` (to count hosts).
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+from typing import Dict, Optional
+
+# label keys carried by every raylet in a slice
+LABEL_SLICE_NAME = "ray_tpu.slice_name"
+LABEL_SLICE_TYPE = "ray_tpu.slice_type"
+LABEL_SLICE_HOST_ID = "ray_tpu.slice_host_id"
+LABEL_SLICE_NUM_HOSTS = "ray_tpu.slice_num_hosts"
+
+
+def num_local_chips() -> int:
+    """Detect this host's TPU chip count (reference tpu.py:104-120:
+    /dev/accel* then /dev/vfio; env override first for tests)."""
+    env = os.environ.get("TPU_CHIP_COUNT")
+    if env:
+        return int(env)
+    accel = glob.glob("/dev/accel*")
+    if accel:
+        return len(accel)
+    vfio = glob.glob("/dev/vfio/[0-9]*")
+    if vfio:
+        return len(vfio)
+    return 0
+
+
+def head_resource_name(slice_type: str) -> str:
+    """`TPU-{pod_type}-head` (reference tpu.py:363)."""
+    return f"TPU-{slice_type}-head"
+
+
+def slice_env() -> Optional[Dict[str, str]]:
+    """Slice membership labels for this host, or None when the host is
+    not part of a TPU pod slice."""
+    slice_type = os.environ.get("TPU_ACCELERATOR_TYPE")
+    if not slice_type:
+        return None
+    host_id = int(os.environ.get("TPU_WORKER_ID", "0"))
+    name = os.environ.get("TPU_SLICE_NAME") or \
+        os.environ.get("TPU_NAME") or f"slice-{slice_type}"
+    hostnames = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    num_hosts = len(hostnames.split(",")) if hostnames else 1
+    return {
+        LABEL_SLICE_NAME: name,
+        LABEL_SLICE_TYPE: slice_type,
+        LABEL_SLICE_HOST_ID: str(host_id),
+        LABEL_SLICE_NUM_HOSTS: str(num_hosts),
+    }
+
+
+def slice_resources(labels: Dict[str, str]) -> Dict[str, float]:
+    """Extra resources a raylet derives from its slice labels: host 0
+    carries the one-per-slice head resource so a driver can target "one
+    task per slice" exactly as in the reference convention."""
+    if labels.get(LABEL_SLICE_TYPE) is None:
+        return {}
+    if int(labels.get(LABEL_SLICE_HOST_ID, "0")) != 0:
+        return {}
+    return {head_resource_name(labels[LABEL_SLICE_TYPE]): 1.0}

@@ -22,7 +22,12 @@ from typing import Any, Dict, List, Optional
 from ray_tpu._private import task as task_mod
 from ray_tpu._private.config import Config
 from ray_tpu._private.rpc import ClientPool, ConnectionLost, RpcError, RpcServer
-from ray_tpu._private.scheduling import ClusterView, pick_node, place_bundles
+from ray_tpu._private.scheduling import (
+    ClusterView,
+    pick_node,
+    place_bundles,
+    place_slice_bundles,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -118,7 +123,8 @@ class GcsServer:
             "labels": req.get("labels", {}),
         }
         self.view.update_node(node_id, req["raylet_addr"], req["total"],
-                              req["available"])
+                              req["available"],
+                              labels=req.get("labels", {}))
         self._last_heartbeat[node_id] = time.monotonic()
         await self.publish("nodes", {"event": "added", "node": self.nodes[node_id]})
         self._retry_wakeup.set()
@@ -146,6 +152,7 @@ class GcsServer:
                 "raylet_addr": n.raylet_addr,
                 "total": n.total,
                 "available": n.available,
+                "labels": n.labels,
             }
             for n in self.view.alive_nodes()
         ]
@@ -449,6 +456,9 @@ class GcsServer:
             "state": "PENDING",
             "bundle_nodes": [],
             "job_id": req.get("job_id"),
+            # TPU pod-slice topology (e.g. "v4-16"): bundles gang-place
+            # one-per-host onto a single complete slice, atomically
+            "topology": req.get("topology"),
         }
         self._pending_pgs.append(pg_id)
         self._retry_wakeup.set()
@@ -458,7 +468,12 @@ class GcsServer:
         pg = self.placement_groups.get(pg_id)
         if pg is None or pg["state"] != "PENDING":
             return True
-        placement = place_bundles(self.view, pg["bundles"], pg["strategy"])
+        if pg.get("topology"):
+            placement = place_slice_bundles(self.view, pg["bundles"],
+                                            pg["topology"])
+        else:
+            placement = place_bundles(self.view, pg["bundles"],
+                                      pg["strategy"])
         if placement is None:
             return False
         # Two-phase commit: prepare on every raylet, then commit (reference:
@@ -547,7 +562,14 @@ class GcsServer:
             if self._pending_pgs:
                 still_pgs: List[bytes] = []
                 for pg_id in self._pending_pgs:
-                    done = await self._schedule_pg(pg_id)
+                    try:
+                        done = await self._schedule_pg(pg_id)
+                    except Exception:  # noqa: BLE001
+                        # one malformed request must never kill the
+                        # scheduler loop for the whole cluster
+                        logger.exception("PG %s scheduling failed",
+                                         pg_id.hex()[:8])
+                        done = False
                     if not done:
                         still_pgs.append(pg_id)
                 self._pending_pgs = still_pgs

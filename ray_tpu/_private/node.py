@@ -117,7 +117,8 @@ class Cluster:
         self.gcs_addr = self.gcs.ready_line.split()[1]
 
     def add_node(self, resources: Dict[str, float],
-                 object_store_memory: int | None = None) -> NodeHandle:
+                 object_store_memory: int | None = None,
+                 labels: Dict[str, str] | None = None) -> NodeHandle:
         args = [
             sys.executable, "-m", "ray_tpu._private.raylet",
             "--gcs-addr", self.gcs_addr,
@@ -125,6 +126,9 @@ class Cluster:
             "--session-dir", self.session_dir,
             "--log-file", self._log(f"raylet-{len(self.nodes)}.log"),
         ]
+        # always explicit ({} for plain nodes): a test-cluster node must
+        # never inherit slice identity from the host's TPU-VM env vars
+        args += ["--labels", json.dumps(labels or {})]
         mem = object_store_memory or self.object_store_memory
         if mem:
             args += ["--object-store-memory", str(mem)]
@@ -134,6 +138,29 @@ class Cluster:
         self.nodes.append(node)
         return node
 
+    def add_slice(self, slice_type: str, num_hosts: int,
+                  chips_per_host: int = 4, cpus_per_host: float = 4.0,
+                  name: str | None = None) -> List[NodeHandle]:
+        """Simulate one TPU pod slice: `num_hosts` raylets sharing a slice
+        name, each owning its host-local chips (the reference's TPU-VM
+        topology, accelerators/tpu.py:341-369, as local processes — the
+        multi-host analogue of `ray_start_cluster`)."""
+        from ray_tpu._private import accelerators as acc
+
+        name = name or f"{slice_type}-{len(self.nodes)}"
+        handles = []
+        for host_id in range(num_hosts):
+            labels = {
+                acc.LABEL_SLICE_NAME: name,
+                acc.LABEL_SLICE_TYPE: slice_type,
+                acc.LABEL_SLICE_HOST_ID: str(host_id),
+                acc.LABEL_SLICE_NUM_HOSTS: str(num_hosts),
+            }
+            handles.append(self.add_node(
+                {"CPU": cpus_per_host, "TPU": float(chips_per_host)},
+                labels=labels))
+        return handles
+
     @property
     def head_node(self) -> NodeHandle:
         return self.nodes[0]
@@ -141,6 +168,12 @@ class Cluster:
     def remove_node(self, node: NodeHandle):
         node.process.terminate()
         self.nodes.remove(node)
+        # terminate() SIGKILLs after a 5s grace — the raylet may never
+        # reach its own store.destroy(), so reap the arena here too
+        try:
+            os.unlink(f"/dev/shm{node.store_name}")
+        except OSError:
+            pass
 
     def shutdown(self):
         # Arena cleanup is scoped to THIS session's stores — other clusters

@@ -27,6 +27,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
+from ray_tpu._private import accelerators
 from ray_tpu._private import task as task_mod
 from ray_tpu._private.config import Config
 from ray_tpu._private.ids import NodeID, ObjectID
@@ -72,6 +73,7 @@ class Raylet:
         object_store_memory: int | None = None,
         config: Config | None = None,
         session_dir: str = "/tmp/ray_tpu",
+        labels: Dict[str, str] | None = None,
     ):
         self.config = config or Config.from_env()
         self.node_id = NodeID.from_random()
@@ -80,7 +82,23 @@ class Raylet:
         self.clients = ClientPool()
         self.session_dir = session_dir
 
-        self.total = dict(resources or {"CPU": os.cpu_count() or 1})
+        # Slice membership: explicit labels win, else detect from the
+        # TPU-VM environment (reference tpu.py metadata polling).
+        self.labels = dict(labels) if labels is not None else \
+            (accelerators.slice_env() or {})
+        if resources is not None:
+            self.total = dict(resources)
+        else:
+            # no explicit resources: auto-detect like the reference's
+            # accelerator managers (tpu.py:104-120 chip detection)
+            self.total = {"CPU": float(os.cpu_count() or 1)}
+            chips = accelerators.num_local_chips()
+            if chips:
+                self.total["TPU"] = float(chips)
+        # host 0 of a slice carries the one-per-slice head resource
+        # (reference tpu.py:363-388, promoted into the scheduler here)
+        for k, v in accelerators.slice_resources(self.labels).items():
+            self.total.setdefault(k, v)
         self.available = dict(self.total)
         # TPU chips are individually assignable; a chip is bound to a
         # worker process from spawn until that worker dies (a JAX process
@@ -126,6 +144,7 @@ class Raylet:
             "total": self.total,
             "available": self.available,
             "hostname": os.uname().nodename,
+            "labels": self.labels,
         })
         await self.gcs.call("subscribe",
                             {"channel": "jobs", "addr": self.server.address})
@@ -175,10 +194,12 @@ class Raylet:
                         "raylet_addr": self.server.address,
                         "total": self.total,
                         "available": self.available,
+                        "labels": self.labels,
                     })
                 for n in reply.get("view", []):
                     self.view.update_node(n["node_id"], n["raylet_addr"],
-                                          n["total"], n["available"])
+                                          n["total"], n["available"],
+                                          labels=n.get("labels"))
                 current = {n["node_id"] for n in reply.get("view", [])}
                 for node_id in list(self.view.nodes):
                     if node_id not in current:
@@ -689,6 +710,7 @@ async def main(args):
         store_name=args.store_name or None,
         object_store_memory=args.object_store_memory or None,
         session_dir=args.session_dir,
+        labels=json.loads(args.labels) if args.labels else None,
     )
     await raylet.start()
     print(f"RAYLET_READY {raylet.address} {raylet.store_name} "
@@ -727,6 +749,8 @@ if __name__ == "__main__":
     parser.add_argument("--store-name", default=None)
     parser.add_argument("--object-store-memory", type=int, default=0)
     parser.add_argument("--session-dir", default="/tmp/ray_tpu")
+    parser.add_argument("--labels", default=None,
+                        help="JSON node labels (slice membership)")
     parser.add_argument("--log-file", default=None)
     args = parser.parse_args()
     if args.log_file:

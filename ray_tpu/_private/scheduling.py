@@ -48,16 +48,20 @@ class ClusterView:
         self.nodes: Dict[bytes, NodeResources] = {}
 
     def update_node(self, node_id: bytes, raylet_addr: str,
-                    total: Dict[str, float], available: Dict[str, float]):
+                    total: Dict[str, float], available: Dict[str, float],
+                    labels: Optional[Dict[str, str]] = None):
         node = self.nodes.get(node_id)
         if node is None:
             self.nodes[node_id] = NodeResources(
-                node_id, raylet_addr, dict(total), dict(available)
+                node_id, raylet_addr, dict(total), dict(available),
+                labels=dict(labels or {}),
             )
         else:
             node.total = dict(total)
             node.available = dict(available)
             node.raylet_addr = raylet_addr
+            if labels is not None:
+                node.labels = dict(labels)
 
     def remove_node(self, node_id: bytes):
         self.nodes.pop(node_id, None)
@@ -190,3 +194,59 @@ def place_bundles(
         return placement
 
     raise ValueError(f"unknown placement strategy: {strategy}")
+
+
+def place_slice_bundles(
+    view: ClusterView,
+    bundles: List[Dict[str, float]],
+    topology: str,
+) -> Optional[List[NodeResources]]:
+    """Atomically place one bundle per host of ONE TPU pod slice.
+
+    TPU-first extension of bundle_scheduling_policy: a slice is the set of
+    raylets sharing `ray_tpu.slice_name` with `ray_tpu.slice_type ==
+    topology`. A slice is eligible only when ALL of its hosts are alive
+    and registered (ICI is slice-internal — a partial slice cannot form
+    the mesh), the bundle count equals the host count, and every host fits
+    its bundle. Bundle i lands on slice host i, so `jax.distributed`
+    process_id == bundle_index matches ICI topology order. All-or-nothing:
+    returns None (caller keeps the PG pending) when no complete slice
+    fits.
+    """
+    from ray_tpu._private import accelerators as acc
+
+    slices: Dict[str, List[NodeResources]] = {}
+    for n in view.alive_nodes():
+        if n.labels.get(acc.LABEL_SLICE_TYPE) != topology:
+            continue
+        name = n.labels.get(acc.LABEL_SLICE_NAME)
+        if name is None:
+            continue  # malformed registration — never poison scheduling
+        slices.setdefault(name, []).append(n)
+
+    candidates = []
+    for name, hosts in slices.items():
+        try:
+            declared = int(
+                hosts[0].labels.get(acc.LABEL_SLICE_NUM_HOSTS, "1"))
+            by_host_id = sorted(
+                hosts,
+                key=lambda n: int(n.labels.get(acc.LABEL_SLICE_HOST_ID,
+                                               "-1")))
+            ids = [int(n.labels.get(acc.LABEL_SLICE_HOST_ID, "-1"))
+                   for n in by_host_id]
+        except ValueError:
+            continue  # non-integer label values — skip the slice
+        if len(hosts) != declared or len(bundles) != declared:
+            continue
+        if ids != list(range(declared)):
+            continue  # duplicate/missing host ids — not a coherent slice
+        if all(node.fits_now(demand)
+               for node, demand in zip(by_host_id, bundles)):
+            candidates.append(by_host_id)
+
+    if not candidates:
+        return None
+    # least-loaded slice first (keep busy slices free for their tenants)
+    return min(candidates,
+               key=lambda hosts: max(n.utilization() for n in hosts))

@@ -463,7 +463,16 @@ class PlacementGroup:
 
 
 def placement_group(bundles: List[Dict[str, float]], strategy: str = "PACK",
-                    name: str | None = None) -> PlacementGroup:
+                    name: str | None = None,
+                    topology: str | None = None) -> PlacementGroup:
+    """Create a placement group.
+
+    ``topology`` gang-places the bundles one-per-host onto a single
+    complete TPU pod slice of that type (e.g. "v4-16"), atomically —
+    bundle i lands on slice host i (see scheduling.place_slice_bundles;
+    reference convention: python/ray/_private/accelerators/tpu.py:363-388
+    promoted into the scheduler).
+    """
     cw = _require_state().core_worker
     pg_id = PlacementGroupID.from_random()
     cw._run_sync(cw.gcs.call("create_placement_group", {
@@ -472,6 +481,7 @@ def placement_group(bundles: List[Dict[str, float]], strategy: str = "PACK",
         "strategy": strategy,
         "name": name,
         "job_id": cw.job_id.binary(),
+        "topology": topology,
     }))
     return PlacementGroup(pg_id, bundles)
 

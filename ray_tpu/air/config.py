@@ -43,6 +43,12 @@ class ScalingConfig:
     mesh_shape: Optional[Tuple[int, ...]] = None
     placement_strategy: str = "PACK"
     trainer_resources: Optional[Dict[str, float]] = None
+    # TPU pod-slice topology (e.g. "v4-16"): gang-place one worker per
+    # host of a single complete slice, atomically — num_workers must
+    # equal the slice's host count. See scheduling.place_slice_bundles.
+    topology: Optional[str] = None
+    # how long fit() waits for the gang placement before failing
+    pg_timeout_s: float = 120.0
 
     def _worker_resources(self) -> Dict[str, float]:
         if self.resources_per_worker is not None:

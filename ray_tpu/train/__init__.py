@@ -20,6 +20,7 @@ from ray_tpu.train._internal.session import (
     get_dataset_shard,
     report,
 )
+from ray_tpu.train._internal.backend_executor import TrainingFailedError
 from ray_tpu.train.backend import Backend, BackendConfig, JaxConfig
 from ray_tpu.train.trainer import (
     BaseTrainer,
@@ -36,6 +37,7 @@ __all__ = [
     "DataParallelTrainer",
     "FailureConfig",
     "JaxConfig",
+    "TrainingFailedError",
     "JaxTrainer",
     "Result",
     "RunConfig",

@@ -48,14 +48,21 @@ class BackendExecutor:
 
     # -- lifecycle ---------------------------------------------------------
 
-    def start(self, timeout: float = 120.0) -> None:
+    def start(self, timeout: float | None = None) -> None:
+        if timeout is None:
+            timeout = self.scaling.pg_timeout_s
         bundles = self.scaling.bundles()
+        # topology="v4-16" gang-places one worker bundle per host of a
+        # single complete TPU slice, all-or-nothing (survey §7.1)
         self.pg = ray_tpu.placement_group(
-            bundles, strategy=self.scaling.placement_strategy)
+            bundles, strategy=self.scaling.placement_strategy,
+            topology=self.scaling.topology)
         if not self.pg.ready(timeout=timeout):
             raise TrainingFailedError(
-                f"placement group with bundles {bundles} not placeable "
-                f"within {timeout}s (cluster resources: "
+                f"placement group with bundles {bundles} "
+                + (f"on slice topology {self.scaling.topology!r} "
+                   if self.scaling.topology else "")
+                + f"not placeable within {timeout}s (cluster resources: "
                 f"{ray_tpu.cluster_resources()})")
         self.worker_group = WorkerGroup(
             self.scaling.num_workers,

@@ -1,0 +1,175 @@
+"""TPU pod-slice gang scheduling tests.
+
+Models the reference's TPU pod convention
+(`python/ray/_private/accelerators/tpu.py:363-388`: per-slice head
+resource + one worker per host) promoted into the scheduler as an atomic
+slice placement primitive (SURVEY.md §7.1).
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private import accelerators as acc
+from ray_tpu._private.node import Cluster
+from ray_tpu._private.scheduling import ClusterView, place_slice_bundles
+from ray_tpu.air import RunConfig, ScalingConfig
+
+
+# ---------------------------------------------------------------------------
+# unit: place_slice_bundles over a fake view
+# ---------------------------------------------------------------------------
+
+def _add_host(view, nid, name, stype, host_id, num_hosts, chips=4.0,
+              available=None):
+    total = {"CPU": 4.0, "TPU": chips}
+    view.update_node(
+        nid, f"addr-{nid.hex()}", total, dict(available or total),
+        labels={
+            acc.LABEL_SLICE_NAME: name,
+            acc.LABEL_SLICE_TYPE: stype,
+            acc.LABEL_SLICE_HOST_ID: str(host_id),
+            acc.LABEL_SLICE_NUM_HOSTS: str(num_hosts),
+        })
+
+
+def test_place_slice_bundles_complete_slice():
+    view = ClusterView()
+    _add_host(view, b"a0", "sliceA", "v4-16", 0, 2)
+    _add_host(view, b"a1", "sliceA", "v4-16", 1, 2)
+    bundles = [{"CPU": 1.0, "TPU": 4.0}] * 2
+    placed = place_slice_bundles(view, bundles, "v4-16")
+    assert placed is not None
+    # bundle i -> slice host i, in ICI order
+    assert [int(n.labels[acc.LABEL_SLICE_HOST_ID]) for n in placed] == [0, 1]
+    assert {n.labels[acc.LABEL_SLICE_NAME] for n in placed} == {"sliceA"}
+
+
+def test_place_slice_bundles_incomplete_slice_stays_pending():
+    view = ClusterView()
+    # only host 0 of a declared 2-host slice has registered
+    _add_host(view, b"a0", "sliceA", "v4-16", 0, 2)
+    assert place_slice_bundles(
+        view, [{"TPU": 4.0}] * 2, "v4-16") is None
+
+
+def test_place_slice_bundles_no_partial_across_slices():
+    view = ClusterView()
+    # two DIFFERENT 2-host slices each with only one live host: a naive
+    # scheduler would place across them; slices must not be mixed
+    _add_host(view, b"a0", "sliceA", "v4-16", 0, 2)
+    _add_host(view, b"b1", "sliceB", "v4-16", 1, 2)
+    assert place_slice_bundles(
+        view, [{"TPU": 4.0}] * 2, "v4-16") is None
+
+
+def test_place_slice_bundles_bundle_count_must_match_hosts():
+    view = ClusterView()
+    _add_host(view, b"a0", "sliceA", "v4-16", 0, 2)
+    _add_host(view, b"a1", "sliceA", "v4-16", 1, 2)
+    assert place_slice_bundles(view, [{"TPU": 4.0}], "v4-16") is None
+    assert place_slice_bundles(view, [{"TPU": 4.0}] * 3, "v4-16") is None
+
+
+def test_place_slice_bundles_prefers_idle_slice():
+    view = ClusterView()
+    _add_host(view, b"a0", "sliceA", "v4-8", 0, 1,
+              available={"CPU": 1.0, "TPU": 4.0})  # busy
+    _add_host(view, b"b0", "sliceB", "v4-8", 0, 1)  # idle
+    placed = place_slice_bundles(view, [{"TPU": 2.0}], "v4-8")
+    assert placed[0].labels[acc.LABEL_SLICE_NAME] == "sliceB"
+
+
+def test_wrong_topology_not_placed():
+    view = ClusterView()
+    _add_host(view, b"a0", "sliceA", "v4-16", 0, 1)
+    assert place_slice_bundles(view, [{"TPU": 4.0}], "v4-32") is None
+
+
+# ---------------------------------------------------------------------------
+# integration: real cluster of raylet processes forming slices
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def slice_cluster():
+    cluster = Cluster()
+    # one 2-host v2-8 slice + one plain CPU node
+    cluster.add_slice("v2-8", num_hosts=2, chips_per_host=4)
+    cluster.add_node({"CPU": 2.0})
+    ray_tpu.init(address=cluster.gcs_addr)
+    yield cluster
+    ray_tpu.shutdown()
+    cluster.shutdown()
+
+
+def test_slice_head_resource_advertised(slice_cluster):
+    total = ray_tpu.cluster_resources()
+    # host 0 of the slice carries the one-per-slice head resource
+    assert total.get(acc.head_resource_name("v2-8")) == 1.0
+    assert total.get("TPU") == 8.0
+
+
+def test_slice_pg_gang_places_then_second_stays_pending(slice_cluster):
+    bundles = [{"CPU": 1.0, "TPU": 4.0}] * 2
+    pg1 = ray_tpu.placement_group(bundles, topology="v2-8")
+    assert pg1.ready(timeout=30.0)
+
+    # the slice is fully claimed: an identical request must stay PENDING
+    # (all-or-nothing — never partially placed)
+    pg2 = ray_tpu.placement_group(bundles, topology="v2-8")
+    assert not pg2.ready(timeout=3.0)
+
+    # freeing the slice lets the pending PG gang-place
+    ray_tpu.remove_placement_group(pg1)
+    assert pg2.ready(timeout=30.0)
+    ray_tpu.remove_placement_group(pg2)
+
+
+def test_train_on_slice_topology(slice_cluster, tmp_path):
+    """ScalingConfig(topology=...) gang-places one train worker per slice
+    host; each worker sees its host's chips via TPU_VISIBLE_CHIPS."""
+    import os as _os
+
+    from ray_tpu import train
+
+    def loop(config):
+        import os
+
+        ctx = train.get_context()
+        train.report({
+            "rank": ctx.get_world_rank(),
+            "world": ctx.get_world_size(),
+            "chips": os.environ.get("TPU_VISIBLE_CHIPS", ""),
+        })
+
+    trainer = train.DataParallelTrainer(
+        loop,
+        scaling_config=ScalingConfig(
+            num_workers=2, topology="v2-8",
+            resources_per_worker={"CPU": 1.0, "TPU": 4.0}),
+        run_config=RunConfig(storage_path=str(tmp_path), name="slice"),
+    )
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["world"] == 2
+    # the worker got dedicated host-local chips
+    assert len(result.metrics["chips"].split(",")) == 4
+
+
+def test_train_slice_unplaceable_fails_cleanly(slice_cluster, tmp_path):
+    """With no complete slice of the requested type anywhere in the
+    cluster, fit() raises instead of partially placing workers."""
+    from ray_tpu import train
+    from ray_tpu.train import TrainingFailedError
+
+    trainer = train.DataParallelTrainer(
+        lambda config: None,
+        scaling_config=ScalingConfig(
+            num_workers=2, topology="v4-4096",  # no such slice exists
+            resources_per_worker={"CPU": 1.0, "TPU": 4.0},
+            pg_timeout_s=5.0),
+        run_config=RunConfig(storage_path=str(tmp_path), name="nofit"),
+    )
+    with pytest.raises(TrainingFailedError):
+        trainer.fit()
