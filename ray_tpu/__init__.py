@@ -10,6 +10,7 @@ store + asyncio control plane for the runtime.
 from ray_tpu._private.core_worker import (
     ActorDiedError,
     GetTimeoutError,
+    ObjectRefGenerator,
     RayTaskError,
 )
 from ray_tpu._private.object_ref import ObjectRef
@@ -45,6 +46,7 @@ __all__ = [
     "GetTimeoutError",
     "NodeAffinitySchedulingStrategy",
     "ObjectRef",
+    "ObjectRefGenerator",
     "PlacementGroup",
     "PlacementGroupSchedulingStrategy",
     "RayTaskError",

@@ -23,6 +23,10 @@ class Config:
     # live in the owner's in-process memory store (reference:
     # `max_direct_call_object_size`, ray_config_def.h:206 — 100KB default).
     max_direct_call_object_size: int = 100 * 1024
+    # Streaming generators: how many reported-but-unconsumed items the
+    # owner buffers before it withholds the executor's ack (reference:
+    # generator_waiter.h backpressure threshold).
+    streaming_backpressure_items: int = 16
     # Default per-node shared-memory store capacity.
     object_store_memory: int = 2 * 1024**3
     # Object-table slots in the shm store header.

@@ -114,6 +114,45 @@ class _MemoryStore:
         await asyncio.wait_for(self._event(oid).wait(), timeout)
 
 
+class ObjectRefGenerator:
+    """Iterator over a streaming task's item refs (reference:
+    `_raylet.pyx:273` ObjectRefGenerator). Yields ObjectRefs as the
+    executor produces them; `ray_tpu.get` each ref for its value.
+    `close()` cancels the producer at its next report."""
+
+    def __init__(self, core_worker: "CoreWorker", task_id: bytes):
+        self._cw = core_worker
+        self._task_id = task_id
+        self._closed = False
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> ObjectRef:
+        return self._cw.stream_next(self._task_id)
+
+    def next_with_timeout(self, timeout: float) -> ObjectRef:
+        return self._cw.stream_next(self._task_id, timeout)
+
+    async def _anext_async(self) -> ObjectRef:
+        """Owner-loop async variant (internal plumbing for Serve/Data)."""
+        out = await self._cw._stream_next_async(self._task_id)
+        if out is type(self._cw)._STREAM_DONE:
+            raise StopAsyncIteration
+        return out
+
+    def close(self):
+        if not self._closed:
+            self._closed = True
+            self._cw.stream_cancel(self._task_id)
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass
+
+
 class _KeyState:
     """Per-scheduling-key submit queue + lease pipeline state."""
 
@@ -162,6 +201,9 @@ class CoreWorker:
         self._actor_clients: Dict[bytes, dict] = {}  # actor state cache
         self._actor_events: Dict[bytes, asyncio.Event] = {}
         self._local_refs: Dict[bytes, int] = {}
+        # Owner-side streaming-generator state, keyed by the producing
+        # task id (reference: StreamingGeneratorState in task_manager.h).
+        self._streams: Dict[bytes, dict] = {}
 
         # Executor state (worker mode).
         self._exec_queue: queue_mod.Queue = queue_mod.Queue()
@@ -535,7 +577,8 @@ class CoreWorker:
         soft: bool = False,
         placement_group_id: bytes | None = None,
         bundle_index: int = -1,
-    ) -> List[ObjectRef]:
+        streaming: bool = False,
+    ):
         task_id = TaskID.of(self.job_id, self.current_task_id,
                             next(self._task_counter))
         wire_args, wire_kwargs = self._serialize_args(args, kwargs)
@@ -547,7 +590,7 @@ class CoreWorker:
             function_key=function_key,
             args=wire_args,
             kwargs=wire_kwargs,
-            num_returns=num_returns,
+            num_returns=0 if streaming else num_returns,
             resources=resources or {"CPU": 1.0},
             owner_addr=self.address,
             owner_worker_id=self.worker_id.binary(),
@@ -556,9 +599,19 @@ class CoreWorker:
             soft=soft,
             placement_group_id=placement_group_id,
             bundle_index=bundle_index,
-            max_retries=(self.config.task_max_retries_default
-                         if max_retries is None else max_retries),
+            # a partially-consumed stream cannot be transparently
+            # re-executed — streaming tasks are never retried
+            max_retries=0 if streaming else (
+                self.config.task_max_retries_default
+                if max_retries is None else max_retries),
+            streaming=streaming,
         )
+        if streaming:
+            # plain dict insert; ordered before the task via the same
+            # call_soon_threadsafe queue the enqueue rides on
+            self._make_stream(spec.task_id)
+            self._loop.call_soon_threadsafe(self._enqueue_task, spec)
+            return ObjectRefGenerator(self, spec.task_id)
         refs = [
             ObjectRef(ObjectID.for_task_return(task_id, i), self.address)
             for i in range(num_returns)
@@ -567,6 +620,133 @@ class CoreWorker:
             self.memory_store.register_thread_waiter(r.binary())
         self._loop.call_soon_threadsafe(self._enqueue_task, spec)
         return refs
+
+    # ------------------------------------------------------------------
+    # streaming generators (num_returns="streaming")
+    #
+    # Reference: `_raylet.pyx:273` ObjectRefGenerator +
+    # `ReportGeneratorItemReturns` (core_worker.proto:462) + the
+    # generator_waiter.h backpressure. The executor reports each yielded
+    # item to the owner as it is produced; the owner buffers item refs,
+    # withholding the ack once `streaming_backpressure_items` are
+    # unconsumed so a slow consumer throttles the producer. Early close()
+    # tells the executor to stop at the next report.
+    # ------------------------------------------------------------------
+
+    def _make_stream(self, task_id: bytes) -> dict:
+        st = self._streams[task_id] = {
+            "items": deque(),      # ObjectRefs ready to hand out
+            "done": False,         # no more items will arrive
+            "error": None,         # stream-level failure (Exception)
+            "cancelled": False,
+            "new_item": asyncio.Event(),   # owner-loop waiters
+            "drained": asyncio.Event(),    # backpressure release
+        }
+        return st
+
+    #: coroutine-safe exhaustion marker — StopIteration cannot cross a
+    #: coroutine boundary (PEP 479 turns it into RuntimeError)
+    _STREAM_DONE = object()
+
+    def stream_next(self, task_id: bytes,
+                    timeout: float | None = None) -> ObjectRef:
+        """Block (caller thread) for the next item ref of a stream.
+        Raises StopIteration when the stream completed, or the stream
+        error."""
+        out = self._run_sync(self._stream_next_async(task_id, timeout),
+                             timeout=None)
+        if out is CoreWorker._STREAM_DONE:
+            raise StopIteration
+        return out
+
+    async def _stream_next_async(self, task_id: bytes,
+                                 timeout: float | None = None):
+        """Returns the next ObjectRef, or _STREAM_DONE on exhaustion."""
+        st = self._streams.get(task_id)
+        if st is None:
+            return CoreWorker._STREAM_DONE
+        deadline = None if timeout is None else self._loop.time() + timeout
+        while True:
+            if st["items"]:
+                ref = st["items"].popleft()
+                if len(st["items"]) < \
+                        self.config.streaming_backpressure_items:
+                    st["drained"].set()
+                return ref
+            if st["error"] is not None:
+                self._streams.pop(task_id, None)
+                raise st["error"]
+            if st["done"] or st["cancelled"]:
+                self._streams.pop(task_id, None)
+                return CoreWorker._STREAM_DONE
+            st["new_item"].clear()
+            wait_for = None
+            if deadline is not None:
+                wait_for = max(0.0, deadline - self._loop.time())
+                if wait_for == 0.0:
+                    raise GetTimeoutError(
+                        f"stream item not ready within {timeout}s")
+            try:
+                await asyncio.wait_for(st["new_item"].wait(), wait_for)
+            except asyncio.TimeoutError:
+                raise GetTimeoutError(
+                    f"stream item not ready within {timeout}s") from None
+
+    def stream_cancel(self, task_id: bytes):
+        """Stop the producer at its next report (early generator close).
+        Also the terminal cleanup: close() means no further next() calls,
+        so the stream dict and any unconsumed buffered item values are
+        reclaimed here — a long-lived proxy must not accumulate state per
+        aborted stream."""
+        def _cancel():
+            st = self._streams.pop(task_id, None)
+            if st is not None:
+                st["cancelled"] = True
+                st["drained"].set()
+                st["new_item"].set()
+                mem = self.memory_store
+                for ref in st["items"]:
+                    oid = ref.binary()
+                    mem.values.pop(oid, None)
+                    mem.errors.pop(oid, None)
+                    mem._events.pop(oid, None)
+        self._loop.call_soon_threadsafe(_cancel)
+
+    async def rpc_report_stream_item(self, req):
+        """Owner-side: the executor reports one yielded item (reference:
+        HandleReportGeneratorItemReturns). The reply doubles as the
+        backpressure ack — withheld while the buffer is full — and
+        carries the cancellation flag back to the producer."""
+        task_id = req["task_id"]
+        st = self._streams.get(task_id)
+        if st is None or st["cancelled"]:
+            return {"ok": True, "cancelled": True}
+        oid, kind, payload = req["item"]
+        mem = self.memory_store
+        if kind == "v":
+            mem.put_value(oid, payload)
+        elif kind == "err":
+            mem.put_error(oid, payload)
+        else:  # plasma
+            mem.add_location(oid, payload)
+        st["items"].append(ObjectRef(ObjectID(oid), self.address))
+        st["new_item"].set()
+        while (len(st["items"]) >=
+               self.config.streaming_backpressure_items
+               and not st["cancelled"]):
+            st["drained"].clear()
+            await st["drained"].wait()
+        return {"ok": True, "cancelled": st["cancelled"]}
+
+    def _finish_stream(self, task_id: bytes,
+                       error: Exception | None = None):
+        st = self._streams.get(task_id)
+        if st is None:
+            return
+        if error is not None and st["error"] is None:
+            st["error"] = error
+        st["done"] = True
+        st["new_item"].set()
 
     def _enqueue_task(self, spec: task_mod.TaskSpec):
         key = spec.scheduling_key()
@@ -702,8 +882,21 @@ class CoreWorker:
                 mem.put_error(oid, payload)
             elif kind == "plasma":
                 mem.add_location(oid, payload)
+        if spec.streaming:
+            # the final reply closes the stream; pre-execution failures
+            # arrive as an error entry instead of item reports
+            err = None
+            for entry in reply.get("returns", []):
+                if entry[1] == "err":
+                    err = self._error_from_frame(entry[2])
+                    break
+            self._finish_stream(spec.task_id, err)
 
     def _store_task_error(self, spec: task_mod.TaskSpec, err: Exception):
+        if spec.streaming:
+            self._loop.call_soon_threadsafe(
+                self._finish_stream, spec.task_id, err)
+            return
         frame = serialization.dumps(err)
         for i in range(spec.num_returns):
             oid = ObjectID.for_task_return(TaskID(spec.task_id), i)
@@ -772,7 +965,8 @@ class CoreWorker:
         args: tuple,
         kwargs: dict,
         num_returns: int = 1,
-    ) -> List[ObjectRef]:
+        streaming: bool = False,
+    ):
         task_id = TaskID.of(self.job_id, self.current_task_id,
                             next(self._task_counter), actor_id)
         wire_args, wire_kwargs = self._serialize_args(args, kwargs)
@@ -783,12 +977,17 @@ class CoreWorker:
             task_type=task_mod.ACTOR_TASK,
             args=wire_args,
             kwargs=wire_kwargs,
-            num_returns=num_returns,
+            num_returns=0 if streaming else num_returns,
             owner_addr=self.address,
             owner_worker_id=self.worker_id.binary(),
             actor_id=actor_id.binary(),
             method_name=method_name,
+            streaming=streaming,
         )
+        if streaming:
+            self._make_stream(spec.task_id)
+            self._loop.call_soon_threadsafe(self._actor_enqueue, spec)
+            return ObjectRefGenerator(self, spec.task_id)
         refs = [
             ObjectRef(ObjectID.for_task_return(task_id, i), self.address)
             for i in range(num_returns)
@@ -1078,6 +1277,14 @@ class CoreWorker:
             result = method(*args, **kwargs)
             if asyncio.iscoroutine(result):
                 result = await result
+            if spec.streaming and hasattr(result, "__anext__"):
+                return await self._execute_streaming_async(spec, result)
+            if spec.streaming and hasattr(result, "__next__"):
+                # sync generator on an async actor: drive it off-loop —
+                # the per-item ack waits (backpressure) must not freeze
+                # the actor's other coroutines
+                return await asyncio.get_running_loop().run_in_executor(
+                    None, self._execute_streaming, spec, result)
             return self._package_returns(spec, result)
         except Exception as e:  # noqa: BLE001
             return self._package_error(spec, e)
@@ -1102,11 +1309,7 @@ class CoreWorker:
                 self._actor_instance = instance
                 self.current_actor_id = ActorID(spec.actor_id)
                 if spec.max_concurrency > 1:
-                    if any(
-                        asyncio.iscoroutinefunction(getattr(cls, n))
-                        for n in dir(cls)
-                        if callable(getattr(cls, n, None)) and not n.startswith("__")
-                    ):
+                    if self._has_async_methods(cls):
                         self._start_actor_async_loop(spec.max_concurrency)
                     else:
                         self._actor_threadpool = ThreadPoolExecutor(
@@ -1132,8 +1335,14 @@ class CoreWorker:
 
     @staticmethod
     def _has_async_methods(cls) -> bool:
+        import inspect as inspect_mod
+
+        def is_async(fn):
+            return (asyncio.iscoroutinefunction(fn)
+                    or inspect_mod.isasyncgenfunction(fn))
+
         return any(
-            asyncio.iscoroutinefunction(getattr(cls, n, None))
+            is_async(getattr(cls, n, None))
             for n in dir(cls)
             if not n.startswith("__")
         )
@@ -1149,7 +1358,83 @@ class CoreWorker:
 
         threading.Thread(target=run, name="actor-async", daemon=True).start()
 
+    # -- executor-side streaming ------------------------------------------
+
+    def _package_item(self, spec: task_mod.TaskSpec, index: int,
+                      value) -> list:
+        """Package one yielded item exactly like a return value: small
+        in-band, large into plasma."""
+        oid = ObjectID.for_task_return(TaskID(spec.task_id), index)
+        pickled, buffers = serialization.serialize(value)
+        size = serialization.serialized_size(pickled, buffers)
+        if size <= self.config.max_direct_call_object_size or \
+                self.store is None:
+            return [oid.binary(), "v", serialization.pack(pickled, buffers)]
+        self.store.put_serialized(oid, pickled, buffers)
+        return [oid.binary(), "plasma", self.raylet_addr]
+
+    async def _report_item(self, spec: task_mod.TaskSpec, item: list) -> dict:
+        owner = await self._clients.get(spec.owner_addr)
+        return await owner.call("report_stream_item", {
+            "task_id": spec.task_id, "item": item,
+        }, timeout=None)
+
+    def _execute_streaming(self, spec: task_mod.TaskSpec, gen) -> dict:
+        """Drive a sync generator, reporting each item to the owner. The
+        per-item ack is the backpressure gate (the owner withholds it
+        while its buffer is full) and carries early-cancellation."""
+        index = 0
+        try:
+            for value in gen:
+                item = self._package_item(spec, index, value)
+                index += 1
+                ack = asyncio.run_coroutine_threadsafe(
+                    self._report_item(spec, item), self._loop).result()
+                if ack.get("cancelled"):
+                    gen.close()
+                    break
+        except Exception:  # noqa: BLE001 — shipped to the consumer
+            tb = traceback.format_exc()
+            frame = serialization.dumps(RayTaskError(
+                f"streaming task {spec.name} failed at item {index}:\n{tb}"))
+            oid = ObjectID.for_task_return(TaskID(spec.task_id), index)
+            asyncio.run_coroutine_threadsafe(
+                self._report_item(spec, [oid.binary(), "err", frame]),
+                self._loop).result()
+        return {"returns": [], "stream_items": index}
+
+    async def _execute_streaming_async(self, spec: task_mod.TaskSpec,
+                                       agen) -> dict:
+        """Async-actor variant: drives an async generator (Serve response
+        streaming rides on this path)."""
+        index = 0
+        try:
+            async for value in agen:
+                item = self._package_item(spec, index, value)
+                index += 1
+                ack = await asyncio.wrap_future(
+                    asyncio.run_coroutine_threadsafe(
+                        self._report_item(spec, item), self._loop))
+                if ack.get("cancelled"):
+                    await agen.aclose()
+                    break
+        except Exception:  # noqa: BLE001
+            tb = traceback.format_exc()
+            frame = serialization.dumps(RayTaskError(
+                f"streaming task {spec.name} failed at item {index}:\n{tb}"))
+            oid = ObjectID.for_task_return(TaskID(spec.task_id), index)
+            await asyncio.wrap_future(asyncio.run_coroutine_threadsafe(
+                self._report_item(spec, [oid.binary(), "err", frame]),
+                self._loop))
+        return {"returns": [], "stream_items": index}
+
     def _package_returns(self, spec: task_mod.TaskSpec, result) -> dict:
+        if spec.streaming:
+            if not hasattr(result, "__next__"):
+                raise TypeError(
+                    f"streaming task {spec.name} must return a generator, "
+                    f"got {type(result).__name__}")
+            return self._execute_streaming(spec, result)
         if spec.num_returns == 0:
             return {"returns": []}
         if spec.num_returns == 1:

@@ -63,6 +63,10 @@ class TaskSpec:
     # Detached actors outlive their creator job.
     detached: bool = False
     actor_name: Optional[str] = None
+    # Streaming generator task (num_returns="streaming"): the executor
+    # reports each yielded item to the owner as it is produced
+    # (reference: ReportGeneratorItemReturns, core_worker.proto:462).
+    streaming: bool = False
 
     def to_wire(self) -> dict:
         return {
@@ -92,6 +96,7 @@ class TaskSpec:
             "runtime_env": self.runtime_env,
             "detached": self.detached,
             "actor_name": self.actor_name,
+            "streaming": self.streaming,
         }
 
     @classmethod

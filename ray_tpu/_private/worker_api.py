@@ -283,10 +283,11 @@ class RemoteFunction:
         key = self._ensure_pushed(cw)
         opts = self._options
         strategy, node_id, soft, pg_id, bundle_index = _strategy_fields(opts)
+        streaming = opts["num_returns"] == "streaming"
         refs = cw.submit_task(
             key, args, kwargs,
             name=self._fn.__name__,
-            num_returns=opts["num_returns"],
+            num_returns=1 if streaming else opts["num_returns"],
             resources=_resource_dict(opts, default_cpu=1.0),
             max_retries=opts["max_retries"],
             strategy=strategy,
@@ -294,7 +295,10 @@ class RemoteFunction:
             soft=soft,
             placement_group_id=pg_id,
             bundle_index=bundle_index,
+            streaming=streaming,
         )
+        if streaming:
+            return refs  # an ObjectRefGenerator
         if opts["num_returns"] == 1:
             return refs[0]
         return refs
@@ -312,20 +316,24 @@ class RemoteFunction:
 
 
 class ActorMethod:
-    def __init__(self, handle: "ActorHandle", name: str, num_returns: int = 1):
+    def __init__(self, handle: "ActorHandle", name: str, num_returns=1):
         self._handle = handle
         self._name = name
         self._num_returns = num_returns
 
-    def options(self, num_returns: int = 1) -> "ActorMethod":
+    def options(self, num_returns=1) -> "ActorMethod":
         return ActorMethod(self._handle, self._name, num_returns)
 
     def remote(self, *args, **kwargs):
         cw = _require_state().core_worker
+        streaming = self._num_returns == "streaming"
         refs = cw.submit_actor_task(
             self._handle._actor_id, self._name, args, kwargs,
-            num_returns=self._num_returns,
+            num_returns=1 if streaming else self._num_returns,
+            streaming=streaming,
         )
+        if streaming:
+            return refs  # an ObjectRefGenerator
         if self._num_returns == 1:
             return refs[0]
         return refs

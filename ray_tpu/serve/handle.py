@@ -83,6 +83,51 @@ class DeploymentResponse:
             pass
 
 
+class DeploymentResponseGenerator:
+    """Streaming response: iterate to receive each yielded chunk as the
+    replica produces it (reference `handle.py` DeploymentResponseGenerator
+    riding the streaming-generator protocol)."""
+
+    def __init__(self, gen, router: Optional["Router"] = None,
+                 replica_idx: int = -1):
+        self._gen = gen  # ObjectRefGenerator of chunk refs
+        self._router = router
+        self._replica_idx = replica_idx
+        self._done = False
+
+    def _mark_done(self):
+        if not self._done and self._router is not None:
+            self._done = True
+            self._router.done(self._replica_idx)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> Any:
+        try:
+            ref = next(self._gen)
+        except StopIteration:
+            self._mark_done()
+            raise
+        try:
+            return ray_tpu.get(ref)
+        except Exception:
+            self._mark_done()
+            raise
+
+    def close(self):
+        """Cancel the stream: the replica's generator stops at its next
+        yield."""
+        self._gen.close()
+        self._mark_done()
+
+    def __del__(self):
+        try:
+            self._mark_done()
+        except Exception:
+            pass
+
+
 class Router:
     """Pow-2 replica chooser with a locally-tracked in-flight view."""
 
@@ -138,14 +183,19 @@ class Router:
 
 class DeploymentHandle:
     def __init__(self, controller, deployment_name: str,
-                 method: str = "__call__"):
+                 method: str = "__call__", stream: bool = False):
         self._controller = controller
         self._name = deployment_name
         self._method = method
+        self._stream = stream
         self._router = Router(controller, deployment_name)
 
-    def options(self, method_name: str) -> "DeploymentHandle":
-        h = DeploymentHandle(self._controller, self._name, method_name)
+    def options(self, method_name: Optional[str] = None,
+                stream: Optional[bool] = None) -> "DeploymentHandle":
+        h = DeploymentHandle(
+            self._controller, self._name,
+            method_name if method_name is not None else self._method,
+            stream if stream is not None else self._stream)
         h._router = self._router  # share the local view
         return h
 
@@ -154,7 +204,7 @@ class DeploymentHandle:
             raise AttributeError(name)
         return self.options(name)
 
-    def remote(self, *args, **kwargs) -> DeploymentResponse:
+    def remote(self, *args, **kwargs):
         # unwrap composed responses so refs resolve in the replica
         args = tuple(a.ref if isinstance(a, DeploymentResponse) else a
                      for a in args)
@@ -162,13 +212,27 @@ class DeploymentHandle:
                   for k, v in kwargs.items()}
         return self._submit(args, kwargs)
 
-    def _submit(self, args, kwargs) -> DeploymentResponse:
+    def _submit(self, args, kwargs):
         idx, replica = self._router.choose()
+        if self._stream:
+            gen = replica.handle_request_streaming.options(
+                num_returns="streaming").remote(self._method, args, kwargs)
+            return DeploymentResponseGenerator(gen, self._router, idx)
         ref = replica.handle_request.remote(self._method, args, kwargs)
         return DeploymentResponse(
             ref, self._router, idx,
             resubmit=lambda: self._submit(args, kwargs))
 
+    def _is_streaming_method(self) -> bool:
+        """Ask a live replica whether the target method is a generator
+        (proxy-side auto-detection for HTTP streaming)."""
+        idx, replica = self._router.choose()
+        try:
+            return bool(ray_tpu.get(
+                replica.is_streaming.remote(self._method), timeout=30))
+        finally:
+            self._router.done(idx)
+
     def __reduce__(self):
         return (DeploymentHandle,
-                (self._controller, self._name, self._method))
+                (self._controller, self._name, self._method, self._stream))

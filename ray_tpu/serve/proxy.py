@@ -24,6 +24,8 @@ class HTTPProxy:
         self._host = host
         self._port = port
         self._handles: Dict[str, DeploymentHandle] = {}
+        # route -> (replica-set version, is_streaming)
+        self._streaming_routes: Dict[str, tuple] = {}
         self._started = threading.Event()
         self._thread = threading.Thread(target=self._serve, daemon=True,
                                         name="http_proxy")
@@ -47,6 +49,8 @@ class HTTPProxy:
     def _serve(self):
         from aiohttp import web
 
+        _STREAM = object()  # marker: second element is a chunk generator
+
         def dispatch_blocking(path: str, body):
             """Route + dispatch + await — everything that can block on
             controller/replica RPCs runs in the executor, never on the
@@ -58,6 +62,20 @@ class HTTPProxy:
                 self._handles[name] = DeploymentHandle(
                     self._controller, name)
             handle = self._handles[name]
+            # generator deployments stream chunks (reference: proxy
+            # response streaming over the generator protocol). Cached per
+            # replica-set version: a redeploy may swap a generator
+            # implementation for a plain one (or vice versa).
+            handle._router._refresh()
+            version = handle._router._version
+            cached = self._streaming_routes.get(name)
+            if cached is None or cached[0] != version:
+                cached = (version, handle._is_streaming_method())
+                self._streaming_routes[name] = cached
+            if cached[1]:
+                h = handle.options(stream=True)
+                gen = h.remote(body) if body is not None else h.remote()
+                return _STREAM, gen
             resp = handle.remote(body) if body is not None \
                 else handle.remote()
             return 200, resp.result(timeout=60)
@@ -76,6 +94,28 @@ class HTTPProxy:
                     None, dispatch_blocking, request.path, body)
             except Exception as e:  # noqa: BLE001 — surfaced as HTTP 500
                 return web.json_response({"error": str(e)}, status=500)
+            if status is _STREAM:
+                # JSON-lines chunked response; each chunk flushes as the
+                # replica yields it
+                resp = web.StreamResponse(
+                    headers={"Content-Type": "application/jsonl"})
+                await resp.prepare(request)
+                gen = result
+                try:
+                    while True:
+                        chunk = await loop.run_in_executor(
+                            None, next, gen, _STREAM)
+                        if chunk is _STREAM:
+                            break
+                        await resp.write(
+                            (json.dumps(chunk) + "\n").encode())
+                except Exception as e:  # noqa: BLE001
+                    await resp.write(
+                        (json.dumps({"error": str(e)}) + "\n").encode())
+                finally:
+                    gen.close()
+                await resp.write_eof()
+                return resp
             try:
                 return web.json_response(result, status=status)
             except TypeError:

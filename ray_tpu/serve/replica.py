@@ -38,6 +38,39 @@ class Replica:
             with self._lock:
                 self._ongoing -= 1
 
+    def handle_request_streaming(self, method: str, args: tuple,
+                                 kwargs: dict):
+        """Generator variant: called with num_returns="streaming" so each
+        yielded chunk ships to the caller as it is produced (reference:
+        replica.py handle_request_streaming over the generator task
+        protocol). Ongoing-count spans the whole stream — an in-progress
+        stream holds autoscaling/routing weight like any request."""
+        with self._lock:
+            self._ongoing += 1
+            self._total += 1
+        try:
+            if self._is_function:
+                result = self._callable(*args, **kwargs)
+            else:
+                result = getattr(self._callable, method)(*args, **kwargs)
+            if hasattr(result, "__next__"):
+                yield from result
+            else:
+                yield result
+        finally:
+            with self._lock:
+                self._ongoing -= 1
+
+    def is_streaming(self, method: str) -> bool:
+        """Whether the deployment's method is a (sync) generator function
+        — the proxy uses this to pick a streaming HTTP response."""
+        import inspect
+
+        target = self._callable if self._is_function else \
+            getattr(self._callable, method, None)
+        return target is not None and (
+            inspect.isgeneratorfunction(target))
+
     def get_metrics(self) -> Dict[str, float]:
         with self._lock:
             return {"ongoing": float(self._ongoing),

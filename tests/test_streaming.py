@@ -1,0 +1,182 @@
+"""Streaming generator tests (num_returns="streaming").
+
+Reference surface: `python/ray/_raylet.pyx:273` ObjectRefGenerator,
+`ReportGeneratorItemReturns` (core_worker.proto:462), generator_waiter
+backpressure, and `python/ray/tests/test_streaming_generator.py`.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module", autouse=True)
+def cluster():
+    ray_tpu.init(num_cpus=4, object_store_memory=128 * 1024 * 1024)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_task_stream_basic():
+    @ray_tpu.remote(num_returns="streaming")
+    def gen(n):
+        for i in range(n):
+            yield i * 10
+
+    out = [ray_tpu.get(ref) for ref in gen.remote(5)]
+    assert out == [0, 10, 20, 30, 40]
+
+
+def test_stream_consume_while_producing():
+    """Items are visible to the consumer before the producer finishes."""
+    @ray_tpu.remote(num_returns="streaming")
+    def slow_gen():
+        for i in range(4):
+            yield (i, time.time())
+            time.sleep(0.3)
+
+    g = slow_gen.remote()
+    first_ref = next(g)
+    i, produced_at = ray_tpu.get(first_ref)
+    consumed_at = time.time()
+    assert i == 0
+    # consumed well before the ~0.9s the remaining items take to produce
+    assert consumed_at - produced_at < 0.9
+    rest = [ray_tpu.get(r)[0] for r in g]
+    assert rest == [1, 2, 3]
+
+
+def test_stream_early_close_cancels_producer():
+    @ray_tpu.remote
+    class Recorder:
+        def __init__(self):
+            self.count = 0
+
+        def bump(self):
+            self.count += 1
+
+        def get(self):
+            return self.count
+
+    rec = Recorder.remote()
+
+    @ray_tpu.remote(num_returns="streaming")
+    def gen(rec):
+        i = 0
+        while True:
+            ray_tpu.get(rec.bump.remote())
+            yield i
+            i += 1
+
+    g = gen.remote(rec)
+    next(g)
+    next(g)
+    g.close()
+    time.sleep(1.0)
+    produced = ray_tpu.get(rec.get.remote())
+    # backpressure caps the run-ahead; cancellation stops it entirely
+    cap = 16 + 4
+    assert produced <= cap, f"producer kept running: {produced} items"
+    snapshot = produced
+    time.sleep(1.0)
+    assert ray_tpu.get(rec.get.remote()) == snapshot  # fully stopped
+
+
+def test_stream_backpressure_limits_runahead():
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def bump(self):
+            self.n += 1
+
+        def get(self):
+            return self.n
+
+    c = Counter.remote()
+
+    @ray_tpu.remote(num_returns="streaming")
+    def gen(c):
+        for i in range(100):
+            ray_tpu.get(c.bump.remote())
+            yield i
+
+    g = gen.remote(c)
+    next(g)  # consume one, then stall
+    time.sleep(1.5)
+    produced = ray_tpu.get(c.get.remote())
+    assert produced <= 16 + 2, \
+        f"producer ran {produced} items ahead of a stalled consumer"
+    # drain; everything arrives in order
+    rest = [ray_tpu.get(r) for r in g]
+    assert rest == list(range(1, 100))
+
+
+def test_stream_midway_error_surfaces_on_get():
+    @ray_tpu.remote(num_returns="streaming")
+    def gen():
+        yield 1
+        yield 2
+        raise ValueError("boom at 2")
+
+    g = gen.remote()
+    assert ray_tpu.get(next(g)) == 1
+    assert ray_tpu.get(next(g)) == 2
+    err_ref = next(g)
+    with pytest.raises(ray_tpu.RayTaskError):
+        ray_tpu.get(err_ref)
+    with pytest.raises(StopIteration):
+        next(g)
+
+
+def test_stream_non_generator_errors():
+    @ray_tpu.remote(num_returns="streaming")
+    def not_gen():
+        return 42
+
+    g = not_gen.remote()
+    with pytest.raises(ray_tpu.RayTaskError):
+        next(g)
+
+
+def test_actor_sync_generator_method():
+    @ray_tpu.remote
+    class Producer:
+        def stream(self, n):
+            for i in range(n):
+                yield f"item-{i}"
+
+    p = Producer.remote()
+    g = p.stream.options(num_returns="streaming").remote(3)
+    assert [ray_tpu.get(r) for r in g] == ["item-0", "item-1", "item-2"]
+
+
+def test_async_actor_generator_method():
+    @ray_tpu.remote
+    class AsyncProducer:
+        async def stream(self, n):
+            import asyncio
+
+            for i in range(n):
+                await asyncio.sleep(0.01)
+                yield i * i
+
+    p = AsyncProducer.remote()
+    g = p.stream.options(num_returns="streaming").remote(4)
+    assert [ray_tpu.get(r) for r in g] == [0, 1, 4, 9]
+
+
+def test_stream_large_items_via_plasma():
+    import numpy as np
+
+    @ray_tpu.remote(num_returns="streaming")
+    def gen():
+        for i in range(3):
+            yield np.full(300_000, i, np.uint8)  # > inline threshold
+
+    for i, ref in enumerate(gen.remote()):
+        arr = ray_tpu.get(ref)
+        assert arr.shape == (300_000,) and arr[0] == i
