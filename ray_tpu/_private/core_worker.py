@@ -26,7 +26,7 @@ import queue as queue_mod
 import threading
 import time
 import traceback
-from collections import deque
+from collections import OrderedDict, deque
 from concurrent.futures import Future as SyncFuture
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional, Sequence, Tuple
@@ -107,6 +107,22 @@ class _MemoryStore:
         if raylet_addr not in self.locations[oid]:
             self.locations[oid].append(raylet_addr)
         self._signal(oid)
+
+    def drop_location(self, oid: bytes, raylet_addr: str):
+        """Remove a dead/stale location; when the last one goes, the
+        object is 'not ready' again so status waiters block until a
+        reconstruction (or late report) re-adds one."""
+        locs = self.locations.get(oid)
+        if locs is None:
+            return
+        if raylet_addr in locs:
+            locs.remove(raylet_addr)
+        if not locs:
+            self.locations.pop(oid, None)
+            ev = self._events.get(oid)
+            if ev is not None and oid not in self.values \
+                    and oid not in self.errors:
+                ev.clear()
 
     async def wait_ready(self, oid: bytes, timeout: float | None = None):
         if self.ready(oid):
@@ -204,6 +220,18 @@ class CoreWorker:
         # Owner-side streaming-generator state, keyed by the producing
         # task id (reference: StreamingGeneratorState in task_manager.h).
         self._streams: Dict[bytes, dict] = {}
+        # Lineage (reference: TaskManager lineage pinning,
+        # task_manager.h:208,269): specs of tasks whose returns live in
+        # plasma, retained so a lost object can be re-executed. Bounded
+        # by config.max_lineage_bytes, evicting oldest-first.
+        self._lineage: "OrderedDict[bytes, tuple]" = OrderedDict()
+        self._lineage_oids: Dict[bytes, bytes] = {}  # oid -> task_id
+        self._lineage_bytes = 0
+        self._reconstructing: Dict[bytes, asyncio.Future] = {}
+        # Primary-copy pins (reference: local_object_manager pinning —
+        # the raylet holding an owned object's primary copy keeps it
+        # unevictable until the owner's refcount drops to zero).
+        self._pinned_at: Dict[bytes, str] = {}
 
         # Executor state (worker mode).
         self._exec_queue: queue_mod.Queue = queue_mod.Queue()
@@ -268,11 +296,141 @@ class CoreWorker:
         self._local_refs[ref.binary()] = self._local_refs.get(ref.binary(), 0) + 1
 
     def deregister_ref(self, ref: ObjectRef):
-        n = self._local_refs.get(ref.binary(), 0) - 1
+        oid = ref.binary()
+        n = self._local_refs.get(oid, 0) - 1
         if n <= 0:
-            self._local_refs.pop(ref.binary(), None)
+            self._local_refs.pop(oid, None)
+            # last local ref gone: release the primary-copy pin and any
+            # lineage retained for this object (owner side)
+            if (oid in self._pinned_at or oid in self._lineage_oids) \
+                    and not self._shutdown:
+                try:
+                    self._loop.call_soon_threadsafe(self._on_ref_released,
+                                                    oid)
+                except RuntimeError:
+                    pass  # loop already closed at interpreter teardown
         else:
-            self._local_refs[ref.binary()] = n
+            self._local_refs[oid] = n
+
+    def _on_ref_released(self, oid: bytes):
+        addr = self._pinned_at.pop(oid, None)
+        if addr is not None:
+            asyncio.ensure_future(self._unpin_at(oid, addr))
+        task_id = self._lineage_oids.pop(oid, None)
+        if task_id is not None and task_id in self._lineage:
+            spec, size, oids = self._lineage[task_id]
+            if not any(o in self._lineage_oids for o in oids):
+                self._lineage.pop(task_id, None)
+                self._lineage_bytes -= size
+
+    async def _unpin_at(self, oid: bytes, addr: str):
+        try:
+            raylet = await self._clients.get(addr)
+            await raylet.notify("unpin_object", {"object_id": oid})
+        except (ConnectionLost, RpcError, OSError):
+            pass  # raylet gone — nothing left to unpin
+
+    async def _pin_at(self, oid: bytes, addr: str):
+        """Pin the primary copy at its hosting raylet so LRU eviction
+        cannot destroy an object the owner still references."""
+        self._pinned_at[oid] = addr
+        try:
+            raylet = await self._clients.get(addr)
+            await raylet.call("pin_object", {"object_id": oid},
+                              timeout=30.0)
+        except (ConnectionLost, RpcError, OSError,
+                asyncio.TimeoutError):
+            self._pinned_at.pop(oid, None)
+            return
+        if self._local_refs.get(oid, 0) <= 0 and \
+                self._pinned_at.pop(oid, None) is not None:
+            # the last ref died while the pin RPC was in flight —
+            # _on_ref_released saw no pin to release, so undo it here
+            await self._unpin_at(oid, addr)
+
+    # -- lineage / reconstruction --------------------------------------
+
+    def _retain_lineage(self, spec: task_mod.TaskSpec,
+                        plasma_oids: List[bytes]):
+        """Keep a re-executable task's spec while its plasma returns are
+        referenced (reference: task_manager.h:215 max_lineage_bytes)."""
+        if spec.task_type != task_mod.NORMAL_TASK or spec.streaming:
+            return  # actor/streaming tasks are not re-executable
+        size = sum(len(e[1]) if e[0] == "v" else 64 for e in spec.args) \
+            + 256
+        oids = [ObjectID.for_task_return(TaskID(spec.task_id), i).binary()
+                for i in range(spec.num_returns)]
+        # re-retains happen on every reconstruction reply — replace, do
+        # not double-count
+        old = self._lineage.pop(spec.task_id, None)
+        if old is not None:
+            self._lineage_bytes -= old[1]
+        self._lineage[spec.task_id] = (spec, size, oids)
+        for oid in plasma_oids:
+            self._lineage_oids[oid] = spec.task_id
+        self._lineage_bytes += size
+        while self._lineage_bytes > self.config.max_lineage_bytes \
+                and self._lineage:
+            _, (old_spec, old_size, old_oids) = \
+                self._lineage.popitem(last=False)
+            self._lineage_bytes -= old_size
+            for o in old_oids:
+                self._lineage_oids.pop(o, None)
+
+    async def _reconstruct(self, oid: bytes) -> bool:
+        """Re-execute the task that created a lost object (reference:
+        TaskManager::ResubmitTask + ObjectRecoveryManager). Dedupes
+        concurrent recoveries of the same task; resolves when the
+        re-execution's reply lands (repopulating locations + pins)."""
+        task_id = self._lineage_oids.get(oid)
+        if task_id is None or task_id not in self._lineage:
+            return False
+        fut = self._reconstructing.get(task_id)
+        if fut is None:
+            spec, _, oids = self._lineage[task_id]
+            logger.warning(
+                "object %s lost — re-executing task %s (%s)",
+                oid.hex()[:12], task_id.hex()[:12], spec.name)
+            fut = self._loop.create_future()
+            self._reconstructing[task_id] = fut
+            mem = self.memory_store
+            for roid in oids:
+                # clear each sibling's readiness properly: the event must
+                # reset so status waiters block until the new copy lands
+                for addr in list(mem.locations.get(roid, [])):
+                    mem.drop_location(roid, addr)
+                # release surviving sibling pins — a popped-but-not-
+                # unpinned entry would hold plasma memory forever
+                pinned = self._pinned_at.pop(roid, None)
+                if pinned is not None:
+                    asyncio.ensure_future(self._unpin_at(roid, pinned))
+            self._enqueue_task(spec)
+        await fut
+        return True
+
+    async def rpc_report_lost_location(self, req):
+        """A raylet failed to fetch from a location we advertised: if the
+        GCS agrees that node is dead, drop the location, and if that was
+        the last copy of a reconstructible object kick off re-execution
+        (the caller re-queries status, which then blocks until the new
+        copy lands). A transient fetch error to a node the GCS still
+        considers alive must NOT drop the location — for objects without
+        lineage (puts, actor returns) a wrongly-dropped last copy is
+        unrecoverable."""
+        oid = req["object_id"]
+        addr = req["raylet_addr"]
+        try:
+            nodes = await self.gcs.call("get_nodes", {}, timeout=10.0)
+            alive = {n["raylet_addr"] for n in nodes if n["alive"]}
+        except (ConnectionLost, RpcError, OSError, asyncio.TimeoutError):
+            return {"ok": False, "still_alive": True}  # can't verify
+        if addr in alive:
+            return {"ok": False, "still_alive": True}
+        self.memory_store.drop_location(oid, addr)
+        if oid not in self.memory_store.locations and \
+                oid in self._lineage_oids:
+            asyncio.ensure_future(self._reconstruct(oid))
+        return {"ok": True}
 
     # ------------------------------------------------------------------
     # function manager (reference: python/ray/_private/function_manager.py)
@@ -312,8 +470,13 @@ class CoreWorker:
             frame = serialization.pack(pickled, buffers)
             self._run_sync(self._put_inband(oid.binary(), frame))
         else:
+            # construct the ref (registering the local refcount) BEFORE
+            # the pin is scheduled — _pin_at's stale-ref guard must see
+            # the count at 1, or a fast pin RPC would immediately unpin
+            ref = ObjectRef(oid, self.address)
             self.store.put_serialized(oid, pickled, buffers)
             self._run_sync(self._put_plasma_meta(oid.binary()))
+            return ref
         return ObjectRef(oid, self.address)
 
     async def _put_inband(self, oid: bytes, frame: bytes):
@@ -321,6 +484,9 @@ class CoreWorker:
 
     async def _put_plasma_meta(self, oid: bytes):
         self.memory_store.add_location(oid, self.raylet_addr)
+        # pin the primary copy until the owner's refs are gone (put()
+        # returns the ref right after, so the refcount is about to be 1)
+        asyncio.ensure_future(self._pin_at(oid, self.raylet_addr))
 
     _FAST_MISS = object()
 
@@ -812,7 +978,10 @@ class CoreWorker:
             except RayTaskError as e:
                 return {"granted": False, "error": str(e)}
             no_spillback = True
-        for _ in range(max_hops):
+        conn_retries = 0
+        hops = 0
+        while hops < max_hops:
+            hops += 1
             try:
                 raylet = await self._clients.get(addr)
                 reply = await raylet.call("request_worker_lease", {
@@ -820,6 +989,22 @@ class CoreWorker:
                     "no_spillback": no_spillback,
                 }, timeout=300.0)
             except (ConnectionLost, RpcError, OSError) as e:
+                if (spec.strategy == task_mod.STRATEGY_NODE_AFFINITY
+                        and spec.soft and addr != self.raylet_addr
+                        and conn_retries < 15):
+                    # soft affinity to a dead/unreachable node: wait for
+                    # the GCS to prune it from the view, then re-route
+                    # from the local raylet (which will fall back to the
+                    # normal policy once the target is gone). Each cycle
+                    # resets the hop budget — the reroute itself consumes
+                    # local->target hops and would otherwise exhaust
+                    # max_hops before the ~5s prune window elapses.
+                    conn_retries += 1
+                    hops = 0
+                    addr = self.raylet_addr
+                    no_spillback = False
+                    await asyncio.sleep(1.0)
+                    continue
                 return {"granted": False, "error": str(e)}
             if reply.get("granted"):
                 reply["raylet_addr"] = addr
@@ -874,6 +1059,7 @@ class CoreWorker:
 
     def _process_task_reply(self, spec: task_mod.TaskSpec, reply: dict):
         mem = self.memory_store
+        plasma_oids: List[bytes] = []
         for entry in reply.get("returns", []):
             oid, kind, payload = entry
             if kind == "v":
@@ -882,6 +1068,16 @@ class CoreWorker:
                 mem.put_error(oid, payload)
             elif kind == "plasma":
                 mem.add_location(oid, payload)
+                plasma_oids.append(oid)
+                if self._local_refs.get(oid, 0) > 0:
+                    # pin while the owner still holds refs; released
+                    # when the local refcount hits zero
+                    asyncio.ensure_future(self._pin_at(oid, payload))
+        if plasma_oids:
+            self._retain_lineage(spec, plasma_oids)
+        fut = self._reconstructing.pop(spec.task_id, None)
+        if fut is not None and not fut.done():
+            fut.set_result(True)
         if spec.streaming:
             # the final reply closes the stream; pre-execution failures
             # arrive as an error entry instead of item reports
@@ -893,6 +1089,9 @@ class CoreWorker:
             self._finish_stream(spec.task_id, err)
 
     def _store_task_error(self, spec: task_mod.TaskSpec, err: Exception):
+        fut = self._reconstructing.pop(spec.task_id, None)
+        if fut is not None and not fut.done():
+            fut.set_result(False)
         if spec.streaming:
             self._loop.call_soon_threadsafe(
                 self._finish_stream, spec.task_id, err)

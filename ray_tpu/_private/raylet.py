@@ -129,6 +129,7 @@ class Raylet:
         self._bg: list = []
         self._spawned_procs: List[tuple] = []  # (proc, pool_key) pre-register
         self._pulls_inflight: Dict[bytes, asyncio.Future] = {}
+        self._pinned: Dict[bytes, object] = {}  # oid -> held PlasmaBuffer
         self._freed_since_heartbeat = False
         self._actor_workers: Dict[bytes, bytes] = {}  # worker_id -> actor_id
 
@@ -346,18 +347,36 @@ class Raylet:
         # Cluster-level decision: schedule here or spill back to another node.
         if spec.placement_group_id is None and not req.get("no_spillback"):
             if (spec.strategy == task_mod.STRATEGY_NODE_AFFINITY
-                    and spec.node_id is not None and not spec.soft):
-                # Hard affinity: always route to the target raylet — it is
-                # the authority on its own resources and queues the lease
-                # if busy. Deciding from our (possibly stale) view here
-                # could wrongly run the task locally.
-                if spec.node_id != self.node_id.binary():
-                    target = self.view.nodes.get(spec.node_id)
-                    if target is None:
-                        return {"granted": False,
-                                "error": "affinity target node is dead"}
+                    and spec.node_id is not None
+                    and spec.node_id != self.node_id.binary()):
+                # Affinity routes to the target raylet — it is the
+                # authority on its own resources and queues the lease if
+                # busy. Deciding fit from our (possibly stale) view here
+                # could wrongly run the task locally. The heartbeat-fed
+                # view lags at startup, so an unknown target is resolved
+                # against the GCS node table before concluding anything.
+                target = self.view.nodes.get(spec.node_id)
+                if target is None:
+                    target = await self._refresh_view_for(spec.node_id)
+                if target is not None and (
+                        not spec.soft
+                        or target.fits_now(spec.resources)):
+                    # route to the target (hard always — it queues; soft
+                    # only while it currently fits, else fall back)
                     return {"granted": False,
                             "spillback_addr": target.raylet_addr}
+                if not spec.soft:
+                    return {"granted": False,
+                            "error": "affinity target node is dead"}
+                # soft + target gone: fall through to the normal policy
+                node = pick_node(
+                    self.view, spec.resources, task_mod.STRATEGY_DEFAULT,
+                    local_node_id=self.node_id.binary(),
+                    spread_threshold=self.config.scheduler_spread_threshold,
+                )
+                if node is not None and node.node_id != self.node_id.binary():
+                    return {"granted": False,
+                            "spillback_addr": node.raylet_addr}
             else:
                 node = pick_node(
                     self.view, spec.resources, spec.strategy,
@@ -384,6 +403,20 @@ class Raylet:
         asyncio.ensure_future(self._localize_deps(lease))
         self._dispatch()
         return await lease.reply_fut
+
+    async def _refresh_view_for(self, node_id: bytes):
+        """Pull the authoritative node table from the GCS when a node is
+        missing from the heartbeat-fed view (startup staleness)."""
+        try:
+            nodes = await self.gcs.call("get_nodes", {}, timeout=10.0)
+        except (ConnectionLost, RpcError, OSError, asyncio.TimeoutError):
+            return None
+        for n in nodes:
+            if n["alive"]:
+                self.view.update_node(n["node_id"], n["raylet_addr"],
+                                      n["total"], n["available"],
+                                      labels=n.get("labels"))
+        return self.view.nodes.get(node_id)
 
     async def _localize_deps(self, lease: Lease):
         deps = lease.spec.plasma_deps()
@@ -633,38 +666,7 @@ class Raylet:
         fut = asyncio.get_event_loop().create_future()
         self._pulls_inflight[object_id.binary()] = fut
         try:
-            owner = await self.clients.get(owner_addr)
-            status = await owner.call("get_object_status", {
-                "object_id": object_id.binary(),
-                "wait": True,
-            }, timeout=300.0)
-            if status.get("error"):
-                raise RuntimeError(status["error"])
-            if self.store.contains(object_id):
-                return
-            if status["status"] == "inband":
-                self.store.put_raw(object_id, status["value"])
-            else:
-                locations = [
-                    a for a in status.get("locations", [])
-                    if a != self.server.address
-                ]
-                if not locations:
-                    raise RuntimeError(
-                        f"no locations for object {object_id.hex()}"
-                    )
-                holder = await self.clients.get(locations[0])
-                data = await holder.call(
-                    "fetch_object", {"object_id": object_id.binary()},
-                    timeout=300.0,
-                )
-                if data.get("data") is None:
-                    raise RuntimeError(f"fetch failed for {object_id.hex()}")
-                self.store.put_raw(object_id, data["data"])
-                await owner.notify("add_object_location", {
-                    "object_id": object_id.binary(),
-                    "raylet_addr": self.server.address,
-                })
+            await self._pull_with_recovery(object_id, owner_addr)
             fut.set_result(True)
         except BaseException as e:
             fut.set_exception(e)
@@ -677,6 +679,75 @@ class Raylet:
             # stale completed future.
             self._pulls_inflight.pop(object_id.binary(), None)
 
+    async def _pull_with_recovery(self, object_id: ObjectID,
+                                  owner_addr: str, attempts: int = 8):
+        """Fetch from an advertised location; on failure report the dead
+        location to the owner (who drops it and, for reconstructible
+        objects, re-executes the creating task — reference:
+        ObjectRecoveryManager) and re-query. The status query blocks
+        while the owner reconstructs, so the retry lands on the fresh
+        copy."""
+        owner = await self.clients.get(owner_addr)
+        last_err = "no locations"
+        for _ in range(attempts):
+            status = await owner.call("get_object_status", {
+                "object_id": object_id.binary(),
+                "wait": True,
+            }, timeout=300.0)
+            if status.get("error"):
+                raise RuntimeError(status["error"])
+            if self.store.contains(object_id):
+                return
+            if status["status"] == "inband":
+                self.store.put_raw(object_id, status["value"])
+                return
+            if status["status"] == "err":
+                # error frames surface at the caller's get(); nothing to
+                # localize
+                raise RuntimeError("object errored at owner")
+            locations = [
+                a for a in status.get("locations", [])
+                if a != self.server.address
+            ]
+            if not locations:
+                last_err = f"no locations for {object_id.hex()}"
+                await asyncio.sleep(0.1)
+                continue
+            fetched = False
+            for addr in locations:
+                try:
+                    holder = await self.clients.get(addr)
+                    data = await holder.call(
+                        "fetch_object",
+                        {"object_id": object_id.binary()},
+                        timeout=300.0,
+                    )
+                except (ConnectionLost, RpcError, OSError):
+                    data = {"data": None}
+                if data.get("data") is not None:
+                    self.store.put_raw(object_id, data["data"])
+                    await owner.notify("add_object_location", {
+                        "object_id": object_id.binary(),
+                        "raylet_addr": self.server.address,
+                    })
+                    fetched = True
+                    break
+                last_err = f"fetch failed from {addr}"
+                verdict = await owner.call("report_lost_location", {
+                    "object_id": object_id.binary(),
+                    "raylet_addr": addr,
+                }, timeout=30.0)
+                if verdict.get("still_alive"):
+                    # transient blip to a live holder — or a dead node
+                    # the GCS hasn't pruned yet (prune takes ~period ×
+                    # threshold). Back off long enough that the attempt
+                    # budget comfortably spans that window.
+                    await asyncio.sleep(1.0)
+            if fetched:
+                return
+        raise RuntimeError(
+            f"pull failed for {object_id.hex()}: {last_err}")
+
     async def rpc_pull_object(self, req):
         await self.pull_object(ObjectID(req["object_id"]), req["owner_addr"])
         return {"ok": True}
@@ -686,6 +757,26 @@ class Raylet:
         if buf is None:
             return {"data": None}
         return {"data": bytes(buf)}
+
+    # -- primary-copy pinning (reference: local_object_manager.h — the
+    # raylet holding an owned object's primary copy keeps it unevictable
+    # until the owner releases it) -------------------------------------
+
+    async def rpc_pin_object(self, req):
+        oid = ObjectID(req["object_id"])
+        if req["object_id"] in self._pinned:
+            return {"ok": True}
+        buf = self.store.get_buffer(oid, timeout=0)
+        if buf is None:
+            return {"ok": False, "error": "object not in store"}
+        # holding the buffer holds the store refcount; LRU only evicts
+        # refcount-zero objects
+        self._pinned[req["object_id"]] = buf
+        return {"ok": True}
+
+    async def rpc_unpin_object(self, req):
+        self._pinned.pop(req["object_id"], None)
+        return {"ok": True}
 
     async def rpc_get_store_stats(self, req):
         return self.store.stats()

@@ -1,0 +1,143 @@
+"""Lineage reconstruction + distributed primary-copy pinning tests.
+
+Reference surface: `src/ray/core_worker/task_manager.h:208,269` (lineage
++ resubmit), `object_recovery_manager.h:41`, `reference_count.h:61`, and
+the raylet's primary-copy pinning (`local_object_manager.h:41`).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu._private.node import Cluster
+
+
+def test_pin_prevents_eviction_of_referenced_objects():
+    """An owned, referenced plasma object survives store pressure that
+    evicts unreferenced ones."""
+    ray_tpu.init(num_cpus=2, object_store_memory=32 * 1024 * 1024)
+    try:
+        keep = ray_tpu.put(np.arange(2_000_000, dtype=np.uint8))  # ~2MB
+        time.sleep(0.3)  # let the pin RPC land
+        # pressure: 30MB of filler whose refs die immediately
+        for i in range(15):
+            ray_tpu.put(np.full(2_000_000, i, np.uint8))
+        # the pinned object must still be readable
+        out = ray_tpu.get(keep, timeout=10)
+        assert out[12345] == np.arange(2_000_000, dtype=np.uint8)[12345]
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_unpin_after_ref_drop_allows_eviction():
+    """Dropping the last ref unpins: the store can then reclaim the
+    space under pressure instead of erroring."""
+    ray_tpu.init(num_cpus=2, object_store_memory=32 * 1024 * 1024)
+    try:
+        refs = [ray_tpu.put(np.full(6_000_000, i, np.uint8))
+                for i in range(4)]  # ~24MB pinned
+        time.sleep(0.3)
+        del refs  # unpin all
+        time.sleep(0.5)
+        # must fit: requires eviction of the unpinned objects
+        big = ray_tpu.put(np.full(20_000_000, 7, np.uint8))
+        assert ray_tpu.get(big, timeout=10)[0] == 7
+    finally:
+        ray_tpu.shutdown()
+
+
+@pytest.fixture
+def two_node_cluster():
+    cluster = Cluster()
+    cluster.add_node({"CPU": 2.0})  # head / driver side
+    worker_node = cluster.add_node({"CPU": 2.0, "scratch": 1.0})
+    ray_tpu.init(address=cluster.gcs_addr)
+    yield cluster, worker_node
+    ray_tpu.shutdown()
+    cluster.shutdown()
+
+
+def test_lineage_reconstruction_after_node_death(two_node_cluster):
+    """Kill the node holding a task's plasma return: get() on the SAME
+    ref re-executes the task on a surviving node and returns the value
+    (soft node affinity lets the re-execution relocate)."""
+    cluster, worker_node = two_node_cluster
+
+    affinity = ray_tpu.NodeAffinitySchedulingStrategy(
+        worker_node.node_id_hex, soft=True)
+
+    @ray_tpu.remote(scheduling_strategy=affinity)
+    def produce():
+        return np.full(500_000, 42, np.uint8)  # plasma-sized
+
+    ref = produce.remote()
+    # wait, don't get — a get would localize a driver-side copy and the
+    # kill below wouldn't actually lose the object
+    ready, _ = ray_tpu.wait([ref], timeout=60)
+    assert ready
+
+    # kill the node that holds the only copy
+    cluster.remove_node(worker_node)
+    time.sleep(1.0)
+
+    out = ray_tpu.get(ref, timeout=120)
+    assert out[0] == 42 and out.shape == (500_000,)
+
+
+def test_lineage_reconstruction_recovers_value():
+    """Same-node recovery: object evicted/destroyed behind the owner's
+    back is re-created by re-executing its task, exactly once per loss."""
+    cluster = Cluster()
+    cluster.add_node({"CPU": 4.0})
+    victim = cluster.add_node({"CPU": 2.0, "scratch": 1.0})
+    ray_tpu.init(address=cluster.gcs_addr)
+    try:
+        @ray_tpu.remote
+        class Counter:
+            def __init__(self):
+                self.n = 0
+
+            def bump(self):
+                self.n += 1
+                return self.n
+
+            def get(self):
+                return self.n
+
+        counter = Counter.options(name="exec_counter").remote()
+        ray_tpu.get(counter.bump.remote())  # ensure alive
+        ray_tpu.get(counter.bump.remote())
+
+        @ray_tpu.remote(resources={"scratch": 1.0}, num_cpus=0,
+                        scheduling_strategy="SPREAD")
+        def produce():
+            c = ray_tpu.get_actor("exec_counter")
+            ray_tpu.get(c.bump.remote())
+            return np.full(400_000, 9, np.uint8)
+
+        ref = produce.remote()
+        # wait (not get!) — get would localize a second copy onto the
+        # driver's node and defeat the loss scenario
+        ready, _ = ray_tpu.wait([ref], timeout=60)
+        assert ready
+        before = ray_tpu.get(counter.get.remote())
+
+        cluster.remove_node(victim)  # destroy the only copy
+        time.sleep(1.0)
+
+        # ...but produce's spec requires "scratch", which died with the
+        # node — bring a fresh scratch-capable node so re-execution can
+        # schedule (elastic recovery: replacement capacity arrives)
+        cluster.add_node({"CPU": 2.0, "scratch": 1.0})
+        time.sleep(1.0)
+
+        out = ray_tpu.get(ref, timeout=120)
+        assert out[0] == 9 and out.shape == (400_000,)
+        after = ray_tpu.get(counter.get.remote())
+        assert after == before + 1, \
+            f"expected exactly one re-execution, got {after - before}"
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
