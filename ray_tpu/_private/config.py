@@ -27,6 +27,11 @@ class Config:
     # owner buffers before it withholds the executor's ack (reference:
     # generator_waiter.h backpressure threshold).
     streaming_backpressure_items: int = 16
+    # Node-to-node object transfer chunk size (reference:
+    # object_manager_default_chunk_size, ray_config_def.h) and how many
+    # chunk fetches ride in flight per object.
+    object_transfer_chunk_bytes: int = 4 * 1024 * 1024
+    object_transfer_parallelism: int = 4
     # Default per-node shared-memory store capacity.
     object_store_memory: int = 2 * 1024**3
     # Object-table slots in the shm store header.

@@ -301,9 +301,12 @@ class CoreWorker:
         if n <= 0:
             self._local_refs.pop(oid, None)
             # last local ref gone: release the primary-copy pin and any
-            # lineage retained for this object (owner side)
-            if (oid in self._pinned_at or oid in self._lineage_oids) \
-                    and not self._shutdown:
+            # lineage retained for this object (owner side). Posted
+            # unconditionally — the reply that records the pin may still
+            # be in flight on the loop thread, so gating on "is a pin
+            # recorded yet" here would race it (the reply side re-checks
+            # the refcount after recording to cover the other order).
+            if not self._shutdown:
                 try:
                     self._loop.call_soon_threadsafe(self._on_ref_released,
                                                     oid)
@@ -329,24 +332,6 @@ class CoreWorker:
             await raylet.notify("unpin_object", {"object_id": oid})
         except (ConnectionLost, RpcError, OSError):
             pass  # raylet gone — nothing left to unpin
-
-    async def _pin_at(self, oid: bytes, addr: str):
-        """Pin the primary copy at its hosting raylet so LRU eviction
-        cannot destroy an object the owner still references."""
-        self._pinned_at[oid] = addr
-        try:
-            raylet = await self._clients.get(addr)
-            await raylet.call("pin_object", {"object_id": oid},
-                              timeout=30.0)
-        except (ConnectionLost, RpcError, OSError,
-                asyncio.TimeoutError):
-            self._pinned_at.pop(oid, None)
-            return
-        if self._local_refs.get(oid, 0) <= 0 and \
-                self._pinned_at.pop(oid, None) is not None:
-            # the last ref died while the pin RPC was in flight —
-            # _on_ref_released saw no pin to release, so undo it here
-            await self._unpin_at(oid, addr)
 
     # -- lineage / reconstruction --------------------------------------
 
@@ -419,17 +404,29 @@ class CoreWorker:
         unrecoverable."""
         oid = req["object_id"]
         addr = req["raylet_addr"]
-        try:
-            nodes = await self.gcs.call("get_nodes", {}, timeout=10.0)
-            alive = {n["raylet_addr"] for n in nodes if n["alive"]}
-        except (ConnectionLost, RpcError, OSError, asyncio.TimeoutError):
-            return {"ok": False, "still_alive": True}  # can't verify
-        if addr in alive:
-            return {"ok": False, "still_alive": True}
+        if not req.get("authoritative"):
+            # third-party report: only trust it if the GCS agrees the
+            # node is dead (a raylet reporting about its OWN store is
+            # authoritative and skips this)
+            try:
+                nodes = await self.gcs.call("get_nodes", {}, timeout=10.0)
+                alive = {n["raylet_addr"] for n in nodes if n["alive"]}
+            except (ConnectionLost, RpcError, OSError,
+                    asyncio.TimeoutError):
+                return {"ok": False, "still_alive": True}  # can't verify
+            if addr in alive:
+                return {"ok": False, "still_alive": True}
         self.memory_store.drop_location(oid, addr)
-        if oid not in self.memory_store.locations and \
-                oid in self._lineage_oids:
-            asyncio.ensure_future(self._reconstruct(oid))
+        if oid not in self.memory_store.locations:
+            if oid in self._lineage_oids:
+                asyncio.ensure_future(self._reconstruct(oid))
+            else:
+                # unrecoverable: fail every waiter fast instead of
+                # letting status queries block to their timeouts
+                self.memory_store.put_error(oid, serialization.dumps(
+                    RayTaskError(
+                        f"object {oid.hex()[:12]} lost: all copies gone "
+                        "and no lineage to re-execute")))
         return {"ok": True}
 
     # ------------------------------------------------------------------
@@ -471,22 +468,87 @@ class CoreWorker:
             self._run_sync(self._put_inband(oid.binary(), frame))
         else:
             # construct the ref (registering the local refcount) BEFORE
-            # the pin is scheduled — _pin_at's stale-ref guard must see
-            # the count at 1, or a fast pin RPC would immediately unpin
+            # the pin is recorded — _on_ref_released must find a count
+            # to decrement when the user drops the ref
             ref = ObjectRef(oid, self.address)
-            self.store.put_serialized(oid, pickled, buffers)
+            self._plasma_put_pinned(oid, pickled, buffers, size)
             self._run_sync(self._put_plasma_meta(oid.binary()))
             return ref
         return ObjectRef(oid, self.address)
+
+    def _plasma_write(self, write_fn, size: int):
+        """Run a plasma write, asking the local raylet to spill pinned
+        objects to disk when the arena is full (reference: the raylet's
+        CreateRequestQueue spill-on-pressure path). This is what lets the
+        store hold more live data than its shm capacity."""
+        from ray_tpu._private.object_store import ObjectStoreFullError
+
+        for _ in range(4):
+            try:
+                return write_fn()
+            except ObjectStoreFullError:
+                if self.raylet_addr is None:
+                    raise
+                freed = self._run_sync(self._request_spill(size))
+                if freed == 0:
+                    raise
+        return write_fn()
+
+    def _plasma_put_pinned(self, oid: ObjectID, pickled, buffers,
+                           size: int):
+        """Create+seal+pin without an unprotected window: the creator's
+        store reference (held from create until after the raylet's pin
+        lands) is what stops a concurrent writer's eviction from
+        destroying the fresh refcount-0 object. Reference: the worker
+        pins primary copies through its raylet before the task reply."""
+        def write():
+            buf = self.store.create_buffer(oid, size)
+            serialization.write_to(buf, pickled, buffers)
+            self.store.seal(oid)
+            # NOT released yet — we still hold the create reference
+        self._plasma_write(write, size)
+        try:
+            self._pin_local(oid.binary())
+        finally:
+            self.store.release(oid)
+
+    def _pin_local(self, oid: bytes):
+        """Executor-side synchronous pin of a freshly-created return at
+        the local raylet (reference: the worker pins primary copies via
+        its raylet at task completion; the owner later takes over the
+        unpin side)."""
+        if self.raylet_addr is None:
+            return
+        try:
+            self._run_sync(self._pin_local_async(oid), timeout=30)
+        except Exception as e:  # noqa: BLE001 — the object stays
+            # readable now (creator still holds its reference) but is
+            # unprotected from eviction afterwards; make that traceable
+            logger.warning("pin of %s at local raylet failed: %r",
+                           oid.hex()[:12], e)
+
+    async def _pin_local_async(self, oid: bytes):
+        raylet = await self._clients.get(self.raylet_addr)
+        await raylet.call("pin_object", {"object_id": oid}, timeout=30.0)
+
+    async def _request_spill(self, size: int) -> int:
+        try:
+            raylet = await self._clients.get(self.raylet_addr)
+            reply = await raylet.call("spill_objects",
+                                      {"needed": size}, timeout=60.0)
+            return int(reply.get("freed", 0))
+        except (ConnectionLost, RpcError, OSError,
+                asyncio.TimeoutError):
+            return 0
 
     async def _put_inband(self, oid: bytes, frame: bytes):
         self.memory_store.put_value(oid, frame)
 
     async def _put_plasma_meta(self, oid: bytes):
         self.memory_store.add_location(oid, self.raylet_addr)
-        # pin the primary copy until the owner's refs are gone (put()
-        # returns the ref right after, so the refcount is about to be 1)
-        asyncio.ensure_future(self._pin_at(oid, self.raylet_addr))
+        # the raylet already holds the pin (_plasma_put_pinned); just
+        # record where, so ref release routes the unpin
+        self._pinned_at[oid] = self.raylet_addr
 
     _FAST_MISS = object()
 
@@ -895,6 +957,9 @@ class CoreWorker:
             mem.put_error(oid, payload)
         else:  # plasma
             mem.add_location(oid, payload)
+            # the executor pinned the item at its raylet; record the
+            # mapping so the consumer's ref release unpins it
+            self._pinned_at[oid] = payload
         st["items"].append(ObjectRef(ObjectID(oid), self.address))
         st["new_item"].set()
         while (len(st["items"]) >=
@@ -1069,12 +1134,23 @@ class CoreWorker:
             elif kind == "plasma":
                 mem.add_location(oid, payload)
                 plasma_oids.append(oid)
+                # the executor pinned the return at its raylet before
+                # replying — record the mapping (or release right away
+                # if the caller already dropped every ref)
                 if self._local_refs.get(oid, 0) > 0:
-                    # pin while the owner still holds refs; released
-                    # when the local refcount hits zero
-                    asyncio.ensure_future(self._pin_at(oid, payload))
+                    self._pinned_at[oid] = payload
+                    if self._local_refs.get(oid, 0) <= 0:
+                        # the last ref died between the check and the
+                        # record — its release callback saw no pin, so
+                        # clean up here (idempotent with that callback)
+                        self._on_ref_released(oid)
+                else:
+                    asyncio.ensure_future(self._unpin_at(oid, payload))
         if plasma_oids:
             self._retain_lineage(spec, plasma_oids)
+            for oid in plasma_oids:
+                if self._local_refs.get(oid, 0) <= 0:
+                    self._on_ref_released(oid)  # ref died pre-reply
         fut = self._reconstructing.pop(spec.task_id, None)
         if fut is not None and not fut.done():
             fut.set_result(True)
@@ -1484,7 +1560,10 @@ class CoreWorker:
                 # the actor's other coroutines
                 return await asyncio.get_running_loop().run_in_executor(
                     None, self._execute_streaming, spec, result)
-            return self._package_returns(spec, result)
+            # packaging can block (plasma write + pin RPC under memory
+            # pressure) — keep it off the actor's event loop
+            return await asyncio.get_running_loop().run_in_executor(
+                None, self._package_returns, spec, result)
         except Exception as e:  # noqa: BLE001
             return self._package_error(spec, e)
 
@@ -1569,7 +1648,7 @@ class CoreWorker:
         if size <= self.config.max_direct_call_object_size or \
                 self.store is None:
             return [oid.binary(), "v", serialization.pack(pickled, buffers)]
-        self.store.put_serialized(oid, pickled, buffers)
+        self._plasma_put_pinned(oid, pickled, buffers, size)
         return [oid.binary(), "plasma", self.raylet_addr]
 
     async def _report_item(self, spec: task_mod.TaskSpec, item: list) -> dict:
@@ -1607,9 +1686,11 @@ class CoreWorker:
         """Async-actor variant: drives an async generator (Serve response
         streaming rides on this path)."""
         index = 0
+        loop = asyncio.get_running_loop()
         try:
             async for value in agen:
-                item = self._package_item(spec, index, value)
+                item = await loop.run_in_executor(
+                    None, self._package_item, spec, index, value)
                 index += 1
                 ack = await asyncio.wrap_future(
                     asyncio.run_coroutine_threadsafe(
@@ -1655,7 +1736,7 @@ class CoreWorker:
                 returns.append([oid.binary(), "v",
                                 serialization.pack(pickled, buffers)])
             else:
-                self.store.put_serialized(oid, pickled, buffers)
+                self._plasma_put_pinned(oid, pickled, buffers, size)
                 returns.append([oid.binary(), "plasma", self.raylet_addr])
         return {"returns": returns}
 

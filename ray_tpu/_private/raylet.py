@@ -130,6 +130,20 @@ class Raylet:
         self._spawned_procs: List[tuple] = []  # (proc, pool_key) pre-register
         self._pulls_inflight: Dict[bytes, asyncio.Future] = {}
         self._pinned: Dict[bytes, object] = {}  # oid -> held PlasmaBuffer
+        # Disk spilling (reference: local_object_manager.h spill/restore):
+        # pinned primary copies written to session-dir files so the shm
+        # arena can hold more live data than its capacity.
+        self._spilled: Dict[bytes, tuple] = {}  # oid -> (path, size)
+        self._spill_dir = os.path.join(
+            session_dir, f"spill-{self.node_id.hex()[:12]}")
+        # serializes spill/restore disk work, which runs in executor
+        # threads so multi-GB file I/O never stalls the event loop (and
+        # with it the heartbeat that keeps this node alive)
+        self._spill_lock = asyncio.Lock()
+        # outbound-transfer leases: hold the buffer from meta to last
+        # chunk so a pressured store cannot evict (and force re-restore
+        # of) an object per chunk
+        self._transfer_handles: Dict[bytes, object] = {}
         self._freed_since_heartbeat = False
         self._actor_workers: Dict[bytes, bytes] = {}  # worker_id -> actor_id
 
@@ -170,6 +184,9 @@ class Raylet:
         await self.clients.close_all()
         await self.server.stop()
         self.store.destroy()
+        import shutil
+
+        shutil.rmtree(self._spill_dir, ignore_errors=True)
 
     @property
     def address(self) -> str:
@@ -656,8 +673,11 @@ class Raylet:
     # ------------------------------------------------------------------
 
     async def pull_object(self, object_id: ObjectID, owner_addr: str):
-        """Ensure `object_id` is in the local store, fetching if needed."""
+        """Ensure `object_id` is in the local store, fetching (or
+        restoring from local spill) if needed."""
         if self.store.contains(object_id):
+            return
+        if await self._restore_async(object_id.binary()):
             return
         inflight = self._pulls_inflight.get(object_id.binary())
         if inflight is not None:
@@ -699,38 +719,42 @@ class Raylet:
             if self.store.contains(object_id):
                 return
             if status["status"] == "inband":
-                self.store.put_raw(object_id, status["value"])
+                await self._put_raw_with_spill_async(object_id,
+                                                     status["value"])
                 return
             if status["status"] == "err":
                 # error frames surface at the caller's get(); nothing to
                 # localize
                 raise RuntimeError("object errored at owner")
-            locations = [
-                a for a in status.get("locations", [])
-                if a != self.server.address
-            ]
+            all_locs = status.get("locations", [])
+            locations = [a for a in all_locs if a != self.server.address]
             if not locations:
+                if self.server.address in all_locs:
+                    # the owner thinks WE hold it, but we don't (evicted
+                    # or lost): this report is authoritative — no GCS
+                    # liveness check can refute a raylet about its own
+                    # store
+                    await owner.call("report_lost_location", {
+                        "object_id": object_id.binary(),
+                        "raylet_addr": self.server.address,
+                        "authoritative": True,
+                    }, timeout=30.0)
                 last_err = f"no locations for {object_id.hex()}"
-                await asyncio.sleep(0.1)
+                await asyncio.sleep(0.5)
                 continue
             fetched = False
             for addr in locations:
                 try:
-                    holder = await self.clients.get(addr)
-                    data = await holder.call(
-                        "fetch_object",
-                        {"object_id": object_id.binary()},
-                        timeout=300.0,
-                    )
-                except (ConnectionLost, RpcError, OSError):
-                    data = {"data": None}
-                if data.get("data") is not None:
-                    self.store.put_raw(object_id, data["data"])
+                    fetched = await self._fetch_remote_chunked(
+                        object_id, addr)
+                except (ConnectionLost, RpcError, OSError,
+                        RuntimeError):
+                    fetched = False
+                if fetched:
                     await owner.notify("add_object_location", {
                         "object_id": object_id.binary(),
                         "raylet_addr": self.server.address,
                     })
-                    fetched = True
                     break
                 last_err = f"fetch failed from {addr}"
                 verdict = await owner.call("report_lost_location", {
@@ -752,11 +776,204 @@ class Raylet:
         await self.pull_object(ObjectID(req["object_id"]), req["owner_addr"])
         return {"ok": True}
 
-    async def rpc_fetch_object(self, req):
-        buf = self.store.get_buffer(ObjectID(req["object_id"]), timeout=-1)
+    # -- chunked transfer (reference: ObjectBufferPool chunking,
+    # object_manager.h:117 — fixed-size chunks pipelined into a
+    # pre-created buffer, so object size is not capped by the RPC frame
+    # limit and no whole-object intermediate copy is made) -------------
+
+    async def _buffer_or_restore(self, oid_bytes: bytes):
+        buf = self.store.get_buffer(ObjectID(oid_bytes), timeout=-1)
+        if buf is None:
+            try:
+                restored = await self._restore_async(oid_bytes)
+            except Exception as e:  # noqa: BLE001
+                logger.warning("restore of %s failed: %r",
+                               oid_bytes.hex()[:12], e)
+                return None
+            if restored:
+                buf = self.store.get_buffer(ObjectID(oid_bytes),
+                                            timeout=-1)
+            else:
+                logger.info("object %s neither in store nor spilled",
+                            oid_bytes.hex()[:12])
+        return buf
+
+    def _release_transfer_handle(self, oid_bytes: bytes):
+        self._transfer_handles.pop(oid_bytes, None)
+
+    async def rpc_fetch_object_meta(self, req):
+        oid = req["object_id"]
+        buf = await self._buffer_or_restore(oid)
+        if buf is None:
+            return {"size": None}
+        # transfer lease: keep the buffer referenced (unevictable) while
+        # the puller streams chunks; reaped on a timer as a backstop
+        self._transfer_handles[oid] = buf
+        asyncio.get_event_loop().call_later(
+            300.0, self._release_transfer_handle, oid)
+        return {"size": buf.nbytes}
+
+    async def rpc_fetch_object_chunk(self, req):
+        oid = req["object_id"]
+        buf = self._transfer_handles.get(oid)
+        if buf is None:
+            buf = await self._buffer_or_restore(oid)
         if buf is None:
             return {"data": None}
-        return {"data": bytes(buf)}
+        off = req["offset"]
+        data = bytes(buf[off:off + req["length"]])
+        if req.get("last"):
+            self._release_transfer_handle(oid)
+        return {"data": data}
+
+    async def _fetch_remote_chunked(self, object_id: ObjectID,
+                                    addr: str) -> bool:
+        """Stream a remote object in pipelined chunks directly into a
+        pre-created local shm buffer; returns False when the holder no
+        longer has the object."""
+        holder = await self.clients.get(addr)
+        meta = await holder.call(
+            "fetch_object_meta", {"object_id": object_id.binary()},
+            timeout=60.0)
+        size = meta.get("size")
+        if size is None:
+            return False
+        buf = await self._create_with_spill_async(object_id, size)
+        chunk = self.config.object_transfer_chunk_bytes
+        sem = asyncio.Semaphore(self.config.object_transfer_parallelism)
+
+        offsets = list(range(0, size, chunk))
+        remaining = {"n": len(offsets)}
+
+        async def fetch_one(off: int):
+            async with sem:
+                remaining["n"] -= 1
+                reply = await holder.call("fetch_object_chunk", {
+                    "object_id": object_id.binary(),
+                    "offset": off,
+                    "length": min(chunk, size - off),
+                    # releases the holder's transfer lease with the
+                    # final chunk request
+                    "last": remaining["n"] == 0,
+                }, timeout=300.0)
+                data = reply.get("data")
+                if data is None:
+                    raise RuntimeError("holder dropped object mid-fetch")
+                buf[off:off + len(data)] = data
+
+        try:
+            await asyncio.gather(*[fetch_one(off) for off in offsets])
+        except BaseException:
+            try:
+                self.store.release(object_id)
+                self.store.delete(object_id)  # discard the partial write
+            except Exception:  # noqa: BLE001
+                pass
+            raise
+        self.store.seal(object_id)
+        self.store.release(object_id)
+        return True
+
+    # -- spilling / restore (reference: local_object_manager.h:41).
+    # All whole-object disk I/O runs in executor threads under
+    # _spill_lock: the raylet loop must keep heartbeating while
+    # multi-GB files move, or the GCS declares this node dead. --------
+
+    def _create_with_spill(self, object_id: ObjectID, size: int):
+        """Synchronous create-with-spill; call from an executor thread
+        (or via _create_with_spill_async from the loop)."""
+        from ray_tpu._private.object_store import ObjectStoreFullError
+
+        for _ in range(3):
+            try:
+                return self.store.create_buffer(object_id, size)
+            except ObjectStoreFullError:
+                if self._spill_up_to(size) == 0:
+                    raise
+        return self.store.create_buffer(object_id, size)
+
+    async def _create_with_spill_async(self, object_id: ObjectID,
+                                       size: int):
+        from ray_tpu._private.object_store import ObjectStoreFullError
+
+        try:
+            return self.store.create_buffer(object_id, size)
+        except ObjectStoreFullError:
+            pass
+        async with self._spill_lock:
+            return await asyncio.get_event_loop().run_in_executor(
+                None, self._create_with_spill, object_id, size)
+
+    def _put_raw_with_spill(self, object_id: ObjectID, data) -> None:
+        buf = self._create_with_spill(object_id, len(data))
+        buf[:] = data
+        self.store.seal(object_id)
+        self.store.release(object_id)
+
+    async def _put_raw_with_spill_async(self, object_id: ObjectID,
+                                        data) -> None:
+        from ray_tpu._private.object_store import ObjectStoreFullError
+
+        try:
+            self.store.put_raw(object_id, data)
+            return
+        except ObjectStoreFullError:
+            pass
+        async with self._spill_lock:
+            await asyncio.get_event_loop().run_in_executor(
+                None, self._put_raw_with_spill, object_id, data)
+
+    def _spill_up_to(self, needed: int) -> int:
+        """Write pinned primary copies to disk (oldest pin first) until
+        `needed` bytes of shm become reclaimable; dropping the pin buffer
+        makes the shm copy LRU-evictable while the disk file keeps the
+        object alive. Runs in executor threads — mutations use atomic
+        dict ops only."""
+        freed = 0
+        for oid, buf in list(self._pinned.items()):
+            if freed >= needed:
+                break
+            if oid not in self._spilled:
+                os.makedirs(self._spill_dir, exist_ok=True)
+                path = os.path.join(self._spill_dir, oid.hex())
+                with open(path, "wb") as f:
+                    f.write(buf)
+                self._spilled[oid] = (path, buf.nbytes)
+            freed += buf.nbytes
+            self._pinned.pop(oid, None)  # buffer release -> evictable
+        if freed:
+            logger.info("spilled %d bytes to %s", freed, self._spill_dir)
+        return freed
+
+    async def _restore_async(self, oid_bytes: bytes) -> bool:
+        if oid_bytes not in self._spilled:
+            return False
+        async with self._spill_lock:
+            return await asyncio.get_event_loop().run_in_executor(
+                None, self._restore_spilled, oid_bytes)
+
+    def _restore_spilled(self, oid_bytes: bytes) -> bool:
+        """Load a spilled object back into shm, reading straight into
+        the store buffer (no whole-object intermediate copy — the node
+        is memory-pressured by definition when this runs). The disk file
+        stays authoritative until the owner unpins."""
+        rec = self._spilled.get(oid_bytes)
+        if rec is None:
+            return False
+        path, size = rec
+        oid = ObjectID(oid_bytes)
+        if self.store.contains(oid):
+            return True
+        try:
+            with open(path, "rb") as f:
+                buf = self._create_with_spill(oid, size)
+                f.readinto(buf)
+        except OSError:
+            self._spilled.pop(oid_bytes, None)
+            return False
+        self.store.seal(oid)
+        self.store.release(oid)
+        return True
 
     # -- primary-copy pinning (reference: local_object_manager.h — the
     # raylet holding an owned object's primary copy keeps it unevictable
@@ -766,7 +983,14 @@ class Raylet:
         oid = ObjectID(req["object_id"])
         if req["object_id"] in self._pinned:
             return {"ok": True}
-        buf = self.store.get_buffer(oid, timeout=0)
+        if req["object_id"] in self._spilled:
+            return {"ok": True}  # the disk file is the pinned copy
+        # timeout=-1 is the NON-BLOCKING probe (0 means wait-forever and
+        # would wedge the raylet's event loop on an evicted object)
+        buf = self.store.get_buffer(oid, timeout=-1)
+        if buf is None:
+            if await self._restore_async(req["object_id"]):
+                buf = self.store.get_buffer(oid, timeout=-1)
         if buf is None:
             return {"ok": False, "error": "object not in store"}
         # holding the buffer holds the store refcount; LRU only evicts
@@ -775,8 +999,24 @@ class Raylet:
         return {"ok": True}
 
     async def rpc_unpin_object(self, req):
-        self._pinned.pop(req["object_id"], None)
+        oid = req["object_id"]
+        self._pinned.pop(oid, None)
+        rec = self._spilled.pop(oid, None)
+        if rec is not None:
+            try:
+                os.unlink(rec[0])
+            except OSError:
+                pass
         return {"ok": True}
+
+    async def rpc_spill_objects(self, req):
+        """A local worker's plasma create failed: make room by spilling
+        pinned primary copies to disk (reference: the raylet triggering
+        spill on CreateRequestQueue pressure)."""
+        async with self._spill_lock:
+            freed = await asyncio.get_event_loop().run_in_executor(
+                None, self._spill_up_to, req["needed"])
+        return {"freed": freed}
 
     async def rpc_get_store_stats(self, req):
         return self.store.stats()

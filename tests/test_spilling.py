@@ -1,0 +1,107 @@
+"""Chunked object transfer + disk spilling tests.
+
+Reference surface: `src/ray/object_manager/object_manager.h:117` +
+`object_buffer_pool.h` (chunked push/pull) and
+`src/ray/raylet/local_object_manager.h:41` (spill/restore).
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu._private.node import Cluster
+
+
+def test_chunked_cross_node_transfer():
+    """A multi-chunk object (size >> chunk size) transfers node-to-node
+    intact. Chunk size shrunk via env so a ~10MB object needs many
+    chunks — the scaled-down version of the >2GiB path, which the chunk
+    protocol handles identically (no whole-object frame ever built)."""
+    os.environ["RAY_TPU_OBJECT_TRANSFER_CHUNK_BYTES"] = str(1 << 20)
+    cluster = Cluster()
+    try:
+        cluster.add_node({"CPU": 2.0})
+        worker = cluster.add_node({"CPU": 2.0})
+        ray_tpu.init(address=cluster.gcs_addr)
+
+        aff = ray_tpu.NodeAffinitySchedulingStrategy(
+            worker.node_id_hex, soft=False)
+
+        @ray_tpu.remote(scheduling_strategy=aff)
+        def produce():
+            rng = np.random.default_rng(0)
+            return rng.integers(0, 255, 10_000_000, np.uint8)
+
+        ref = produce.remote()
+        out = ray_tpu.get(ref, timeout=120)
+        expect = np.random.default_rng(0).integers(0, 255, 10_000_000,
+                                                   np.uint8)
+        np.testing.assert_array_equal(out, expect)
+    finally:
+        os.environ.pop("RAY_TPU_OBJECT_TRANSFER_CHUNK_BYTES", None)
+        ray_tpu.shutdown()
+        cluster.shutdown()
+
+
+def test_put_beyond_capacity_spills_and_restores():
+    """Puts totalling ~2x the store capacity all succeed (pinned copies
+    spill to disk) and every value reads back correctly (restore)."""
+    ray_tpu.init(num_cpus=2, object_store_memory=32 * 1024 * 1024)
+    try:
+        refs = []
+        for i in range(8):  # 8 x 8MB = 64MB = 2x capacity
+            refs.append(ray_tpu.put(np.full(8_000_000, i, np.uint8)))
+            time.sleep(0.1)  # let pins land before the next put
+        for i, ref in enumerate(refs):
+            out = ray_tpu.get(ref, timeout=30)
+            assert out[0] == i and out.shape == (8_000_000,)
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_task_returns_beyond_capacity_spill():
+    """Worker-produced plasma returns also ride the spill path."""
+    ray_tpu.init(num_cpus=2, object_store_memory=32 * 1024 * 1024)
+    try:
+        @ray_tpu.remote
+        def produce(i):
+            return np.full(8_000_000, i, np.uint8)
+
+        refs = [produce.remote(i) for i in range(8)]
+        for i, ref in enumerate(refs):
+            out = ray_tpu.get(ref, timeout=60)
+            assert out[0] == i
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_unpin_removes_spill_files():
+    """Dropping the last ref to a spilled object deletes its disk file."""
+    cluster = Cluster(head_resources={"CPU": 2.0},
+                      object_store_memory=32 * 1024 * 1024)
+    try:
+        ray_tpu.init(address=cluster.gcs_addr)
+        refs = [ray_tpu.put(np.full(8_000_000, i, np.uint8))
+                for i in range(8)]
+        time.sleep(0.5)
+        spill_dirs = [
+            os.path.join(cluster.session_dir, d)
+            for d in os.listdir(cluster.session_dir) if d.startswith("spill-")
+        ]
+        spilled = sum(len(os.listdir(d)) for d in spill_dirs)
+        assert spilled > 0, "expected some objects to be spilled"
+        del refs
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            left = sum(len(os.listdir(d)) for d in spill_dirs
+                       if os.path.isdir(d))
+            if left == 0:
+                break
+            time.sleep(0.5)
+        assert left == 0, f"{left} spill files not reclaimed after unpin"
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
