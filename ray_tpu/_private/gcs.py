@@ -136,6 +136,20 @@ class GcsServer:
         if node is None or not node["alive"]:
             return {"ok": False, "reregister": True}
         node["available"] = req["available"]
+        node["pending_demands"] = req.get("pending_demands", [])
+        # idle tracking for autoscaler scale-down: a node is idle while
+        # its resources are fully free, nothing is queued, and no worker
+        # is bound to an actor or a running lease (live CPU actors hold
+        # no resources, so resource-freeness alone would mark their node
+        # reclaimable; warm idle-pool workers are excluded raylet-side)
+        busy = (bool(node["pending_demands"])
+                or req.get("busy_workers", 0) > 0
+                or any(node["available"].get(k, 0.0) < v
+                       for k, v in node["total"].items()))
+        if busy:
+            node.pop("idle_since", None)
+        else:
+            node.setdefault("idle_since", time.monotonic())
         self.view.update_node(node_id, node["raylet_addr"], node["total"],
                               req["available"])
         self._last_heartbeat[node_id] = time.monotonic()
@@ -159,6 +173,48 @@ class GcsServer:
 
     async def rpc_get_nodes(self, req):
         return list(self.nodes.values())
+
+    async def rpc_get_cluster_load(self, req):
+        """Aggregate demand/idleness snapshot for the autoscaler
+        (reference: GcsAutoscalerStateManager::HandleGetClusterResourceState,
+        autoscaler.proto)."""
+        now = time.monotonic()
+        nodes = []
+        for node in self.nodes.values():
+            if not node["alive"]:
+                continue
+            nodes.append({
+                "node_id": node["node_id"],
+                "total": node["total"],
+                "available": node["available"],
+                "labels": node.get("labels", {}),
+                "idle_duration_s": (now - node["idle_since"]
+                                    if "idle_since" in node else 0.0),
+            })
+        pending = []
+        for node in self.nodes.values():
+            if node["alive"]:
+                pending.extend(node.get("pending_demands", []))
+        # actors the GCS itself could not place yet
+        for actor_id in self._pending_actors:
+            if actor_id in self._scheduling_actors:
+                continue  # lease already dispatched to a raylet — its
+                # demand shows up there (or is being satisfied)
+            info = self.actors.get(actor_id)
+            if info is not None:
+                pending.append(
+                    task_mod.TaskSpec.from_wire(info["spec"]).resources)
+        pending_pgs = []
+        for pg_id in self._pending_pgs:
+            pg = self.placement_groups.get(pg_id)
+            if pg is not None and pg["state"] == "PENDING":
+                pending_pgs.append({
+                    "bundles": pg["bundles"],
+                    "strategy": pg["strategy"],
+                    "topology": pg.get("topology"),
+                })
+        return {"nodes": nodes, "pending": pending,
+                "pending_pgs": pending_pgs}
 
     async def _health_check_loop(self):
         # Reference: GcsHealthCheckManager — mark nodes dead after missed

@@ -60,6 +60,8 @@ class Lease:
     deps_ready: bool = False
     acquired: bool = False
     pg_key: Optional[tuple] = None
+    # already spilled here from another node — must not bounce again
+    no_respill: bool = False
 
 
 class Raylet:
@@ -204,6 +206,21 @@ class Raylet:
                     "node_id": self.node_id.binary(),
                     "available": self.available,
                     "idle_freed": self._freed_since_heartbeat,
+                    # unmet lease demand, for the autoscaler's
+                    # bin-packing (reference: ray_syncer resource-load
+                    # gossip feeding GcsAutoscalerStateManager).
+                    # Acquired leases hold local resources already —
+                    # reporting them too would double-count the demand.
+                    "pending_demands": [
+                        lease.resources
+                        for lease in self._pending[:64]
+                        if not lease.acquired
+                    ],
+                    # workers bound to actors or running leases (warm
+                    # idle-pool workers excluded) — live actors hold no
+                    # CPU resources, so idleness needs this signal
+                    "busy_workers": len(self._workers) - sum(
+                        len(p) for p in self._idle.values()),
                 }, timeout=5.0)
                 self._freed_since_heartbeat = False
                 if reply.get("reregister"):
@@ -222,8 +239,46 @@ class Raylet:
                 for node_id in list(self.view.nodes):
                     if node_id not in current:
                         self.view.remove_node(node_id)
+                self._respill_pending()
             except (ConnectionLost, RpcError, OSError, asyncio.TimeoutError):
                 pass
+
+    def _respill_pending(self):
+        """Hand queued leases that this node cannot currently satisfy to
+        nodes that can (reference: ClusterTaskManager re-running cluster
+        scheduling for queued work). This is what lets autoscaler-added
+        nodes drain a backlog that queued before they existed."""
+        for lease in list(self._pending):
+            spec = lease.spec
+            if spec.placement_group_id is not None:
+                continue  # PG leases are bundle-bound to this node
+            if lease.no_respill:
+                continue  # spilled here once already — no ping-pong
+            if lease.acquired:
+                # resources already held locally (waiting on a worker
+                # spawn): moving it now would leak the acquisition
+                continue
+            fits_local_now = all(
+                self.available.get(k, 0.0) >= v
+                for k, v in lease.resources.items() if v > 0)
+            if fits_local_now:
+                continue  # the normal dispatch path will take it
+            node = pick_node(
+                self.view, spec.resources, spec.strategy,
+                local_node_id=self.node_id.binary(),
+                target_node_id=spec.node_id,
+                soft=spec.soft,
+                spread_threshold=self.config.scheduler_spread_threshold,
+            )
+            if node is None or node.node_id == self.node_id.binary():
+                continue
+            self._pending.remove(lease)
+            self._leases.pop(lease.lease_id, None)
+            if not lease.reply_fut.done():
+                lease.reply_fut.set_result({
+                    "granted": False,
+                    "spillback_addr": node.raylet_addr,
+                })
 
     async def _reap_loop(self):
         """Detect dead worker processes (reference: WorkerPool monitors its
@@ -412,6 +467,7 @@ class Raylet:
             dedicated=dedicated,
             reply_fut=asyncio.get_event_loop().create_future(),
             resources=dict(spec.resources),
+            no_respill=bool(req.get("no_spillback")),
         )
         if spec.placement_group_id is not None:
             lease.pg_key = (spec.placement_group_id, spec.bundle_index)

@@ -1,0 +1,17 @@
+"""ray_tpu.autoscaler — demand-driven cluster scaling.
+
+Reference: `python/ray/autoscaler/` (v1 StandardAutoscaler + v2
+reconciler; SURVEY.md §2.8). Slice-aware: TPU pod slices scale up and
+down as atomic multi-host instances.
+"""
+
+from ray_tpu.autoscaler.autoscaler import Autoscaler
+from ray_tpu.autoscaler.node_provider import (
+    FakeMultiNodeProvider,
+    Instance,
+    NodeProvider,
+    NodeType,
+)
+
+__all__ = ["Autoscaler", "FakeMultiNodeProvider", "Instance",
+           "NodeProvider", "NodeType"]

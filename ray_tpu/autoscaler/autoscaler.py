@@ -1,0 +1,221 @@
+"""Load-based autoscaler: reconcile cluster size against pending demand.
+
+Reference: `python/ray/autoscaler/_private/autoscaler.py:172`
+(StandardAutoscaler.update) and the v2 redesign
+(`autoscaler/v2/instance_manager/reconciler.py`, bin-packing in
+`v2/scheduler.py:624` ResourceDemandScheduler): each round reads demand
+from the GCS (pending leases + pending placement groups), bin-packs the
+unmet part onto hypothetical nodes of the configured types, launches the
+difference through a NodeProvider, and retires provider-owned nodes that
+have sat idle past the timeout.
+
+TPU-first: a pending slice-topology placement group demands one whole
+slice instance (`NodeType.slice_type`), never loose hosts — keeping
+scale-up aligned with the scheduler's atomic gang placement.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ray_tpu._private.rpc import ClientPool
+from ray_tpu.autoscaler.node_provider import Instance, NodeProvider, NodeType
+
+logger = logging.getLogger(__name__)
+
+
+def _fits(avail: Dict[str, float], demand: Dict[str, float]) -> bool:
+    return all(avail.get(k, 0.0) >= v for k, v in demand.items() if v > 0)
+
+
+def _consume(avail: Dict[str, float], demand: Dict[str, float]) -> None:
+    for k, v in demand.items():
+        avail[k] = avail.get(k, 0.0) - v
+
+
+class Autoscaler:
+    def __init__(self, gcs_addr: str, provider: NodeProvider,
+                 node_types: List[NodeType],
+                 max_workers: int = 8,
+                 idle_timeout_s: float = 60.0,
+                 update_interval_s: float = 2.0):
+        self.gcs_addr = gcs_addr
+        self.provider = provider
+        self.node_types = {t.name: t for t in node_types}
+        self.max_workers = max_workers
+        self.idle_timeout_s = idle_timeout_s
+        self.update_interval_s = update_interval_s
+        self._clients = ClientPool()
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- one reconcile round (directly callable from tests) ------------
+
+    def update(self) -> Dict[str, int]:
+        """Run one reconcile round; returns {"launched": n, "terminated": m}."""
+        return asyncio.run(self._update_async())
+
+    async def _update_async(self) -> Dict[str, int]:
+        gcs = await self._clients.get(self.gcs_addr)
+        load = await gcs.call("get_cluster_load", {}, timeout=30.0)
+        launched = self._scale_up(load)
+        terminated = self._scale_down(load)
+        await self._clients.close_all()
+        return {"launched": launched, "terminated": terminated}
+
+    def _scale_up(self, load: dict) -> int:
+        # hypothetical free capacity: registered nodes' availability...
+        avail_pool = [dict(n["available"]) for n in load["nodes"]]
+        registered = {
+            n["node_id"].hex() if isinstance(n["node_id"], bytes)
+            else n["node_id"]
+            for n in load["nodes"]
+        }
+        instances = self.provider.non_terminated_nodes()
+        booting_slices: set = set()
+        for inst in instances:
+            ntype = self.node_types.get(inst.node_type)
+            if ntype is None:
+                continue
+            for nid in inst.node_ids:
+                if nid not in registered:
+                    # ...plus launched-but-still-booting capacity: a
+                    # slow-booting real node must absorb the demand that
+                    # caused its launch, or every round re-launches for
+                    # the same pending work
+                    avail_pool.append(dict(ntype.resources))
+                    if ntype.slice_type:
+                        booting_slices.add(ntype.slice_type)
+
+        demands: List[Dict[str, float]] = list(load["pending"])
+        slice_demands: List[str] = []
+        for pg in load["pending_pgs"]:
+            if pg.get("topology"):
+                slice_demands.append(pg["topology"])
+            else:
+                demands.extend(pg["bundles"])
+
+        # caps are counted in HOSTS, globally and per type
+        host_count = sum(len(i.node_ids) for i in instances)
+        type_counts: Dict[str, int] = {}
+        for inst in instances:
+            type_counts[inst.node_type] = \
+                type_counts.get(inst.node_type, 0) + 1
+        launched = 0
+
+        def may_launch(ntype: NodeType) -> bool:
+            return (host_count + ntype.num_hosts <= self.max_workers
+                    and type_counts.get(ntype.name, 0) <
+                    ntype.max_workers)
+
+        def record_launch(ntype: NodeType):
+            nonlocal host_count
+            host_count += ntype.num_hosts
+            type_counts[ntype.name] = type_counts.get(ntype.name, 0) + 1
+
+        # slice-topology PGs demand whole slice instances, atomically
+        for topology in slice_demands:
+            if topology in booting_slices:
+                booting_slices.discard(topology)
+                continue  # a slice for this demand is already booting
+            ntype = next(
+                (t for t in self.node_types.values()
+                 if t.slice_type == topology), None)
+            if ntype is None:
+                logger.warning("no node type provides slice %s", topology)
+                continue
+            if not may_launch(ntype):
+                continue
+            logger.info("scaling up: slice %s (%d hosts)", topology,
+                        ntype.num_hosts)
+            self.provider.create_node(ntype)
+            record_launch(ntype)
+            launched += ntype.num_hosts
+
+        # bin-pack loose demands largest-first (reference:
+        # ResourceDemandScheduler's utilization-based packing)
+        demands.sort(key=lambda d: -sum(d.values()))
+        planned: List[Dict[str, float]] = []
+        planned_types: List[NodeType] = []
+        for demand in demands:
+            placed = False
+            for avail in avail_pool + planned:
+                if _fits(avail, demand):
+                    _consume(avail, demand)
+                    placed = True
+                    break
+            if placed:
+                continue
+            ntype = self._smallest_fitting_type(demand)
+            if ntype is None:
+                logger.warning("demand %s fits no node type", demand)
+                continue
+            if not may_launch(ntype):
+                continue
+            fresh = dict(ntype.resources)
+            _consume(fresh, demand)
+            planned.append(fresh)
+            planned_types.append(ntype)
+            record_launch(ntype)
+        for ntype in planned_types:
+            logger.info("scaling up: %s %s", ntype.name, ntype.resources)
+            self.provider.create_node(ntype)
+            launched += 1
+        return launched
+
+    def _smallest_fitting_type(self, demand: Dict[str, float]
+                               ) -> Optional[NodeType]:
+        fitting = [
+            t for t in self.node_types.values()
+            if t.slice_type is None and _fits(dict(t.resources), demand)
+        ]
+        if not fitting:
+            return None
+        return min(fitting, key=lambda t: sum(t.resources.values()))
+
+    def _scale_down(self, load: dict) -> int:
+        # any pending work keeps every node: the next round may pack it
+        # onto a currently-idle node
+        if load["pending"] or load["pending_pgs"]:
+            return 0
+        idle_ids = {
+            n["node_id"].hex() if isinstance(n["node_id"], bytes)
+            else n["node_id"]
+            for n in load["nodes"]
+            if n["idle_duration_s"] >= self.idle_timeout_s
+        }
+        terminated = 0
+        for inst in list(self.provider.non_terminated_nodes()):
+            # slices retire atomically: only when EVERY host is idle
+            if all(nid in idle_ids for nid in inst.node_ids):
+                logger.info("scaling down idle instance %s",
+                            inst.instance_id)
+                self.provider.terminate_node(inst)
+                terminated += len(inst.node_ids)
+        return terminated
+
+    # -- background loop ----------------------------------------------
+
+    def start(self):
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="autoscaler")
+        self._thread.start()
+        return self
+
+    def _run(self):
+        while not self._stop.is_set():
+            try:
+                self.update()
+            except Exception:  # noqa: BLE001
+                logger.exception("autoscaler round failed")
+            self._stop.wait(self.update_interval_s)
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)

@@ -1,0 +1,134 @@
+"""Autoscaler tests: scale up on unmet demand, down on idleness.
+
+Reference ground: `python/ray/tests/test_autoscaler_fake_multinode.py`
+and the v2 reconciler tests — fake "cloud" nodes are local raylets.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu._private.node import Cluster
+from ray_tpu.autoscaler import Autoscaler, FakeMultiNodeProvider, NodeType
+
+
+@pytest.fixture
+def scaling_cluster():
+    cluster = Cluster(head_resources={"CPU": 1.0})
+    ray_tpu.init(address=cluster.gcs_addr)
+    provider = FakeMultiNodeProvider(cluster)
+    yield cluster, provider
+    ray_tpu.shutdown()
+    cluster.shutdown()
+
+
+def _drain_heartbeat(seconds=1.5):
+    """Give raylets a couple heartbeats to report demand/idleness."""
+    time.sleep(seconds)
+
+
+def test_scale_up_for_infeasible_pg(scaling_cluster):
+    cluster, provider = scaling_cluster
+    autoscaler = Autoscaler(
+        cluster.gcs_addr, provider,
+        [NodeType("cpu4", {"CPU": 4.0})],
+        max_workers=4, idle_timeout_s=9999)
+
+    pg = ray_tpu.placement_group([{"CPU": 4.0}], strategy="PACK")
+    assert not pg.ready(timeout=2.0)  # infeasible on the 1-CPU head
+
+    _drain_heartbeat()
+    result = autoscaler.update()
+    assert result["launched"] == 1
+    assert pg.ready(timeout=30.0), "PG still pending after scale-up"
+    ray_tpu.remove_placement_group(pg)
+
+
+def test_scale_up_for_pending_tasks(scaling_cluster):
+    cluster, provider = scaling_cluster
+    autoscaler = Autoscaler(
+        cluster.gcs_addr, provider,
+        [NodeType("cpu2", {"CPU": 2.0}), NodeType("cpu8", {"CPU": 8.0})],
+        max_workers=4, idle_timeout_s=9999)
+
+    @ray_tpu.remote(num_cpus=2)
+    def work(i):
+        return i * 2
+
+    refs = [work.remote(i) for i in range(3)]
+    _drain_heartbeat()
+    autoscaler.update()
+    # picks the smallest fitting type for {"CPU": 2} demands
+    types = {i.node_type for i in provider.non_terminated_nodes()}
+    assert types == {"cpu2"}
+    assert sorted(ray_tpu.get(refs, timeout=60)) == [0, 2, 4]
+
+
+def test_scale_up_slice_for_topology_pg(scaling_cluster):
+    """A pending slice-topology PG provisions one whole slice instance
+    (atomic multi-host scale-up), after which it gang-places."""
+    cluster, provider = scaling_cluster
+    autoscaler = Autoscaler(
+        cluster.gcs_addr, provider,
+        [NodeType("v2-8", {"CPU": 2.0, "TPU": 4.0},
+                  slice_type="v2-8", num_hosts=2)],
+        max_workers=8, idle_timeout_s=9999)
+
+    pg = ray_tpu.placement_group(
+        [{"CPU": 1.0, "TPU": 4.0}] * 2, topology="v2-8")
+    assert not pg.ready(timeout=2.0)
+
+    _drain_heartbeat()
+    result = autoscaler.update()
+    assert result["launched"] == 2  # both hosts of one slice
+    assert pg.ready(timeout=30.0)
+    ray_tpu.remove_placement_group(pg)
+
+
+def test_scale_down_idle_nodes(scaling_cluster):
+    cluster, provider = scaling_cluster
+    autoscaler = Autoscaler(
+        cluster.gcs_addr, provider,
+        [NodeType("cpu4", {"CPU": 4.0})],
+        max_workers=4, idle_timeout_s=2.0)
+
+    @ray_tpu.remote(num_cpus=4)
+    def burst():
+        return "done"
+
+    ref = burst.remote()
+    _drain_heartbeat()
+    autoscaler.update()
+    assert len(provider.non_terminated_nodes()) == 1
+    assert ray_tpu.get(ref, timeout=60) == "done"
+
+    # wait past the idle timeout, then reconcile until retired
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        time.sleep(1.0)
+        result = autoscaler.update()
+        if result["terminated"] and not provider.non_terminated_nodes():
+            break
+    assert not provider.non_terminated_nodes(), "idle node never retired"
+
+
+def test_max_workers_cap(scaling_cluster):
+    cluster, provider = scaling_cluster
+    autoscaler = Autoscaler(
+        cluster.gcs_addr, provider,
+        [NodeType("cpu2", {"CPU": 2.0})],
+        max_workers=2, idle_timeout_s=9999)
+
+    @ray_tpu.remote(num_cpus=2)
+    def work(i):
+        time.sleep(0.2)
+        return i
+
+    refs = [work.remote(i) for i in range(8)]  # demand for 8 nodes
+    _drain_heartbeat()
+    autoscaler.update()
+    autoscaler.update()
+    assert len(provider.non_terminated_nodes()) <= 2
+    assert sorted(ray_tpu.get(refs, timeout=120)) == list(range(8))
