@@ -232,6 +232,10 @@ class CoreWorker:
         # the raylet holding an owned object's primary copy keeps it
         # unevictable until the owner's refcount drops to zero).
         self._pinned_at: Dict[bytes, str] = {}
+        # Task-event buffer (reference: TaskEventBuffer,
+        # task_event_buffer.h — batched, periodically flushed to the
+        # GCS task table for `list tasks` observability).
+        self._task_events: List[dict] = []
 
         # Executor state (worker mode).
         self._exec_queue: queue_mod.Queue = queue_mod.Queue()
@@ -264,6 +268,32 @@ class CoreWorker:
         self.gcs = await self._clients.get(self.gcs_addr)
         await self.gcs.call("subscribe",
                             {"channel": "actors", "addr": self._server.address})
+        asyncio.ensure_future(self._event_flush_loop())
+
+    def _emit_task_event(self, task_id: bytes, name: str,
+                         task_type: str, state: str):
+        self._task_events.append({
+            "task_id": task_id, "name": name, "type": task_type,
+            "state": state, "ts": time.time(),
+        })
+
+    async def _event_flush_loop(self):
+        """Ship buffered task events to the GCS task table ~1/s
+        (reference: TaskEventBuffer's periodic flush; fire-and-forget so
+        observability never sits on the task path)."""
+        while not self._shutdown:
+            await asyncio.sleep(1.0)
+            # drain the WHOLE buffer each tick (in bounded frames) — a
+            # fixed drain rate below the emit rate would grow the buffer
+            # without bound
+            while self._task_events:
+                batch, self._task_events = self._task_events[:512], \
+                    self._task_events[512:]
+                try:
+                    await self.gcs.notify("add_task_events",
+                                          {"events": batch})
+                except (ConnectionLost, RpcError, OSError):
+                    break
 
     def shutdown(self):
         if self._shutdown:
@@ -277,6 +307,15 @@ class CoreWorker:
         set_core_worker(None)
 
     async def _stop_async(self):
+        if self._task_events:
+            # a short-lived driver exits before the periodic flush —
+            # ship the tail so its tasks appear in `list tasks`
+            batch, self._task_events = self._task_events, []
+            try:
+                await self.gcs.notify("add_task_events",
+                                      {"events": batch})
+            except (ConnectionLost, RpcError, OSError):
+                pass
         await self._clients.close_all()
         await self._server.stop()
 
@@ -530,6 +569,10 @@ class CoreWorker:
     async def _pin_local_async(self, oid: bytes):
         raylet = await self._clients.get(self.raylet_addr)
         await raylet.call("pin_object", {"object_id": oid}, timeout=30.0)
+
+    async def _list_objects_on(self, raylet_addr: str):
+        raylet = await self._clients.get(raylet_addr)
+        return await raylet.call("list_objects", {}, timeout=30.0)
 
     async def _request_spill(self, size: int) -> int:
         try:
@@ -980,6 +1023,8 @@ class CoreWorker:
         st["new_item"].set()
 
     def _enqueue_task(self, spec: task_mod.TaskSpec):
+        self._emit_task_event(spec.task_id, spec.name, spec.task_type,
+                              "SUBMITTED")
         key = spec.scheduling_key()
         state = self._key_states.get(key)
         if state is None:
@@ -1123,6 +1168,9 @@ class CoreWorker:
                 pass
 
     def _process_task_reply(self, spec: task_mod.TaskSpec, reply: dict):
+        self._emit_task_event(
+            spec.task_id, spec.name, spec.task_type,
+            "FAILED" if reply.get("error") else "FINISHED")
         mem = self.memory_store
         plasma_oids: List[bytes] = []
         for entry in reply.get("returns", []):
@@ -1165,6 +1213,8 @@ class CoreWorker:
             self._finish_stream(spec.task_id, err)
 
     def _store_task_error(self, spec: task_mod.TaskSpec, err: Exception):
+        self._emit_task_event(spec.task_id, spec.name, spec.task_type,
+                              "FAILED")
         fut = self._reconstructing.pop(spec.task_id, None)
         if fut is not None and not fut.done():
             fut.set_result(False)
@@ -1285,6 +1335,8 @@ class CoreWorker:
         return st
 
     def _actor_enqueue(self, spec: task_mod.TaskSpec):
+        self._emit_task_event(spec.task_id, spec.name, spec.task_type,
+                              "SUBMITTED")
         st = self._actor_state(spec.actor_id)
         # Fast path: actor resolved, connection live, nothing queued — assign
         # the sequence number and write the frame right now, skipping the

@@ -17,6 +17,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import time
+from collections import OrderedDict
 from typing import Any, Dict, List, Optional
 
 from ray_tpu._private import task as task_mod
@@ -53,6 +54,7 @@ class GcsServer:
         self.actors: Dict[bytes, dict] = {}
         self.named_actors: Dict[str, bytes] = {}
         self.placement_groups: Dict[bytes, dict] = {}
+        self.task_events: "OrderedDict[bytes, dict]" = OrderedDict()
         self.subscribers: Dict[str, List[str]] = {}
         self._last_heartbeat: Dict[bytes, float] = {}
         self._pending_actors: List[bytes] = []
@@ -65,19 +67,45 @@ class GcsServer:
     # lifecycle
     # ------------------------------------------------------------------
 
-    async def start(self):
+    def _metrics_text(self) -> str:
+        states: Dict[str, int] = {}
+        for a in self.actors.values():
+            states[a["state"]] = states.get(a["state"], 0) + 1
+        lines = [
+            "# TYPE gcs_nodes_alive gauge",
+            f"gcs_nodes_alive "
+            f"{sum(1 for n in self.nodes.values() if n['alive'])}",
+            f"gcs_placement_groups_pending {len(self._pending_pgs)}",
+            f"gcs_task_events {len(self.task_events)}",
+        ]
+        for state, count in states.items():
+            lines.append(f'gcs_actors{{state="{state}"}} {count}')
+        return "\n".join(lines) + "\n"
+
+    async def start(self, metrics_port: int | None = None):
         self.server.register_all(self)
         await self.server.start()
         self._bg_tasks = [
             asyncio.ensure_future(self._health_check_loop()),
             asyncio.ensure_future(self._retry_loop()),
         ]
+        if metrics_port is not None:
+            from ray_tpu.util.metrics import serve_metrics
+
+            self._metrics_server, port = await serve_metrics(
+                port=metrics_port, extra_text=self._metrics_text)
+            logger.info("metrics on :%d/metrics", port)
+            self.metrics_port = port
         logger.info("GCS listening on %s", self.server.address)
         return self
+
+    _metrics_server = None
 
     async def stop(self):
         for t in self._bg_tasks:
             t.cancel()
+        if self._metrics_server is not None:
+            self._metrics_server.close()
         await self.clients.close_all()
         await self.server.stop()
 
@@ -173,6 +201,46 @@ class GcsServer:
 
     async def rpc_get_nodes(self, req):
         return list(self.nodes.values())
+
+    # ------------------------------------------------------------------
+    # task events (reference: GcsTaskManager, gcs_task_manager.h — the
+    # bounded task table backing `ray list tasks` / `ray summary`)
+    # ------------------------------------------------------------------
+
+    _TASK_EVENTS_CAP = 10_000
+
+    async def rpc_add_task_events(self, req):
+        for ev in req["events"]:
+            task_id = ev["task_id"]
+            rec = self.task_events.get(task_id)
+            if rec is None:
+                rec = self.task_events[task_id] = {
+                    "task_id": task_id,
+                    "name": ev.get("name", ""),
+                    "type": ev.get("type", ""),
+                    "state": "",
+                    "events": [],
+                }
+                while len(self.task_events) > self._TASK_EVENTS_CAP:
+                    self.task_events.popitem(last=False)
+            rec["state"] = ev["state"]
+            rec["events"].append((ev["state"], ev["ts"]))
+        return None  # notify-only path
+
+    async def rpc_list_task_events(self, req):
+        limit = req.get("limit", 1000)
+        name = req.get("name")
+        state = req.get("state")
+        out = []
+        for rec in reversed(self.task_events.values()):
+            if name and rec["name"] != name:
+                continue
+            if state and rec["state"] != state:
+                continue
+            out.append(rec)
+            if len(out) >= limit:
+                break
+        return out
 
     async def rpc_get_cluster_load(self, req):
         """Aggregate demand/idleness snapshot for the autoscaler
@@ -631,12 +699,13 @@ class GcsServer:
                 self._pending_pgs = still_pgs
 
 
-async def main(host: str, port: int):
+async def main(host: str, port: int, metrics_port=None,
+               daemonize: bool = False):
     import os
     import signal
 
     server = GcsServer(host, port)
-    await server.start()
+    await server.start(metrics_port=metrics_port)
     print(f"GCS_READY {server.address}", flush=True)
     stop = asyncio.Event()
     loop = asyncio.get_running_loop()
@@ -650,7 +719,8 @@ async def main(host: str, port: int):
             await asyncio.sleep(1.0)
         stop.set()
 
-    asyncio.ensure_future(parent_watch())
+    if not daemonize:
+        asyncio.ensure_future(parent_watch())
     await stop.wait()
     await server.stop()
 
@@ -661,8 +731,12 @@ if __name__ == "__main__":
     parser = argparse.ArgumentParser()
     parser.add_argument("--host", default="127.0.0.1")
     parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--metrics-port", type=int, default=None)
     parser.add_argument("--log-file", default=None)
+    parser.add_argument("--daemonize", action="store_true",
+                        help="survive the launching process (CLI mode)")
     args = parser.parse_args()
     if args.log_file:
         logging.basicConfig(filename=args.log_file, level=logging.INFO)
-    asyncio.run(main(args.host, args.port))
+    asyncio.run(main(args.host, args.port, args.metrics_port,
+                     daemonize=args.daemonize))

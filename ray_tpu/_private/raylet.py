@@ -151,9 +151,33 @@ class Raylet:
 
     # ------------------------------------------------------------------
 
-    async def start(self):
+    def _metrics_text(self) -> str:
+        stats = self.store.stats()
+        lines = [
+            "# TYPE raylet_pending_leases gauge",
+            f"raylet_pending_leases {len(self._pending)}",
+            f"raylet_workers {len(self._workers)}",
+            f"raylet_pinned_objects {len(self._pinned)}",
+            f"raylet_spilled_objects {len(self._spilled)}",
+            f"object_store_capacity_bytes {stats['capacity']}",
+            f"object_store_allocated_bytes {stats['allocated']}",
+            f"object_store_num_objects {stats['num_objects']}",
+        ]
+        for k, v in self.available.items():
+            lines.append(
+                f'raylet_resource_available{{resource="{k}"}} {v}')
+        return "\n".join(lines) + "\n"
+
+    async def start(self, metrics_port: int | None = None):
         self.server.register_all(self)
         await self.server.start()
+        if metrics_port is not None:
+            from ray_tpu.util.metrics import serve_metrics
+
+            self._metrics_server, port = await serve_metrics(
+                port=metrics_port, extra_text=self._metrics_text)
+            logger.info("metrics on :%d/metrics", port)
+            self.metrics_port = port
         self.gcs = await self.clients.get(self.gcs_addr)
         await self.gcs.call("register_node", {
             "node_id": self.node_id.binary(),
@@ -174,9 +198,13 @@ class Raylet:
         logger.info("raylet %s on %s", self.node_id.hex()[:8], self.server.address)
         return self
 
+    _metrics_server = None
+
     async def stop(self):
         for t in self._bg:
             t.cancel()
+        if self._metrics_server is not None:
+            self._metrics_server.close()
         for w in self._workers.values():
             if w.proc and w.proc.returncode is None:
                 try:
@@ -1077,6 +1105,18 @@ class Raylet:
     async def rpc_get_store_stats(self, req):
         return self.store.stats()
 
+    async def rpc_list_objects(self, req):
+        """Primary copies this raylet is responsible for: pinned (shm)
+        and spilled (disk) objects, for the state API."""
+        out = []
+        for oid, buf in self._pinned.items():
+            out.append({"object_id": oid.hex(), "where": "shm",
+                        "size": buf.nbytes})
+        for oid, (path, size) in self._spilled.items():
+            out.append({"object_id": oid.hex(), "where": "spilled",
+                        "size": size, "path": path})
+        return out
+
     async def rpc_node_info(self, req):
         return {
             "node_id": self.node_id.binary(),
@@ -1099,7 +1139,7 @@ async def main(args):
         session_dir=args.session_dir,
         labels=json.loads(args.labels) if args.labels else None,
     )
-    await raylet.start()
+    await raylet.start(metrics_port=args.metrics_port)
     print(f"RAYLET_READY {raylet.address} {raylet.store_name} "
           f"{raylet.node_id.hex()}", flush=True)
     import signal
@@ -1119,7 +1159,8 @@ async def main(args):
             await asyncio.sleep(1.0)
         stop.set()
 
-    asyncio.ensure_future(parent_watch())
+    if not getattr(args, 'daemonize', False):
+        asyncio.ensure_future(parent_watch())
     await stop.wait()
     # Graceful teardown: kill worker children, unlink the shm arena.
     await raylet.stop()
@@ -1138,7 +1179,11 @@ if __name__ == "__main__":
     parser.add_argument("--session-dir", default="/tmp/ray_tpu")
     parser.add_argument("--labels", default=None,
                         help="JSON node labels (slice membership)")
+    parser.add_argument("--metrics-port", type=int, default=None,
+                        help="serve Prometheus /metrics on this port")
     parser.add_argument("--log-file", default=None)
+    parser.add_argument("--daemonize", action="store_true",
+                        help="survive the launching process (CLI mode)")
     args = parser.parse_args()
     if args.log_file:
         logging.basicConfig(filename=args.log_file, level=logging.INFO)

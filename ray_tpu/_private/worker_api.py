@@ -10,6 +10,7 @@ from __future__ import annotations
 import atexit
 import functools
 import inspect
+import os
 import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence
@@ -74,6 +75,10 @@ def init(
         if _system_config:
             cfg.update(_system_config)
 
+        if address is None:
+            # CLI-submitted drivers find their cluster through the env
+            # (reference: RAY_ADDRESS)
+            address = os.environ.get("RAY_TPU_ADDRESS") or None
         if address is None:
             node_resources = dict(resources or {})
             import os as _os

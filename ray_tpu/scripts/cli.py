@@ -1,0 +1,268 @@
+"""Operator CLI: form real multi-machine clusters and inspect them.
+
+Reference: `python/ray/scripts/scripts.py` (`ray start/stop/status/...`)
+and the state-API CLI (`ray list tasks/actors/objects`). Invoked as
+`python -m ray_tpu <command>`.
+
+A head start spawns the GCS + a raylet detached (surviving this CLI);
+worker machines join with `start --address`. Daemon pids land in a
+state file under the session dir so `stop` can tear the node down.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+_STATE_FILE = "/tmp/ray_tpu/cli_node.json"
+
+
+def _daemon_env() -> dict:
+    """Daemon env hygiene, matching node._spawn: daemons never touch
+    accelerators (JAX_PLATFORMS=cpu), but the original platform is
+    preserved so raylets can hand it to TPU workers."""
+    env = dict(os.environ)
+    if "JAX_PLATFORMS" in env and \
+            "RAY_TPU_WORKER_JAX_PLATFORMS" not in env:
+        env["RAY_TPU_WORKER_JAX_PLATFORMS"] = env["JAX_PLATFORMS"]
+    env["JAX_PLATFORMS"] = "cpu"
+    repo_root = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    env.setdefault("PYTHONPATH", repo_root)
+    return env
+
+
+def _spawn_daemon(args, log_path: str, ready_prefix: str) -> tuple:
+    """Detached daemon spawn; returns (pid, ready_line)."""
+    logfile = open(log_path, "ab")
+    proc = subprocess.Popen(
+        args, stdout=subprocess.PIPE, stderr=logfile,
+        start_new_session=True, env=_daemon_env(),
+    )
+    # non-blocking ready wait: a wedged daemon that never prints (and
+    # never exits) must not hang the CLI past the deadline
+    os.set_blocking(proc.stdout.fileno(), False)
+    deadline = time.monotonic() + 60
+    buf = b""
+    while time.monotonic() < deadline:
+        chunk = proc.stdout.read()
+        if chunk:
+            buf += chunk
+            for line in buf.decode(errors="replace").splitlines():
+                if line.startswith(ready_prefix):
+                    return proc.pid, line.strip()
+        if proc.poll() is not None:
+            raise SystemExit(f"daemon died on startup; see {log_path}")
+        time.sleep(0.05)
+    proc.terminate()
+    raise SystemExit("daemon not ready within 60s")
+
+
+def _save_state(state: dict):
+    os.makedirs(os.path.dirname(_STATE_FILE), exist_ok=True)
+    with open(_STATE_FILE, "w") as f:
+        json.dump(state, f)
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+        return True
+    except (ProcessLookupError, PermissionError):
+        return False
+
+
+def _load_state() -> dict | None:
+    try:
+        with open(_STATE_FILE) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def cmd_start(args):
+    prior = _load_state()
+    if prior:
+        # refuse to orphan a tracked node: overwriting the state file
+        # would leave the previous daemons (no parent watch) running
+        # with no way to stop them
+        alive = [p for p in prior["pids"] if _pid_alive(p)]
+        if alive:
+            raise SystemExit(
+                f"node already running (pids {alive}); "
+                "run `ray_tpu stop` first")
+    session = f"/tmp/ray_tpu/cli_{int(time.time())}"
+    os.makedirs(os.path.join(session, "logs"), exist_ok=True)
+    pids = []
+    if args.head:
+        gcs_args = [sys.executable, "-m", "ray_tpu._private.gcs",
+                    "--host", args.host, "--port", str(args.port),
+                    "--daemonize",
+                    "--log-file", f"{session}/logs/gcs.log"]
+        if args.metrics_port:
+            gcs_args += ["--metrics-port", str(args.metrics_port)]
+        pid, ready = _spawn_daemon(gcs_args, f"{session}/logs/gcs.out",
+                                   "GCS_READY")
+        gcs_addr = ready.split()[1]
+        pids.append(pid)
+        print(f"GCS started at {gcs_addr}")
+    else:
+        if not args.address:
+            raise SystemExit("--address required unless --head")
+        gcs_addr = args.address
+
+    raylet_args = [sys.executable, "-m", "ray_tpu._private.raylet",
+                   "--gcs-addr", gcs_addr,
+                   "--session-dir", session,
+                   "--daemonize",
+                   "--log-file", f"{session}/logs/raylet.log"]
+    if args.resources:
+        raylet_args += ["--resources", args.resources]
+    if args.object_store_memory:
+        raylet_args += ["--object-store-memory",
+                        str(args.object_store_memory)]
+    if args.metrics_port and not args.head:
+        raylet_args += ["--metrics-port", str(args.metrics_port)]
+    pid, ready = _spawn_daemon(raylet_args, f"{session}/logs/raylet.out",
+                               "RAYLET_READY")
+    pids.append(pid)
+    print(f"raylet started: {ready.split()[1]}")
+    _save_state({"gcs_addr": gcs_addr, "pids": pids, "session": session})
+    print(f"\nTo connect: ray_tpu.init(address={gcs_addr!r})")
+    print(f"Or: export RAY_TPU_ADDRESS={gcs_addr}")
+
+
+def cmd_stop(args):
+    state = _load_state()
+    if state is None:
+        print("no tracked node on this machine")
+        return
+    import signal
+
+    for pid in state["pids"]:
+        try:
+            os.kill(pid, signal.SIGTERM)
+            print(f"stopped pid {pid}")
+        except ProcessLookupError:
+            pass
+    try:
+        os.unlink(_STATE_FILE)
+    except OSError:
+        pass
+
+
+def _connect(args):
+    import ray_tpu
+
+    address = args.address or (_load_state() or {}).get("gcs_addr") \
+        or os.environ.get("RAY_TPU_ADDRESS")
+    if not address:
+        raise SystemExit("--address required (or run `start --head`)")
+    ray_tpu.init(address=address)
+    return ray_tpu
+
+
+def cmd_status(args):
+    ray_tpu = _connect(args)
+    try:
+        nodes = ray_tpu.nodes()
+        print(f"{len([n for n in nodes if n['Alive']])} alive node(s)")
+        for n in nodes:
+            mark = "+" if n["Alive"] else "-"
+            print(f" {mark} {n['NodeID'][:12]} {n['RayletAddr']} "
+                  f"total={n['Resources']} avail={n['Available']}")
+        total = ray_tpu.cluster_resources()
+        avail = ray_tpu.available_resources()
+        print(f"resources: total={total} available={avail}")
+    finally:
+        ray_tpu.shutdown()
+
+
+def cmd_list(args):
+    ray_tpu = _connect(args)
+    from ray_tpu.util import state as state_api
+
+    try:
+        fn = {
+            "tasks": state_api.list_tasks,
+            "actors": state_api.list_actors,
+            "objects": state_api.list_objects,
+            "nodes": state_api.list_nodes,
+        }[args.entity]
+        for rec in fn():
+            print(json.dumps(rec, default=str))
+    finally:
+        ray_tpu.shutdown()
+
+
+def cmd_summary(args):
+    ray_tpu = _connect(args)
+    from ray_tpu.util import state as state_api
+
+    try:
+        for name, states in state_api.summarize_tasks().items():
+            print(f"{name}: " + ", ".join(
+                f"{s}={c}" for s, c in sorted(states.items())))
+    finally:
+        ray_tpu.shutdown()
+
+
+def cmd_submit(args):
+    address = args.address or (_load_state() or {}).get("gcs_addr") \
+        or os.environ.get("RAY_TPU_ADDRESS")
+    if not address:
+        raise SystemExit("--address required")
+    env = dict(os.environ)
+    env["RAY_TPU_ADDRESS"] = address
+    cmd = [sys.executable, args.script] + args.script_args
+    raise SystemExit(subprocess.call(cmd, env=env))
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="ray_tpu", description="ray_tpu cluster operator CLI")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p = sub.add_parser("start", help="start node daemons")
+    p.add_argument("--head", action="store_true")
+    p.add_argument("--address", help="GCS address to join (worker node)")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=6379)
+    p.add_argument("--resources", help="JSON resources override")
+    p.add_argument("--object-store-memory", type=int, default=0)
+    p.add_argument("--metrics-port", type=int, default=0)
+    p.set_defaults(fn=cmd_start)
+
+    p = sub.add_parser("stop", help="stop this machine's daemons")
+    p.set_defaults(fn=cmd_stop)
+
+    p = sub.add_parser("status", help="cluster nodes + resources")
+    p.add_argument("--address")
+    p.set_defaults(fn=cmd_status)
+
+    p = sub.add_parser("list", help="list cluster entities")
+    p.add_argument("entity",
+                   choices=["tasks", "actors", "objects", "nodes"])
+    p.add_argument("--address")
+    p.set_defaults(fn=cmd_list)
+
+    p = sub.add_parser("summary", help="task summary by name/state")
+    p.add_argument("--address")
+    p.set_defaults(fn=cmd_summary)
+
+    p = sub.add_parser("submit", help="run a driver script")
+    p.add_argument("--address")
+    p.add_argument("script")
+    p.add_argument("script_args", nargs="*")
+    p.set_defaults(fn=cmd_submit)
+
+    args = parser.parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

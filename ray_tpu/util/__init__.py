@@ -1,0 +1,16 @@
+"""ray_tpu.util — metrics, state API, and operator utilities.
+
+Reference: `python/ray/util/` (SURVEY.md §2.3).
+"""
+
+from ray_tpu.util.metrics import Counter, Gauge, Histogram
+from ray_tpu.util.state import (
+    list_actors,
+    list_nodes,
+    list_objects,
+    list_tasks,
+    summarize_tasks,
+)
+
+__all__ = ["Counter", "Gauge", "Histogram", "list_actors", "list_nodes",
+           "list_objects", "list_tasks", "summarize_tasks"]

@@ -1,0 +1,192 @@
+"""Metrics: Counter/Gauge/Histogram + Prometheus-text export.
+
+Reference: `python/ray/util/metrics.py:137,262,187` (the user-facing
+Cython-backed metric types) and `src/ray/stats/metric_defs.cc` (the
+OpenCensus registry exported through the metrics agent). Here one
+process-local registry backs both the user API and each daemon's
+`/metrics` HTTP endpoint (`serve_metrics`), so Prometheus scrapes
+daemons directly — no separate agent process.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+class _Registry:
+    def __init__(self):
+        self._metrics: List["Metric"] = []
+        self._lock = threading.Lock()
+
+    def register(self, metric: "Metric"):
+        with self._lock:
+            self._metrics.append(metric)
+
+    def prometheus_text(self) -> str:
+        lines: List[str] = []
+        with self._lock:
+            metrics = list(self._metrics)
+        for m in metrics:
+            lines.append(f"# HELP {m.name} {m.description}")
+            lines.append(f"# TYPE {m.name} {m.prom_type}")
+            lines.extend(m.samples())
+        return "\n".join(lines) + "\n"
+
+
+DEFAULT_REGISTRY = _Registry()
+
+
+def _label_str(keys: Sequence[str], values: Tuple) -> str:
+    if not keys:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in zip(keys, values))
+    return "{" + inner + "}"
+
+
+class Metric:
+    prom_type = "untyped"
+
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Sequence[str] = (),
+                 registry: Optional[_Registry] = None):
+        self.name = name
+        self.description = description
+        self.tag_keys = tuple(tag_keys)
+        self._values: Dict[Tuple, float] = {}
+        self._lock = threading.Lock()
+        (registry or DEFAULT_REGISTRY).register(self)
+
+    def _key(self, tags: Optional[Dict[str, str]]) -> Tuple:
+        tags = tags or {}
+        return tuple(str(tags.get(k, "")) for k in self.tag_keys)
+
+    def samples(self) -> List[str]:
+        with self._lock:
+            items = list(self._values.items())
+        return [
+            f"{self.name}{_label_str(self.tag_keys, key)} {value}"
+            for key, value in items
+        ]
+
+
+class Counter(Metric):
+    """Monotonic counter (reference `metrics.py:137`)."""
+
+    prom_type = "counter"
+
+    def inc(self, value: float = 1.0,
+            tags: Optional[Dict[str, str]] = None):
+        key = self._key(tags)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + value
+
+
+class Gauge(Metric):
+    """Point-in-time value (reference `metrics.py:262`)."""
+
+    prom_type = "gauge"
+
+    def set(self, value: float, tags: Optional[Dict[str, str]] = None):
+        with self._lock:
+            self._values[self._key(tags)] = float(value)
+
+
+class Histogram(Metric):
+    """Bucketed distribution (reference `metrics.py:187`)."""
+
+    prom_type = "histogram"
+
+    def __init__(self, name: str, description: str = "",
+                 boundaries: Sequence[float] = (0.01, 0.1, 1, 10),
+                 tag_keys: Sequence[str] = (),
+                 registry: Optional[_Registry] = None):
+        super().__init__(name, description, tag_keys, registry)
+        self.boundaries = sorted(boundaries)
+        self._buckets: Dict[Tuple, List[int]] = {}
+        self._sums: Dict[Tuple, float] = {}
+        self._counts: Dict[Tuple, int] = {}
+
+    def observe(self, value: float,
+                tags: Optional[Dict[str, str]] = None):
+        key = self._key(tags)
+        with self._lock:
+            buckets = self._buckets.setdefault(
+                key, [0] * (len(self.boundaries) + 1))
+            for i, bound in enumerate(self.boundaries):
+                if value <= bound:
+                    buckets[i] += 1
+                    break
+            else:
+                buckets[-1] += 1
+            self._sums[key] = self._sums.get(key, 0.0) + value
+            self._counts[key] = self._counts.get(key, 0) + 1
+
+    def samples(self) -> List[str]:
+        out: List[str] = []
+        with self._lock:
+            items = list(self._buckets.items())
+            sums = dict(self._sums)
+            counts = dict(self._counts)
+        for key, buckets in items:
+            cumulative = 0
+            for i, bound in enumerate(self.boundaries):
+                cumulative += buckets[i]
+                labels = dict(zip(self.tag_keys, key))
+                labels["le"] = str(bound)
+                keys = list(self.tag_keys) + ["le"]
+                vals = tuple(labels[k] for k in keys)
+                out.append(
+                    f"{self.name}_bucket{_label_str(keys, vals)} "
+                    f"{cumulative}")
+            keys = list(self.tag_keys) + ["le"]
+            vals = tuple(list(key) + ["+Inf"])
+            out.append(f"{self.name}_bucket{_label_str(keys, vals)} "
+                       f"{cumulative + buckets[-1]}")
+            out.append(f"{self.name}_sum{_label_str(self.tag_keys, key)} "
+                       f"{sums[key]}")
+            out.append(
+                f"{self.name}_count{_label_str(self.tag_keys, key)} "
+                f"{counts[key]}")
+        return out
+
+
+async def serve_metrics(host: str = "127.0.0.1", port: int = 0,
+                        registry: Optional[_Registry] = None,
+                        extra_text=None):
+    """Serve `GET /metrics` in Prometheus text format on a raw asyncio
+    server (daemons must not depend on aiohttp). Returns (server, port).
+    `extra_text`: zero-arg callable appending daemon-specific gauges
+    computed at scrape time."""
+    reg = registry or DEFAULT_REGISTRY
+
+    async def handle(reader, writer):
+        try:
+            # consume the request head; path irrelevant — everything is
+            # /metrics. Bounded: an idle connection must not pin a task
+            # forever.
+            while True:
+                line = await asyncio.wait_for(reader.readline(),
+                                              timeout=5.0)
+                if not line or line in (b"\r\n", b"\n"):
+                    break
+            body = reg.prometheus_text()
+            if extra_text is not None:
+                body += extra_text()
+            payload = body.encode()
+            writer.write(
+                b"HTTP/1.1 200 OK\r\n"
+                b"Content-Type: text/plain; version=0.0.4\r\n"
+                b"Content-Length: " + str(len(payload)).encode() +
+                b"\r\nConnection: close\r\n\r\n" + payload)
+            await writer.drain()
+        except (ConnectionResetError, BrokenPipeError,
+                asyncio.TimeoutError):
+            pass
+        finally:
+            writer.close()
+
+    server = await asyncio.start_server(handle, host, port)
+    actual_port = server.sockets[0].getsockname()[1]
+    return server, actual_port
