@@ -37,7 +37,13 @@ from ray_tpu._private.config import Config
 from ray_tpu._private.ids import ActorID, JobID, ObjectID, TaskID, WorkerID
 from ray_tpu._private.object_ref import ObjectRef, set_core_worker
 from ray_tpu._private.object_store import ObjectStore
-from ray_tpu._private.rpc import ClientPool, ConnectionLost, RpcError, RpcServer
+from ray_tpu._private.rpc import (
+    ClientPool,
+    ConnectionLost,
+    ReconnectingClient,
+    RpcError,
+    RpcServer,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -265,7 +271,8 @@ class CoreWorker:
         self.memory_store = _MemoryStore(self._loop)
         self._server.register_all(self)
         await self._server.start()
-        self.gcs = await self._clients.get(self.gcs_addr)
+        # reconnecting handle: survives a GCS restart (persistence FT)
+        self.gcs = ReconnectingClient(self._clients, self.gcs_addr)
         await self.gcs.call("subscribe",
                             {"channel": "actors", "addr": self._server.address})
         asyncio.ensure_future(self._event_flush_loop())
@@ -849,6 +856,7 @@ class CoreWorker:
         placement_group_id: bytes | None = None,
         bundle_index: int = -1,
         streaming: bool = False,
+        runtime_env: dict | None = None,
     ):
         task_id = TaskID.of(self.job_id, self.current_task_id,
                             next(self._task_counter))
@@ -876,6 +884,7 @@ class CoreWorker:
                 self.config.task_max_retries_default
                 if max_retries is None else max_retries),
             streaming=streaming,
+            runtime_env=runtime_env,
         )
         if streaming:
             # plain dict insert; ordered before the task via the same
@@ -1247,6 +1256,7 @@ class CoreWorker:
         soft: bool = False,
         placement_group_id: bytes | None = None,
         bundle_index: int = -1,
+        runtime_env: dict | None = None,
     ) -> ActorID:
         actor_id = ActorID.of(self.job_id, self.current_task_id,
                               next(self._task_counter))
@@ -1275,6 +1285,7 @@ class CoreWorker:
             bundle_index=bundle_index,
             detached=detached,
             actor_name=actor_name,
+            runtime_env=runtime_env,
         )
         reply = self._run_sync(
             self.gcs.call("register_actor", {"spec": spec.to_wire()})

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 import time
 from collections import OrderedDict
 from typing import Any, Dict, List, Optional
@@ -41,7 +42,8 @@ DEAD = "DEAD"
 
 class GcsServer:
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 config: Config | None = None):
+                 config: Config | None = None,
+                 persist_path: str | None = None):
         self.config = config or Config.from_env()
         self.server = RpcServer(host, port)
         self.clients = ClientPool()
@@ -62,6 +64,72 @@ class GcsServer:
         self._pending_pgs: List[bytes] = []
         self._bg_tasks: list = []
         self._retry_wakeup = asyncio.Event()
+        # Persistence (reference: RedisStoreClient-backed GCS tables,
+        # store_client/redis_store_client.h — here a snapshot file):
+        # tables survive a GCS restart; raylets reregister via the
+        # heartbeat reregister handshake, clients reconnect through
+        # their ReconnectingClient handles.
+        self.persist_path = persist_path
+        if persist_path:
+            self._load_snapshot()
+
+    _SNAPSHOT_TABLES = ("kv", "jobs", "actors", "named_actors",
+                        "placement_groups", "subscribers", "task_events")
+
+    def _load_snapshot(self):
+        import pickle
+
+        try:
+            with open(self.persist_path, "rb") as f:
+                data = pickle.load(f)
+        except FileNotFoundError:
+            return
+        except Exception:  # noqa: BLE001 — torn write: start fresh
+            logger.exception("snapshot unreadable; starting fresh")
+            return
+        for name in self._SNAPSHOT_TABLES:
+            if name in data:
+                setattr(self, name, data[name])
+        # resume interrupted placements: anything not terminal goes back
+        # on the pending queues
+        for actor_id, info in self.actors.items():
+            if info["state"] in (PENDING, RESTARTING):
+                self._pending_actors.append(actor_id)
+        for pg_id, pg in self.placement_groups.items():
+            if pg["state"] == "PENDING":
+                self._pending_pgs.append(pg_id)
+        logger.info(
+            "restored GCS state: %d actors, %d PGs, %d jobs, %d kv ns",
+            len(self.actors), len(self.placement_groups),
+            len(self.jobs), len(self.kv))
+
+    def _write_snapshot(self):
+        self._write_snapshot_bytes(self._serialize_snapshot())
+
+    def _serialize_snapshot(self) -> bytes:
+        """MUST run on the event-loop thread: pickling live tables while
+        handlers mutate them would see dicts change mid-iteration."""
+        import pickle
+
+        data = {name: getattr(self, name)
+                for name in self._SNAPSHOT_TABLES}
+        return pickle.dumps(data)
+
+    def _write_snapshot_bytes(self, blob: bytes):
+        tmp = self.persist_path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+        os.replace(tmp, self.persist_path)  # atomic swap
+
+    async def _snapshot_loop(self):
+        while True:
+            await asyncio.sleep(1.0)
+            try:
+                blob = self._serialize_snapshot()  # on-loop: consistent
+                await asyncio.get_event_loop().run_in_executor(
+                    None, self._write_snapshot_bytes, blob)
+            except Exception:  # noqa: BLE001
+                logger.exception("snapshot write failed")
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -89,6 +157,10 @@ class GcsServer:
             asyncio.ensure_future(self._health_check_loop()),
             asyncio.ensure_future(self._retry_loop()),
         ]
+        if self.persist_path:
+            self._bg_tasks.append(
+                asyncio.ensure_future(self._snapshot_loop()))
+            self._retry_wakeup.set()  # kick restored pending work
         if metrics_port is not None:
             from ray_tpu.util.metrics import serve_metrics
 
@@ -106,6 +178,11 @@ class GcsServer:
             t.cancel()
         if self._metrics_server is not None:
             self._metrics_server.close()
+        if self.persist_path:
+            try:
+                self._write_snapshot()
+            except Exception:  # noqa: BLE001
+                logger.exception("final snapshot failed")
         await self.clients.close_all()
         await self.server.stop()
 
@@ -125,15 +202,34 @@ class GcsServer:
         return {"ok": True}
 
     async def publish(self, channel: str, data: Any):
-        dead = []
-        for addr in self.subscribers.get(channel, []):
-            try:
-                client = await self.clients.get(addr)
-                await client.notify("pubsub", {"channel": channel, "data": data})
-            except (ConnectionLost, OSError, RpcError):
-                dead.append(addr)
-        for addr in dead:
-            self.subscribers[channel].remove(addr)
+        """Fan out concurrently with a short per-subscriber budget: a
+        dead subscriber (exited driver/worker) must cost ~2s once — not
+        a serial 10s connect-retry that stalls whichever RPC handler
+        happened to publish."""
+        subs = list(self.subscribers.get(channel, []))
+        if not subs:
+            return
+
+        async def send(addr: str):
+            client = await self.clients.get(addr)
+            await client.notify("pubsub",
+                                {"channel": channel, "data": data})
+
+        results = await asyncio.gather(
+            *[asyncio.wait_for(send(a), timeout=2.0) for a in subs],
+            return_exceptions=True)
+        for addr, result in zip(subs, results):
+            if isinstance(result, (ConnectionLost, OSError, RpcError)):
+                # connection-dead: unsubscribe (removal must be
+                # idempotent — concurrent publishes may both see it)
+                if addr in self.subscribers.get(channel, []):
+                    self.subscribers[channel].remove(addr)
+                self.clients.invalidate(addr)
+            elif isinstance(result, BaseException):
+                # transient (busy subscriber hit the 2s budget): skip
+                # this round but KEEP the subscription — dropping a live
+                # driver would silently starve it of actor updates
+                logger.debug("pubsub to %s timed out", addr)
 
     # ------------------------------------------------------------------
     # node membership + resource view (GcsNodeManager + ray_syncer)
@@ -700,11 +796,11 @@ class GcsServer:
 
 
 async def main(host: str, port: int, metrics_port=None,
-               daemonize: bool = False):
+               daemonize: bool = False, persist_path=None):
     import os
     import signal
 
-    server = GcsServer(host, port)
+    server = GcsServer(host, port, persist_path=persist_path)
     await server.start(metrics_port=metrics_port)
     print(f"GCS_READY {server.address}", flush=True)
     stop = asyncio.Event()
@@ -732,6 +828,8 @@ if __name__ == "__main__":
     parser.add_argument("--host", default="127.0.0.1")
     parser.add_argument("--port", type=int, default=0)
     parser.add_argument("--metrics-port", type=int, default=None)
+    parser.add_argument("--persist-path", default=None,
+                        help="snapshot file for GCS fault tolerance")
     parser.add_argument("--log-file", default=None)
     parser.add_argument("--daemonize", action="store_true",
                         help="survive the launching process (CLI mode)")
@@ -739,4 +837,5 @@ if __name__ == "__main__":
     if args.log_file:
         logging.basicConfig(filename=args.log_file, level=logging.INFO)
     asyncio.run(main(args.host, args.port, args.metrics_port,
-                     daemonize=args.daemonize))
+                     daemonize=args.daemonize,
+                     persist_path=args.persist_path))

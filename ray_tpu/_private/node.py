@@ -36,7 +36,12 @@ class ProcessHandle:
 
 
 def _spawn(args: List[str], log_path: str, ready_prefix: str,
-           timeout: float = 30.0, env: dict | None = None) -> ProcessHandle:
+           timeout: float = 30.0, env: dict | None = None,
+           detach: bool = False) -> ProcessHandle:
+    """Spawn a daemon and wait for its READY line. `detach` puts it in
+    its own session (CLI-started nodes that outlive the launcher). The
+    ready wait is non-blocking so a wedged daemon that never prints and
+    never exits still trips the deadline."""
     env = dict(env or os.environ)
     env.setdefault("PYTHONPATH", REPO_ROOT)
     # Daemons never touch accelerators; workers get chips explicitly. Keep
@@ -44,31 +49,30 @@ def _spawn(args: List[str], log_path: str, ready_prefix: str,
     if "JAX_PLATFORMS" in env and "RAY_TPU_WORKER_JAX_PLATFORMS" not in env:
         env["RAY_TPU_WORKER_JAX_PLATFORMS"] = env["JAX_PLATFORMS"]
     env["JAX_PLATFORMS"] = "cpu"
-    logfile = open(log_path, "wb")
+    logfile = open(log_path, "wb" if not detach else "ab")
     proc = subprocess.Popen(
         args, stdout=subprocess.PIPE, stderr=logfile, env=env,
-        cwd=REPO_ROOT,
+        cwd=REPO_ROOT, start_new_session=detach,
     )
     logfile.close()
+    os.set_blocking(proc.stdout.fileno(), False)
     deadline = time.monotonic() + timeout
-    ready_line = ""
+    buf = b""
     while time.monotonic() < deadline:
-        line = proc.stdout.readline().decode()
-        if not line:
-            if proc.poll() is not None:
-                raise RuntimeError(
-                    f"daemon exited: {args!r}; log: {log_path}: "
-                    + open(log_path, errors="replace").read()[-2000:]
-                )
-            time.sleep(0.02)
-            continue
-        if line.startswith(ready_prefix):
-            ready_line = line.strip()
-            break
-    if not ready_line:
-        proc.terminate()
-        raise RuntimeError(f"daemon not ready in {timeout}s: {args!r}")
-    return ProcessHandle(proc, ready_line, log_path)
+        chunk = proc.stdout.read()
+        if chunk:
+            buf += chunk
+            for line in buf.decode(errors="replace").splitlines():
+                if line.startswith(ready_prefix):
+                    return ProcessHandle(proc, line.strip(), log_path)
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"daemon exited: {args!r}; log: {log_path}: "
+                + open(log_path, errors="replace").read()[-2000:]
+            )
+        time.sleep(0.02)
+    proc.terminate()
+    raise RuntimeError(f"daemon not ready in {timeout}s: {args!r}")
 
 
 class NodeHandle:
@@ -93,11 +97,13 @@ class Cluster:
         head_resources: Dict[str, float] | None = None,
         object_store_memory: int | None = None,
         session_dir: str | None = None,
+        gcs_persistence: bool = False,
     ):
         ts = int(time.time() * 1000)
         self.session_dir = session_dir or f"/tmp/ray_tpu/session_{ts}_{os.getpid()}"
         os.makedirs(os.path.join(self.session_dir, "logs"), exist_ok=True)
         self.object_store_memory = object_store_memory
+        self.gcs_persistence = gcs_persistence
         self.gcs: Optional[ProcessHandle] = None
         self.nodes: List[NodeHandle] = []
         self._start_gcs()
@@ -107,14 +113,25 @@ class Cluster:
     def _log(self, name: str) -> str:
         return os.path.join(self.session_dir, "logs", name)
 
-    def _start_gcs(self):
-        self.gcs = _spawn(
-            [sys.executable, "-m", "ray_tpu._private.gcs",
-             "--log-file", self._log("gcs.log")],
-            self._log("gcs.out"),
-            "GCS_READY",
-        )
+    def _start_gcs(self, port: int = 0):
+        args = [sys.executable, "-m", "ray_tpu._private.gcs",
+                "--port", str(port),
+                "--log-file", self._log("gcs.log")]
+        if self.gcs_persistence:
+            args += ["--persist-path",
+                     os.path.join(self.session_dir, "gcs_state.pkl")]
+        self.gcs = _spawn(args, self._log("gcs.out"), "GCS_READY")
         self.gcs_addr = self.gcs.ready_line.split()[1]
+
+    def restart_gcs(self):
+        """Kill and respawn the GCS on the same address (fault-tolerance
+        testing; requires gcs_persistence so tables survive — reference:
+        test_gcs_fault_tolerance.py's restart_gcs_server)."""
+        if not self.gcs_persistence:
+            raise RuntimeError("restart_gcs requires gcs_persistence")
+        port = int(self.gcs_addr.rsplit(":", 1)[1])
+        self.gcs.terminate()
+        self._start_gcs(port=port)
 
     def add_node(self, resources: Dict[str, float],
                  object_store_memory: int | None = None,

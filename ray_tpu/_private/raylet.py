@@ -32,7 +32,13 @@ from ray_tpu._private import task as task_mod
 from ray_tpu._private.config import Config
 from ray_tpu._private.ids import NodeID, ObjectID
 from ray_tpu._private.object_store import ObjectStore
-from ray_tpu._private.rpc import ClientPool, ConnectionLost, RpcError, RpcServer
+from ray_tpu._private.rpc import (
+    ClientPool,
+    ConnectionLost,
+    ReconnectingClient,
+    RpcError,
+    RpcServer,
+)
 from ray_tpu._private.scheduling import ClusterView, pick_node
 
 logger = logging.getLogger(__name__)
@@ -47,6 +53,9 @@ class WorkerHandle:
     proc: Optional[asyncio.subprocess.Process] = None
     tpu_chips: tuple = ()
     alive: bool = True
+    # identity of the worker's materialized runtime env (reference:
+    # per-runtime-env worker pools, worker_pool.h:159)
+    env_hash: str = ""
 
 
 @dataclass
@@ -178,7 +187,8 @@ class Raylet:
                 port=metrics_port, extra_text=self._metrics_text)
             logger.info("metrics on :%d/metrics", port)
             self.metrics_port = port
-        self.gcs = await self.clients.get(self.gcs_addr)
+        # reconnecting handle: survives a GCS restart (persistence FT)
+        self.gcs = ReconnectingClient(self.clients, self.gcs_addr)
         await self.gcs.call("register_node", {
             "node_id": self.node_id.binary(),
             "raylet_addr": self.server.address,
@@ -364,12 +374,16 @@ class Raylet:
     # worker pool
     # ------------------------------------------------------------------
 
-    def _pool_key(self, job_id: bytes, tpu_chips: tuple) -> tuple:
-        return (job_id, tpu_chips)
+    def _pool_key(self, job_id: bytes, tpu_chips: tuple,
+                  env_hash: str = "") -> tuple:
+        return (job_id, tpu_chips, env_hash)
 
-    async def _spawn_worker(self, job_id: bytes, tpu_chips: tuple):
+    async def _spawn_worker(self, job_id: bytes, tpu_chips: tuple,
+                            runtime_env: dict | None = None):
         env = dict(os.environ)
         env["RAY_TPU_NODE_ID"] = self.node_id.hex()
+        if runtime_env and runtime_env.get("env_vars"):
+            env.update(runtime_env["env_vars"])
         if tpu_chips:
             env["TPU_VISIBLE_CHIPS"] = ",".join(str(c) for c in tpu_chips)
             env["TPU_CHIPS_PER_PROCESS_BOUNDS"] = "1,1,1"
@@ -398,6 +412,9 @@ class Raylet:
             "--node-id", self.node_id.hex(),
             "--job-id", job_id.hex(),
             "--tpu-chips", ",".join(str(c) for c in tpu_chips),
+            "--runtime-env",
+            json.dumps(runtime_env) if runtime_env else "",
+            "--session-dir", self.session_dir,
             env=env,
             stdout=logfile,
             stderr=logfile,
@@ -412,15 +429,19 @@ class Raylet:
             pid=req["pid"],
             job_id=req["job_id"],
             tpu_chips=tuple(req.get("tpu_chips", ())),
+            env_hash=req.get("runtime_env_hash", ""),
         )
         # Adopt the subprocess handle if we spawned it.
         if worker.tpu_chips:
-            key = self._pool_key(worker.job_id, ("tpu", len(worker.tpu_chips)))
+            key = self._pool_key(worker.job_id,
+                                 ("tpu", len(worker.tpu_chips)),
+                                 worker.env_hash)
         else:
-            key = self._pool_key(worker.job_id, ())
+            key = self._pool_key(worker.job_id, (), worker.env_hash)
         if self._starting.get(key):
             self._starting[key] -= 1
-        key = self._pool_key(worker.job_id, worker.tpu_chips)
+        key = self._pool_key(worker.job_id, worker.tpu_chips,
+                             worker.env_hash)
         self._workers[worker.worker_id] = worker
         self._idle.setdefault(key, []).append(worker)
         self._match_worker_procs(worker)
@@ -578,9 +599,11 @@ class Raylet:
         lease.acquired = False
         self._freed_since_heartbeat = True
 
-    def _find_idle_tpu_worker(self, job_id: bytes, n_chips: int):
+    def _find_idle_tpu_worker(self, job_id: bytes, n_chips: int,
+                              env_hash: str = ""):
         for key, pool in self._idle.items():
-            if key[0] == job_id and len(key[1]) == n_chips and pool:
+            if key[0] == job_id and len(key[1]) == n_chips \
+                    and key[2] == env_hash and pool:
                 return pool.pop()
         return None
 
@@ -604,21 +627,28 @@ class Raylet:
     def _dispatch(self):
         """Dispatch queue scan (reference: LocalTaskManager::
         ScheduleAndDispatchTasks)."""
-        # key -> number of leases that hold resources but lack a worker.
-        spawn_needed: Dict[tuple, int] = {}
+        from ray_tpu._private.runtime_env import env_hash as _env_hash
+
+        # key -> (shortfall count, runtime_env wire) for leases that hold
+        # resources but lack a worker.
+        spawn_needed: Dict[tuple, list] = {}
         for lease in list(self._pending):
             if not lease.deps_ready:
                 continue
             if not lease.acquired and not self._try_acquire(lease):
                 continue
+            renv = lease.spec.runtime_env
+            ehash = _env_hash(renv)
             n_chips = int(lease.resources.get("TPU", 0))
             if n_chips:
-                worker = self._find_idle_tpu_worker(lease.spec.job_id, n_chips)
+                worker = self._find_idle_tpu_worker(
+                    lease.spec.job_id, n_chips, ehash)
                 if worker is not None:
                     self._grant(lease, worker)
                     self._pending.remove(lease)
                     continue
-                key = self._pool_key(lease.spec.job_id, ("tpu", n_chips))
+                key = self._pool_key(lease.spec.job_id, ("tpu", n_chips),
+                                     ehash)
                 if self._starting.get(key, 0) > 0:
                     continue  # a matching worker is already starting
                 if len(self.unassigned_chips) >= n_chips:
@@ -629,34 +659,39 @@ class Raylet:
                     del self.unassigned_chips[:n_chips]
                     self._starting[key] = self._starting.get(key, 0) + 1
                     asyncio.ensure_future(self._spawn_and_track(
-                        (lease.spec.job_id, chips), starting_key=key))
+                        (lease.spec.job_id, chips, ehash),
+                        starting_key=key, runtime_env=renv))
                 else:
                     self._reclaim_idle_tpu_workers(n_chips)
                 continue
-            key = self._pool_key(lease.spec.job_id, ())
+            key = self._pool_key(lease.spec.job_id, (), ehash)
             idle = self._idle.get(key, [])
             if idle:
                 worker = idle.pop()
                 self._grant(lease, worker)
                 self._pending.remove(lease)
             else:
-                spawn_needed[key] = spawn_needed.get(key, 0) + 1
+                entry = spawn_needed.setdefault(key, [0, renv])
+                entry[0] += 1
         # Spawn exactly the shortfall: workers already starting count against
         # the need, and total in-flight spawns are capped. The shortfall is
         # bounded by acquired resources, so a request flood cannot fork more
         # workers than the node has capacity for.
-        for key, needed in spawn_needed.items():
+        for key, (needed, renv) in spawn_needed.items():
             starting = self._starting.get(key, 0)
             cap = self.config.maximum_startup_concurrency - starting
             for _ in range(max(0, min(needed - starting, cap))):
                 self._starting[key] = self._starting.get(key, 0) + 1
-                asyncio.ensure_future(self._spawn_and_track(key))
+                asyncio.ensure_future(
+                    self._spawn_and_track(key, runtime_env=renv))
 
-    async def _spawn_and_track(self, key: tuple, starting_key: tuple | None = None):
-        job_id, chips = key
+    async def _spawn_and_track(self, key: tuple,
+                               starting_key: tuple | None = None,
+                               runtime_env: dict | None = None):
+        job_id, chips = key[0], key[1]
         starting_key = starting_key or key
         try:
-            proc = await self._spawn_worker(job_id, chips)
+            proc = await self._spawn_worker(job_id, chips, runtime_env)
         except Exception:
             logger.exception("worker spawn failed")
             self._starting[starting_key] = max(
@@ -704,7 +739,8 @@ class Raylet:
         if lease.dedicated:
             # Actor workers stay bound to the actor until it dies.
             return
-        key = self._pool_key(worker.job_id, worker.tpu_chips)
+        key = self._pool_key(worker.job_id, worker.tpu_chips,
+                             worker.env_hash)
         self._idle.setdefault(key, []).append(worker)
 
     async def rpc_return_worker(self, req):

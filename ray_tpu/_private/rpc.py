@@ -151,6 +151,7 @@ class RpcClient:
         self._read_task = None
         self._lock = asyncio.Lock()
         self._closed = False
+        self._dead = False  # read loop saw EOF/reset — no replies can come
 
     async def connect(self):
         deadline = asyncio.get_event_loop().time() + self._timeout
@@ -180,6 +181,11 @@ class RpcClient:
                     fut.set_exception(RpcError(payload))
         except (asyncio.IncompleteReadError, ConnectionResetError, OSError,
                 asyncio.CancelledError):
+            # the peer is gone: no reply will EVER arrive on this
+            # connection — mark dead so `connected` stops advertising it
+            # (a not-yet-closing writer would otherwise let new calls
+            # wait forever on a drained pending table)
+            self._dead = True
             for fut in self._pending.values():
                 if not fut.done():
                     fut.set_exception(ConnectionLost(self.address))
@@ -187,7 +193,7 @@ class RpcClient:
 
     async def call(self, method: str, payload: Any = None,
                    timeout: float | None = None) -> Any:
-        if self._writer is None:
+        if self._writer is None or self._dead:
             raise ConnectionLost(f"not connected: {self.address}")
         msgid = next(self._msgid)
         fut = asyncio.get_event_loop().create_future()
@@ -204,7 +210,7 @@ class RpcClient:
         """Loop-thread-only fast path: write the request frame synchronously
         (StreamWriter.write appends a whole frame atomically, so no lock and
         no drain round-trip) and return the pending reply future."""
-        if self._writer is None:
+        if self._writer is None or self._dead:
             raise ConnectionLost(f"not connected: {self.address}")
         msgid = next(self._msgid)
         fut = asyncio.get_event_loop().create_future()
@@ -213,7 +219,7 @@ class RpcClient:
         return fut
 
     async def notify(self, method: str, payload: Any = None):
-        if self._writer is None:
+        if self._writer is None or self._dead:
             raise ConnectionLost(f"not connected: {self.address}")
         frame = _pack([0, NOTIFY, method, payload])
         async with self._lock:
@@ -229,7 +235,53 @@ class RpcClient:
 
     @property
     def connected(self) -> bool:
-        return self._writer is not None and not self._writer.is_closing()
+        return (self._writer is not None
+                and not self._writer.is_closing()
+                and not self._dead)
+
+
+class ReconnectingClient:
+    """A stable handle to a peer that may restart (the GCS): every call
+    resolves the live connection through the pool and retries once after
+    re-establishing it (reference: the gRPC channel's transparent
+    reconnect that raylet/worker GCS clients rely on)."""
+
+    def __init__(self, pool: "ClientPool", address: str):
+        self._pool = pool
+        self._address = address
+
+    @property
+    def address(self) -> str:
+        return self._address
+
+    async def call(self, method: str, payload, timeout: float = 30.0):
+        for attempt in (0, 1):
+            client = await self._pool.get(self._address)
+            if client._dead:
+                # stale pool entry: refresh and retry the CONNECT — the
+                # request was never sent, so this is always safe
+                self._pool.invalidate(self._address)
+                if attempt:
+                    raise ConnectionLost(self._address)
+                await asyncio.sleep(0.2)
+                continue
+            try:
+                return await client.call(method, payload, timeout=timeout)
+            except ConnectionLost:
+                # the request MAY have been applied before the peer went
+                # away — blindly replaying would double-apply mutations
+                # (e.g. a named-actor registration). Invalidate so the
+                # next call reconnects, and surface the loss.
+                self._pool.invalidate(self._address)
+                raise
+
+    async def notify(self, method: str, payload):
+        client = await self._pool.get(self._address)
+        try:
+            await client.notify(method, payload)
+        except ConnectionLost:
+            self._pool.invalidate(self._address)
+            raise
 
 
 class ClientPool:

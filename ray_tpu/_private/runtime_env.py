@@ -1,0 +1,126 @@
+"""Runtime environments: per-task/actor env vars + code shipping.
+
+Reference: `python/ray/runtime_env/runtime_env.py:152` (the RuntimeEnv
+spec) and `python/ray/_private/runtime_env/{working_dir,py_modules}.py`
+(URI-addressed packages installed by the per-node agent). Here the
+packages live in the GCS KV (content-addressed zips) and the WORKER
+materializes them at startup — no separate agent process; the raylet
+pools workers per runtime-env hash exactly like the reference's
+per-runtime-env worker pools (worker_pool.h:159).
+
+Supported fields: `env_vars` (dict), `working_dir` (local dir, shipped
+and chdir'd), `py_modules` (list of local dirs, shipped and put on
+sys.path).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+import os
+import sys
+import zipfile
+from typing import Dict, List, Optional
+
+_KV_NS = "runtime_env"
+_MAX_PACKAGE_BYTES = 100 * 1024 * 1024
+
+
+def _zip_dir(path: str) -> bytes:
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
+        for root, _dirs, files in os.walk(path):
+            for name in files:
+                full = os.path.join(root, name)
+                zf.write(full, os.path.relpath(full, path))
+    data = buf.getvalue()
+    if len(data) > _MAX_PACKAGE_BYTES:
+        raise ValueError(
+            f"runtime_env package {path!r} is {len(data)} bytes "
+            f"(cap {_MAX_PACKAGE_BYTES})")
+    return data
+
+
+def prepare(cw, runtime_env: Dict) -> Dict:
+    """Driver-side: upload local dirs to the GCS KV (content-addressed)
+    and return the wire form carried in TaskSpec.runtime_env."""
+    wire: Dict = {}
+    env_vars = runtime_env.get("env_vars")
+    if env_vars:
+        wire["env_vars"] = {str(k): str(v) for k, v in env_vars.items()}
+
+    def upload(path: str) -> str:
+        data = _zip_dir(path)
+        key = hashlib.sha1(data).hexdigest()[:20]
+        cw._run_sync(cw.gcs.call("kv_put", {
+            "ns": _KV_NS, "key": key.encode(), "value": data,
+            "overwrite": False,
+        }))
+        return key
+
+    if runtime_env.get("working_dir"):
+        wire["working_dir"] = upload(runtime_env["working_dir"])
+    if runtime_env.get("py_modules"):
+        wire["py_modules"] = [
+            {"key": upload(p), "name": os.path.basename(p.rstrip("/"))}
+            for p in runtime_env["py_modules"]
+        ]
+    unknown = set(runtime_env) - {"env_vars", "working_dir", "py_modules"}
+    if unknown:
+        raise ValueError(f"unsupported runtime_env fields: {unknown}")
+    return wire
+
+
+def env_hash(wire: Optional[Dict]) -> str:
+    """Stable identity for worker pooling; empty env hashes to ''."""
+    if not wire:
+        return ""
+    return hashlib.sha1(
+        json.dumps(wire, sort_keys=True).encode()).hexdigest()[:16]
+
+
+def materialize(cw, wire: Dict, target_root: str) -> None:
+    """Worker-side: download + extract packages, apply sys.path/cwd.
+    env_vars were already applied by the raylet at spawn."""
+    os.makedirs(target_root, exist_ok=True)
+
+    def fetch_extract(key: str, subdir: str) -> str:
+        dest = os.path.join(target_root, subdir)
+        if not os.path.isdir(dest):
+            reply = cw._run_sync(cw.gcs.call("kv_get", {
+                "ns": _KV_NS, "key": key.encode()}))
+            data = reply["value"]
+            if data is None:
+                raise RuntimeError(f"runtime_env package {key} missing")
+            # per-process tmp: concurrent workers materializing the same
+            # env must not collide; whoever renames first wins, the
+            # loser's rename failure is success (dest exists)
+            tmp = f"{dest}.tmp.{os.getpid()}"
+            with zipfile.ZipFile(io.BytesIO(data)) as zf:
+                zf.extractall(tmp)
+            try:
+                os.replace(tmp, dest)
+            except OSError:
+                if not os.path.isdir(dest):
+                    raise
+                import shutil
+
+                shutil.rmtree(tmp, ignore_errors=True)
+        return dest
+
+    for mod in wire.get("py_modules", []):
+        dest = fetch_extract(mod["key"], f"mod-{mod['key']}")
+        # a module dir is importable by its own name: expose its parent
+        parent = os.path.join(target_root, f"modroot-{mod['key']}")
+        os.makedirs(parent, exist_ok=True)
+        link = os.path.join(parent, mod["name"])
+        if not os.path.exists(link):
+            os.symlink(dest, link)
+        if parent not in sys.path:
+            sys.path.insert(0, parent)
+    if wire.get("working_dir"):
+        dest = fetch_extract(wire["working_dir"], f"wd-{wire['working_dir']}")
+        os.chdir(dest)
+        if dest not in sys.path:
+            sys.path.insert(0, dest)

@@ -61,6 +61,7 @@ def init(
     num_tpus: int | None = None,
     resources: Dict[str, float] | None = None,
     object_store_memory: int | None = None,
+    runtime_env: dict | None = None,
     _system_config: dict | None = None,
     ignore_reinit_error: bool = False,
 ):
@@ -118,6 +119,12 @@ def init(
             "job_id": job_id.binary(),
             "driver_addr": cw.address,
         }))
+        if runtime_env is not None:
+            # job-level default applied to every task/actor without its
+            # own runtime_env (reference: ray.init(runtime_env=...))
+            from ray_tpu._private import runtime_env as renv_mod
+
+            cw.job_runtime_env = renv_mod.prepare(cw, runtime_env)
         _global_state = GlobalState(cluster, cw, owns)
         atexit.register(shutdown)
         return _global_state
@@ -222,7 +229,24 @@ _OPTION_DEFAULTS = dict(
     scheduling_strategy=None,
     placement_group=None,
     placement_group_bundle_index=-1,
+    runtime_env=None,
 )
+
+
+def _prepared_runtime_env(holder, cw, opts):
+    """Resolve + upload the runtime env once per RemoteFunction/ActorClass
+    instance (content-addressed, so repeats are cheap anyway); falls back
+    to the job-level default from init(runtime_env=...)."""
+    renv = opts.get("runtime_env")
+    if renv is None:
+        return getattr(cw, "job_runtime_env", None)
+    cached = getattr(holder, "_prepared_env", None)
+    if cached is None:
+        from ray_tpu._private import runtime_env as renv_mod
+
+        cached = renv_mod.prepare(cw, renv)
+        holder._prepared_env = cached
+    return cached
 
 
 def _resource_dict(opts: dict, default_cpu: float) -> Dict[str, float]:
@@ -301,6 +325,7 @@ class RemoteFunction:
             placement_group_id=pg_id,
             bundle_index=bundle_index,
             streaming=streaming,
+            runtime_env=_prepared_runtime_env(self, cw, opts),
         )
         if streaming:
             return refs  # an ObjectRefGenerator
@@ -395,6 +420,7 @@ class ActorClass:
             soft=soft,
             placement_group_id=pg_id,
             bundle_index=bundle_index,
+            runtime_env=_prepared_runtime_env(self, cw, opts),
         )
         return ActorHandle(actor_id)
 

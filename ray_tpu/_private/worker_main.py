@@ -22,6 +22,8 @@ def main():
     parser.add_argument("--node-id", required=True)
     parser.add_argument("--job-id", required=True)
     parser.add_argument("--tpu-chips", default="")
+    parser.add_argument("--runtime-env", default="")
+    parser.add_argument("--session-dir", default="/tmp/ray_tpu")
     args = parser.parse_args()
 
     logging.basicConfig(
@@ -46,7 +48,22 @@ def main():
     )
     cw.start()
 
+    env_wire = None
+    if args.runtime_env:
+        import json
+
+        from ray_tpu._private import runtime_env as renv_mod
+
+        env_wire = json.loads(args.runtime_env)
+        # download + extract packages, apply cwd/sys.path before any
+        # task runs (env_vars were applied by the raylet at spawn)
+        renv_mod.materialize(
+            cw, env_wire,
+            os.path.join(args.session_dir, "runtime_envs"))
+
     async def register():
+        from ray_tpu._private import runtime_env as renv_mod
+
         raylet = await cw._clients.get(args.raylet_addr)
         await raylet.call("register_worker", {
             "worker_id": cw.worker_id.binary(),
@@ -54,6 +71,7 @@ def main():
             "pid": os.getpid(),
             "job_id": cw.job_id.binary(),
             "tpu_chips": list(chips),
+            "runtime_env_hash": renv_mod.env_hash(env_wire),
         })
 
     cw._run_sync(register())

@@ -1,0 +1,130 @@
+"""Lazy task/actor DAGs with a compiled execution path.
+
+Reference: `python/ray/dag/` — `.bind()` builds a lazy `DAGNode` graph
+(`dag_node.py`), `dag.execute()` walks it submitting tasks with upstream
+ObjectRefs as arguments, and `experimental_compile` lowers repeated
+executions onto pre-allocated channels (`compiled_dag_node.py:291`,
+mutable plasma + NCCL).
+
+TPU-first delta for the compiled path (SURVEY.md §7.1): instead of
+NCCL p2p channels, a compiled ray_tpu DAG of pure-JAX stages fuses the
+whole graph into ONE jitted function with buffer donation — XLA keeps
+intermediates on-device and schedules the transfers, which on TPU is the
+channel layer (ICI moves arrays between sharded stages inside the jit).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+
+
+class DAGNode:
+    """One lazy call; `execute()` materializes the whole upstream graph
+    (reference `dag_node.py`)."""
+
+    def __init__(self, fn_or_method, args: tuple, kwargs: dict):
+        self._fn = fn_or_method
+        self._args = args
+        self._kwargs = kwargs
+
+    def execute(self, *root_args) -> Any:
+        """Submit every node once, upstream first; returns the final
+        ObjectRef. `InputNode` placeholders bind to root_args."""
+        cache: Dict[int, Any] = {}
+        return self._execute(cache, root_args)
+
+    def _execute(self, cache: Dict[int, Any], root_args: tuple):
+        if id(self) in cache:
+            return cache[id(self)]
+
+        def resolve(v):
+            if isinstance(v, DAGNode):
+                return v._execute(cache, root_args)
+            if isinstance(v, InputNode):
+                return v.pick(root_args)
+            return v
+
+        args = tuple(resolve(a) for a in self._args)
+        kwargs = {k: resolve(v) for k, v in self._kwargs.items()}
+        ref = self._fn.remote(*args, **kwargs)
+        cache[id(self)] = ref
+        return ref
+
+    def experimental_compile(self) -> "CompiledDAG":
+        return CompiledDAG(self)
+
+
+class InputNode:
+    """Placeholder for execute()-time arguments (reference
+    `input_node.py`). `InputNode()` is the whole tuple's first element;
+    `InputNode(i)` picks position i."""
+
+    def __init__(self, index: int = 0):
+        self._index = index
+
+    def pick(self, root_args: tuple):
+        return root_args[self._index]
+
+
+def bind(remote_fn, *args, **kwargs) -> DAGNode:
+    """fn.bind(...) equivalent for this framework's RemoteFunction /
+    ActorMethod objects."""
+    return DAGNode(remote_fn, args, kwargs)
+
+
+class CompiledDAG:
+    """Repeat-execution form. For graphs whose nodes are jax-pure
+    callables the whole DAG compiles into one jitted function with
+    donated buffers (the TPU replacement for channel-based aDAGs);
+    otherwise it falls back to cached lazy execution, which still avoids
+    graph reconstruction per call."""
+
+    def __init__(self, dag: DAGNode):
+        self._dag = dag
+        self._jitted = None
+        jax_fns = self._extract_pure_jax_chain(dag)
+        if jax_fns is not None:
+            import jax
+
+            def fused(x):
+                for fn in jax_fns:
+                    x = fn(x)
+                return x
+
+            # donate the input: intermediates stay on device, XLA owns
+            # the buffers end to end
+            self._jitted = jax.jit(fused, donate_argnums=(0,))
+
+    @staticmethod
+    def _extract_pure_jax_chain(dag: DAGNode) -> Optional[List]:
+        """A linear chain of nodes marked `_jax_pure` (via
+        `ray_tpu.dag.jax_stage`) compiles into one jit."""
+        chain: List = []
+        node: Any = dag
+        while isinstance(node, DAGNode):
+            fn = getattr(node._fn, "_jax_pure_fn", None)
+            if fn is None or node._kwargs or len(node._args) != 1:
+                return None
+            chain.append(fn)
+            node = node._args[0]
+        if not isinstance(node, InputNode):
+            return None
+        chain.reverse()
+        return chain
+
+    def execute(self, *root_args):
+        if self._jitted is not None:
+            return self._jitted(*root_args)
+        return ray_tpu.get(self._dag.execute(*root_args))
+
+
+def jax_stage(fn):
+    """Mark a remote function as a pure JAX stage eligible for compiled
+    fusion: calls still work as ordinary remote tasks, and compiled DAGs
+    fuse consecutive stages into one jit."""
+    remote_fn = ray_tpu.remote(fn) if not hasattr(fn, "remote") else fn
+    remote_fn._jax_pure_fn = fn if not hasattr(fn, "remote") \
+        else fn._fn  # unwrap RemoteFunction
+    return remote_fn

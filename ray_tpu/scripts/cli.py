@@ -21,45 +21,17 @@ import time
 _STATE_FILE = "/tmp/ray_tpu/cli_node.json"
 
 
-def _daemon_env() -> dict:
-    """Daemon env hygiene, matching node._spawn: daemons never touch
-    accelerators (JAX_PLATFORMS=cpu), but the original platform is
-    preserved so raylets can hand it to TPU workers."""
-    env = dict(os.environ)
-    if "JAX_PLATFORMS" in env and \
-            "RAY_TPU_WORKER_JAX_PLATFORMS" not in env:
-        env["RAY_TPU_WORKER_JAX_PLATFORMS"] = env["JAX_PLATFORMS"]
-    env["JAX_PLATFORMS"] = "cpu"
-    repo_root = os.path.dirname(os.path.dirname(
-        os.path.dirname(os.path.abspath(__file__))))
-    env.setdefault("PYTHONPATH", repo_root)
-    return env
-
-
 def _spawn_daemon(args, log_path: str, ready_prefix: str) -> tuple:
-    """Detached daemon spawn; returns (pid, ready_line)."""
-    logfile = open(log_path, "ab")
-    proc = subprocess.Popen(
-        args, stdout=subprocess.PIPE, stderr=logfile,
-        start_new_session=True, env=_daemon_env(),
-    )
-    # non-blocking ready wait: a wedged daemon that never prints (and
-    # never exits) must not hang the CLI past the deadline
-    os.set_blocking(proc.stdout.fileno(), False)
-    deadline = time.monotonic() + 60
-    buf = b""
-    while time.monotonic() < deadline:
-        chunk = proc.stdout.read()
-        if chunk:
-            buf += chunk
-            for line in buf.decode(errors="replace").splitlines():
-                if line.startswith(ready_prefix):
-                    return proc.pid, line.strip()
-        if proc.poll() is not None:
-            raise SystemExit(f"daemon died on startup; see {log_path}")
-        time.sleep(0.05)
-    proc.terminate()
-    raise SystemExit("daemon not ready within 60s")
+    """Detached daemon spawn; returns (pid, ready_line). Shares
+    node._spawn's env hygiene + ready-wait machinery."""
+    from ray_tpu._private.node import _spawn
+
+    try:
+        handle = _spawn(args, log_path, ready_prefix, timeout=60.0,
+                        detach=True)
+    except RuntimeError as e:
+        raise SystemExit(str(e))
+    return handle.proc.pid, handle.ready_line
 
 
 def _save_state(state: dict):
@@ -211,6 +183,35 @@ def cmd_summary(args):
         ray_tpu.shutdown()
 
 
+def cmd_job(args):
+    """`ray_tpu job submit|status|logs|stop|list` (reference:
+    dashboard/modules/job/cli.py)."""
+    ray_tpu = _connect(args)
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    try:
+        client = JobSubmissionClient()
+        if args.job_command == "submit":
+            job_id = client.submit_job(
+                entrypoint=" ".join(args.entrypoint))
+            print(job_id)
+            if args.wait:
+                print(client.wait_until_finished(job_id))
+                print(client.get_job_logs(job_id), end="")
+        elif args.job_command == "status":
+            print(client.get_job_status(args.job_id))
+        elif args.job_command == "logs":
+            print(client.get_job_logs(args.job_id), end="")
+        elif args.job_command == "stop":
+            print("stopped" if client.stop_job(args.job_id)
+                  else "already finished")
+        elif args.job_command == "list":
+            for rec in client.list_jobs():
+                print(json.dumps(rec))
+    finally:
+        ray_tpu.shutdown()
+
+
 def cmd_submit(args):
     address = args.address or (_load_state() or {}).get("gcs_addr") \
         or os.environ.get("RAY_TPU_ADDRESS")
@@ -254,11 +255,21 @@ def main(argv=None):
     p.add_argument("--address")
     p.set_defaults(fn=cmd_summary)
 
-    p = sub.add_parser("submit", help="run a driver script")
+    p = sub.add_parser("submit", help="run a driver script locally")
     p.add_argument("--address")
     p.add_argument("script")
     p.add_argument("script_args", nargs="*")
     p.set_defaults(fn=cmd_submit)
+
+    p = sub.add_parser("job", help="cluster-hosted jobs")
+    p.add_argument("job_command",
+                   choices=["submit", "status", "logs", "stop", "list"])
+    p.add_argument("--address")
+    p.add_argument("--job-id", default=None)
+    p.add_argument("--wait", action="store_true")
+    p.add_argument("entrypoint", nargs="*",
+                   help="entrypoint command (submit)")
+    p.set_defaults(fn=cmd_job)
 
     args = parser.parse_args(argv)
     args.fn(args)

@@ -20,6 +20,10 @@ from ray_tpu.serve.deployment import (
     deployment,
 )
 from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
+from ray_tpu.serve.multiplex import (
+    get_multiplexed_model_id,
+    multiplexed,
+)
 
 _state: Dict[str, Any] = {"controller": None, "proxy": None}
 
@@ -118,6 +122,8 @@ def shutdown() -> None:
 
 
 __all__ = [
+    "multiplexed",
+    "get_multiplexed_model_id",
     "Application",
     "AutoscalingConfig",
     "Deployment",

@@ -1,0 +1,23 @@
+"""Durable workflows: DAG execution with step-level checkpoints + resume.
+
+Reference: `python/ray/workflow/` — `workflow.run` executes a DAG of
+steps with each step's output checkpointed to storage
+(`workflow_executor.py:32`, `workflow_storage.py`), so a crashed
+workflow resumes from the last completed step rather than restarting.
+
+Surface here: `workflow.run(dag_node, workflow_id=...)` over
+`ray_tpu.dag` DAGs, `workflow.resume(workflow_id)`, `workflow.status`,
+`workflow.list_all`. Storage is a filesystem directory (set via
+`workflow.init(storage=...)`).
+"""
+
+from ray_tpu.workflow.execution import (
+    init,
+    list_all,
+    resume,
+    run,
+    run_async,
+    status,
+)
+
+__all__ = ["init", "run", "run_async", "resume", "status", "list_all"]

@@ -1,0 +1,156 @@
+"""Workflow executor: checkpointed step-by-step DAG execution.
+
+Reference: `python/ray/workflow/workflow_executor.py:32` (the in-flight
+execution state machine) + `workflow_storage.py` (step-result storage).
+Steps are content-keyed by their position in the DAG; a completed step's
+pickled result short-circuits re-execution on resume.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu._private import serialization
+from ray_tpu.dag import DAGNode, InputNode
+
+_storage_root = os.path.expanduser("~/.ray_tpu_workflows")
+
+RUNNING = "RUNNING"
+SUCCEEDED = "SUCCEEDED"
+FAILED = "FAILED"
+
+
+def init(storage: Optional[str] = None) -> None:
+    global _storage_root
+    if storage:
+        _storage_root = storage
+    os.makedirs(_storage_root, exist_ok=True)
+
+
+def _wf_dir(workflow_id: str) -> str:
+    return os.path.join(_storage_root, workflow_id)
+
+
+def _write(path: str, obj: Any) -> None:
+    # cloudpickle: step functions are often closures/lambdas the stdlib
+    # pickler cannot serialize
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(serialization.dumps(obj))
+    os.replace(tmp, path)
+
+
+def _read(path: str) -> Any:
+    with open(path, "rb") as f:
+        return serialization.loads(f.read())
+
+
+def _step_key(node: DAGNode, dag_path: str) -> str:
+    """Stable identity for a step: its position in the DAG plus the
+    function name (the DAG shape is fixed across resumes)."""
+    name = getattr(node._fn, "__name__", "step")
+    return hashlib.sha1(f"{dag_path}:{name}".encode()).hexdigest()[:16]
+
+
+def _execute_node(node: Any, wf_dir: str, dag_path: str,
+                  root_args: tuple) -> Any:
+    """Post-order execution with per-step checkpoints. Returns the
+    step's VALUE (not a ref) — each step is a barrier, which is what
+    makes the checkpoint a consistent resume point."""
+    if isinstance(node, InputNode):
+        return node.pick(root_args)
+    if not isinstance(node, DAGNode):
+        return node
+    key = _step_key(node, dag_path)
+    ckpt = os.path.join(wf_dir, f"step-{key}.pkl")
+    if os.path.exists(ckpt):
+        return _read(ckpt)
+    args = [
+        _execute_node(a, wf_dir, f"{dag_path}/{i}", root_args)
+        for i, a in enumerate(node._args)
+    ]
+    kwargs = {
+        k: _execute_node(v, wf_dir, f"{dag_path}/{k}", root_args)
+        for k, v in node._kwargs.items()
+    }
+    value = ray_tpu.get(node._fn.remote(*args, **kwargs))
+    _write(ckpt, value)
+    return value
+
+
+def run(dag: DAGNode, *args, workflow_id: Optional[str] = None) -> Any:
+    """Execute to completion, checkpointing each step; returns the final
+    value. A re-run (or `resume`) with the same workflow_id skips
+    completed steps."""
+    workflow_id = workflow_id or f"wf-{int(time.time() * 1000)}"
+    wf_dir = _wf_dir(workflow_id)
+    os.makedirs(wf_dir, exist_ok=True)
+    meta_path = os.path.join(wf_dir, "meta.pkl")
+    _write(meta_path, {"workflow_id": workflow_id, "status": RUNNING,
+                       "dag": dag, "args": args, "ts": time.time()})
+    try:
+        out = _execute_node(dag, wf_dir, "", args)
+    except BaseException:
+        meta = _read(meta_path)
+        meta["status"] = FAILED
+        _write(meta_path, meta)
+        raise
+    meta = _read(meta_path)
+    meta.update(status=SUCCEEDED, result=out)
+    _write(meta_path, meta)
+    return out
+
+
+def run_async(dag: DAGNode, *args,
+              workflow_id: Optional[str] = None):
+    """Run in a detached driver thread; returns the workflow id."""
+    import threading
+
+    workflow_id = workflow_id or f"wf-{int(time.time() * 1000)}"
+    threading.Thread(
+        target=lambda: _swallow(run, dag, *args,
+                                workflow_id=workflow_id),
+        daemon=True).start()
+    return workflow_id
+
+
+def _swallow(fn, *args, **kwargs):
+    try:
+        fn(*args, **kwargs)
+    except BaseException:  # noqa: BLE001 — recorded in meta
+        pass
+
+
+def resume(workflow_id: str) -> Any:
+    """Re-run a failed/interrupted workflow: completed steps come from
+    their checkpoints, only the rest re-execute (reference:
+    `workflow.resume`)."""
+    meta = _read(os.path.join(_wf_dir(workflow_id), "meta.pkl"))
+    return run(meta["dag"], *meta["args"], workflow_id=workflow_id)
+
+
+def status(workflow_id: str) -> str:
+    return _read(os.path.join(_wf_dir(workflow_id), "meta.pkl"))["status"]
+
+
+def get_output(workflow_id: str) -> Any:
+    meta = _read(os.path.join(_wf_dir(workflow_id), "meta.pkl"))
+    if meta["status"] != SUCCEEDED:
+        raise RuntimeError(f"workflow {workflow_id} is {meta['status']}")
+    return meta["result"]
+
+
+def list_all() -> List[Dict[str, Any]]:
+    out = []
+    if not os.path.isdir(_storage_root):
+        return out
+    for wid in os.listdir(_storage_root):
+        meta_path = os.path.join(_storage_root, wid, "meta.pkl")
+        if os.path.exists(meta_path):
+            meta = _read(meta_path)
+            out.append({"workflow_id": wid, "status": meta["status"]})
+    return out

@@ -1,0 +1,80 @@
+"""util tests: ActorPool, distributed Queue.
+
+Reference ground: `python/ray/tests/test_actor_pool.py`,
+`test_queue.py`.
+"""
+
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util.actor_pool import ActorPool
+from ray_tpu.util.queue import Empty, Queue
+
+
+@pytest.fixture(scope="module", autouse=True)
+def cluster():
+    ray_tpu.init(num_cpus=4, object_store_memory=128 * 1024 * 1024)
+    yield
+    ray_tpu.shutdown()
+
+
+@ray_tpu.remote
+class Doubler:
+    def double(self, x):
+        return 2 * x
+
+
+def test_actor_pool_map_ordered():
+    pool = ActorPool([Doubler.remote() for _ in range(2)])
+    out = list(pool.map(lambda a, v: a.double.remote(v), range(6)))
+    assert out == [0, 2, 4, 6, 8, 10]
+
+
+def test_actor_pool_map_unordered():
+    pool = ActorPool([Doubler.remote() for _ in range(2)])
+    out = list(pool.map_unordered(
+        lambda a, v: a.double.remote(v), range(6)))
+    assert sorted(out) == [0, 2, 4, 6, 8, 10]
+
+
+def test_actor_pool_submit_get_next():
+    pool = ActorPool([Doubler.remote()])
+    pool.submit(lambda a, v: a.double.remote(v), 10)
+    pool.submit(lambda a, v: a.double.remote(v), 20)  # queues
+    assert pool.has_next()
+    assert pool.get_next(timeout=30) == 20
+    assert pool.get_next(timeout=30) == 40
+    assert not pool.has_next()
+
+
+def test_queue_roundtrip():
+    q = Queue(maxsize=4)
+    q.put("a")
+    q.put_many(["b", "c"])
+    assert q.qsize() == 3
+    assert q.get() == "a"
+    assert q.get() == "b"
+    assert q.get() == "c"
+    assert q.empty()
+    with pytest.raises(Empty):
+        q.get_nowait()
+    q.shutdown()
+
+
+def test_queue_blocking_get_across_callers():
+    q = Queue()
+    got = []
+
+    def consumer():
+        got.append(q.get(timeout=30))
+
+    t = threading.Thread(target=consumer)
+    t.start()
+    time.sleep(0.3)
+    q.put("handoff")
+    t.join(timeout=30)
+    assert got == ["handoff"]
+    q.shutdown()
