@@ -142,23 +142,71 @@ def bench_gpt2_tokens_per_sec(steps: int = 20):
 # --------------------------------------------------------------------------
 
 def bench_control_plane():
+    """Each phase gets an isolated cluster sized to the machine: worker
+    processes beyond the core count thrash instead of pipelining, and a
+    phase's leftover actors would steal cycles from the next phase's
+    measurement."""
+    import os
+
     import numpy as np
 
     import ray_tpu
 
+    ncpu = os.cpu_count() or 1
     out = {}
-    ray_tpu.init(num_cpus=8, object_store_memory=1 << 30)
+
+    # -- phase A: object plane (no task workers at all) -----------------
+    ray_tpu.init(num_cpus=1, object_store_memory=1 << 30)
+    try:
+        arr = np.ones(64 * 1024 * 1024, np.uint8)  # 64 MiB
+        ray_tpu.put(arr)  # warm
+        n, start = 0, time.perf_counter()
+        while time.perf_counter() - start < 3.0:
+            ray_tpu.put(arr)
+            n += 1
+        out["single_client_put_gigabytes"] = (
+            n * arr.nbytes / (time.perf_counter() - start) / 1e9)
+
+        small_ref = ray_tpu.put(np.ones(1024, np.uint8))
+        for _ in range(100):
+            ray_tpu.get(small_ref)
+        n, start = 0, time.perf_counter()
+        while time.perf_counter() - start < 3.0:
+            for _ in range(100):
+                ray_tpu.get(small_ref)
+            n += 100
+        out["single_client_get_calls"] = n / (time.perf_counter() - start)
+    finally:
+        ray_tpu.shutdown()
+
+    # -- phase B: tasks --------------------------------------------------
+    ray_tpu.init(num_cpus=min(4, ncpu), object_store_memory=256 << 20)
+    try:
+        @ray_tpu.remote
+        def noop():
+            return None
+
+        ray_tpu.get(noop.remote())
+        ray_tpu.get([noop.remote() for _ in range(64)])
+        n, start = 0, time.perf_counter()
+        while time.perf_counter() - start < 3.0:
+            refs = [noop.remote() for _ in range(1000)]
+            ray_tpu.get(refs)
+            n += 1000
+        out["single_client_tasks_async"] = n / (time.perf_counter() - start)
+    finally:
+        ray_tpu.shutdown()
+
+    # -- phase C: actors -------------------------------------------------
+    n_actors = max(1, min(8, ncpu))
+    ray_tpu.init(num_cpus=max(2, n_actors),
+                 object_store_memory=256 << 20)
     try:
         @ray_tpu.remote
         class Sink:
             def ping(self):
                 return None
 
-        @ray_tpu.remote
-        def noop():
-            return None
-
-        # -- 1:1 sync actor calls ---------------------------------------
         actor = Sink.remote()
         ray_tpu.get(actor.ping.remote())
         for _ in range(100):
@@ -170,7 +218,6 @@ def bench_control_plane():
             n += 100
         out["1_1_actor_calls_sync"] = n / (time.perf_counter() - start)
 
-        # -- 1:1 async actor calls (pipelined, batched gets) ------------
         n, start = 0, time.perf_counter()
         while time.perf_counter() - start < 3.0:
             refs = [actor.ping.remote() for _ in range(1000)]
@@ -178,8 +225,6 @@ def bench_control_plane():
             n += 1000
         out["1_1_actor_calls_async"] = n / (time.perf_counter() - start)
 
-        # -- n:n async actor calls --------------------------------------
-        n_actors = 8
         actors = [Sink.remote() for _ in range(n_actors)]
         ray_tpu.get([a.ping.remote() for a in actors])
         n, start = 0, time.perf_counter()
@@ -188,36 +233,6 @@ def bench_control_plane():
             ray_tpu.get(refs)
             n += len(refs)
         out["n_n_actor_calls_async"] = n / (time.perf_counter() - start)
-
-        # -- single-client async tasks ----------------------------------
-        ray_tpu.get(noop.remote())
-        n, start = 0, time.perf_counter()
-        while time.perf_counter() - start < 3.0:
-            refs = [noop.remote() for _ in range(1000)]
-            ray_tpu.get(refs)
-            n += 1000
-        out["single_client_tasks_async"] = n / (time.perf_counter() - start)
-
-        # -- put throughput (GB/s, zero-copy numpy into shm) ------------
-        arr = np.ones(64 * 1024 * 1024, np.uint8)  # 64 MiB
-        ray_tpu.put(arr)  # warm
-        n, start = 0, time.perf_counter()
-        while time.perf_counter() - start < 3.0:
-            ray_tpu.put(arr)
-            n += 1
-        out["single_client_put_gigabytes"] = (
-            n * arr.nbytes / (time.perf_counter() - start) / 1e9)
-
-        # -- plasma get calls/s (small objects through the store) -------
-        small_ref = ray_tpu.put(np.ones(1024, np.uint8))
-        for _ in range(100):
-            ray_tpu.get(small_ref)
-        n, start = 0, time.perf_counter()
-        while time.perf_counter() - start < 3.0:
-            for _ in range(100):
-                ray_tpu.get(small_ref)
-            n += 100
-        out["single_client_get_calls"] = n / (time.perf_counter() - start)
     finally:
         ray_tpu.shutdown()
     return out

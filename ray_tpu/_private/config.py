@@ -32,6 +32,9 @@ class Config:
     # chunk fetches ride in flight per object.
     object_transfer_chunk_bytes: int = 4 * 1024 * 1024
     object_transfer_parallelism: int = 4
+    # Outstanding worker-lease requests per scheduling key (reference:
+    # max_pending_lease_requests_per_scheduling_category).
+    max_lease_requests_per_key: int = 8
     # Default per-node shared-memory store capacity.
     object_store_memory: int = 2 * 1024**3
     # Object-table slots in the shm store header.

@@ -1039,8 +1039,13 @@ class CoreWorker:
         if state is None:
             state = self._key_states[key] = _KeyState()
         state.queue.append([spec, spec.max_retries])
-        # One outstanding lease request per queued task, capped lightly.
-        if state.requesting < max(1, len(state.queue)):
+        # Pipeline through a bounded set of leases (reference: the
+        # submitter caps in-flight lease requests per SchedulingKey).
+        # One request per queued task would flood the raylet into
+        # spawning far more workers than cores under bursty submission.
+        cap = min(max(1, len(state.queue)),
+                  self.config.max_lease_requests_per_key)
+        if state.requesting < cap:
             state.requesting += 1
             asyncio.ensure_future(self._lease_and_run(key, state))
 

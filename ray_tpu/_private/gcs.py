@@ -219,17 +219,18 @@ class GcsServer:
             *[asyncio.wait_for(send(a), timeout=2.0) for a in subs],
             return_exceptions=True)
         for addr, result in zip(subs, results):
-            if isinstance(result, (ConnectionLost, OSError, RpcError)):
+            # TimeoutError must be checked FIRST: on py3.11+ it IS a
+            # subclass of OSError, and a busy-but-live subscriber that
+            # blows the 2s budget must keep its subscription — dropping
+            # it would silently starve the driver of actor updates
+            if isinstance(result, asyncio.TimeoutError):
+                logger.debug("pubsub to %s timed out", addr)
+            elif isinstance(result, (ConnectionLost, OSError, RpcError)):
                 # connection-dead: unsubscribe (removal must be
                 # idempotent — concurrent publishes may both see it)
                 if addr in self.subscribers.get(channel, []):
                     self.subscribers[channel].remove(addr)
                 self.clients.invalidate(addr)
-            elif isinstance(result, BaseException):
-                # transient (busy subscriber hit the 2s budget): skip
-                # this round but KEEP the subscription — dropping a live
-                # driver would silently starve it of actor updates
-                logger.debug("pubsub to %s timed out", addr)
 
     # ------------------------------------------------------------------
     # node membership + resource view (GcsNodeManager + ray_syncer)

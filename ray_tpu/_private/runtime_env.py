@@ -115,8 +115,10 @@ def materialize(cw, wire: Dict, target_root: str) -> None:
         parent = os.path.join(target_root, f"modroot-{mod['key']}")
         os.makedirs(parent, exist_ok=True)
         link = os.path.join(parent, mod["name"])
-        if not os.path.exists(link):
+        try:
             os.symlink(dest, link)
+        except FileExistsError:
+            pass  # a concurrent worker won the race — same target
         if parent not in sys.path:
             sys.path.insert(0, parent)
     if wire.get("working_dir"):

@@ -117,7 +117,12 @@ class TaskSpec:
 
     def scheduling_key(self) -> tuple:
         """Tasks with the same key can reuse a cached worker lease
-        (reference: SchedulingKey in direct_task_transport.h)."""
+        (reference: SchedulingKey in direct_task_transport.h). The
+        runtime env is part of the key: a lease's worker is materialized
+        for ONE env, so tasks with different envs must never share a
+        drain queue."""
+        from ray_tpu._private.runtime_env import env_hash
+
         return (
             self.function_key,
             tuple(sorted(self.resources.items())),
@@ -125,4 +130,5 @@ class TaskSpec:
             self.node_id,
             self.placement_group_id,
             self.bundle_index,
+            env_hash(self.runtime_env),
         )

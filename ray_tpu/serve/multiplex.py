@@ -73,11 +73,21 @@ def multiplexed(max_num_models_per_replica: int = 3):
     """
 
     def decorate(loader: Callable):
-        cache = _LRU(max_num_models_per_replica)
-        inflight: dict = {}  # model_id -> asyncio.Future
+        state_attr = f"_multiplex_state_{loader.__name__}"
+
+        def _state(self):
+            # per-INSTANCE cache: two replicas of the class in one
+            # process must not share (or cross-evict) each other's
+            # device-bound models
+            state = self.__dict__.get(state_attr)
+            if state is None:
+                state = self.__dict__[state_attr] = (
+                    _LRU(max_num_models_per_replica), {})
+            return state
 
         @functools.wraps(loader)
         async def wrapper(self, model_id: str):
+            cache, inflight = _state(self)
             hit, model = cache.get(model_id)
             if hit:
                 _set_model_id(model_id)

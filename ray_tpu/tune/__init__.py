@@ -15,6 +15,7 @@ from ray_tpu.tune.schedulers import (
 )
 from ray_tpu.tune.search import (
     BasicVariantGenerator,
+    TPESearch,
     Searcher,
     choice,
     grid_search,
@@ -51,6 +52,7 @@ __all__ = [
     "PopulationBasedTraining",
     "ResultGrid",
     "Searcher",
+    "TPESearch",
     "Stopper",
     "Trainable",
     "TrialPlateauStopper",

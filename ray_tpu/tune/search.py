@@ -207,3 +207,139 @@ class BasicVariantGenerator(Searcher):
         cfg = self._variants[self._i]
         self._i += 1
         return cfg
+
+
+class TPESearch(Searcher):
+    """Tree-structured Parzen Estimator — the algorithm behind the
+    reference's default model-based searcher (`OptunaSearch`, whose
+    default sampler is TPE). The reference ships wrappers around
+    external libraries (`python/ray/tune/search/optuna/optuna_search.py`
+    etc.); this is a native implementation so model-based search works
+    with zero extra dependencies.
+
+    Univariate TPE (Optuna's default): observations are split at the
+    gamma-quantile into good/bad sets; each dimension proposes
+    candidates from a kernel density over the good set and scores them
+    by the good/bad density ratio.
+    """
+
+    def __init__(self, metric: Optional[str] = None, mode: str = "max",
+                 n_initial_points: int = 10, gamma: float = 0.25,
+                 n_candidates: int = 24, seed: Optional[int] = None):
+        super().__init__(metric, mode)
+        self.n_initial = n_initial_points
+        self.gamma = gamma
+        self.n_candidates = n_candidates
+        self.rng = random.Random(seed)
+        self._configs: Dict[str, Dict[str, Any]] = {}
+        self._scores: List[tuple] = []  # (score, flat_config)
+
+    # -- observation -------------------------------------------------------
+
+    def on_trial_complete(self, trial_id, result=None, error=False):
+        if error or result is None or self.metric not in result:
+            self._configs.pop(trial_id, None)
+            return
+        cfg = self._configs.pop(trial_id, None)
+        if cfg is None:
+            return
+        score = float(result[self.metric])
+        if self.mode == "min":
+            score = -score
+        self._scores.append((score, cfg))
+
+    # -- suggestion --------------------------------------------------------
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        if self.param_space is None:
+            raise RuntimeError("set_search_properties was never called")
+        flat: Dict[str, Any] = {}
+        config = self._build("", self.param_space, flat)
+        self._configs[trial_id] = flat
+        return config
+
+    def _build(self, prefix: str, space: Dict[str, Any],
+               flat: Dict[str, Any]) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        for k, v in space.items():
+            path = f"{prefix}.{k}" if prefix else k
+            if isinstance(v, dict):
+                out[k] = self._build(path, v, flat)
+            elif isinstance(v, GridSearch):
+                out[k] = self._suggest_dim(path, Choice(v.values))
+                flat[path] = out[k]
+            elif isinstance(v, Domain):
+                out[k] = self._suggest_dim(path, v)
+                flat[path] = out[k]
+            elif isinstance(v, sample_from):
+                out[k] = v.fn(out)
+            else:
+                out[k] = v
+        return out
+
+    def _split(self):
+        ordered = sorted(self._scores, key=lambda s: -s[0])
+        n_good = max(1, int(len(ordered) * self.gamma))
+        return ordered[:n_good], ordered[n_good:]
+
+    def _suggest_dim(self, path: str, domain: Domain) -> Any:
+        if len(self._scores) < self.n_initial:
+            return domain.sample(self.rng)
+        good, bad = self._split()
+        good_vals = [c[path] for _, c in good if path in c]
+        bad_vals = [c[path] for _, c in bad if path in c]
+        if not good_vals:
+            return domain.sample(self.rng)
+        if isinstance(domain, Choice):
+            return self._categorical(domain.categories, good_vals,
+                                     bad_vals)
+        return self._numeric(domain, good_vals, bad_vals)
+
+    def _categorical(self, categories, good_vals, bad_vals):
+        # density ratio with +1 prior smoothing per category
+        def weight(cat):
+            lg = (good_vals.count(cat) + 1) / (len(good_vals)
+                                               + len(categories))
+            lb = (bad_vals.count(cat) + 1) / (len(bad_vals)
+                                              + len(categories))
+            return lg / lb
+
+        weights = [weight(c) for c in categories]
+        return self.rng.choices(categories, weights=weights, k=1)[0]
+
+    def _numeric(self, domain, good_vals, bad_vals):
+        log = isinstance(domain, LogUniform)
+
+        def fwd(x):
+            return math.log(x) if log else float(x)
+
+        def inv(x):
+            return math.exp(x) if log else x
+
+        lo, hi = fwd(domain.low), fwd(domain.high)
+        pts = [fwd(v) for v in good_vals]
+        bad_pts = [fwd(v) for v in bad_vals]
+        width = max(hi - lo, 1e-12)
+        bw = max(width / max(1.0, math.sqrt(len(pts))), width * 0.01)
+
+        def density(x, centers):
+            if not centers:
+                return 1.0 / width  # uniform fallback
+            s = sum(
+                math.exp(-0.5 * ((x - c) / bw) ** 2) for c in centers)
+            return s / (len(centers) * bw * math.sqrt(2 * math.pi)) \
+                + 1e-12
+
+        best_x, best_ratio = None, -1.0
+        for _ in range(self.n_candidates):
+            center = self.rng.choice(pts)
+            x = min(hi, max(lo, self.rng.gauss(center, bw)))
+            ratio = density(x, pts) / density(x, bad_pts)
+            if ratio > best_ratio:
+                best_x, best_ratio = x, ratio
+        out = inv(best_x)
+        if isinstance(domain, Randint):
+            return int(min(domain.high - 1, max(domain.low, round(out))))
+        if isinstance(domain, QUniform):
+            return round(out / domain.q) * domain.q
+        return out

@@ -36,18 +36,27 @@ class ActorPool:
         return bool(self._index_to_future) or bool(self._pending_submits)
 
     def get_next(self, timeout: float | None = None) -> Any:
-        """Next result in submission order."""
+        """Next result in submission order. A timeout leaves the pool
+        state untouched (the result stays claimable; the actor stays
+        busy) — reference semantics."""
         if self._next_return_index not in self._index_to_future:
             raise StopIteration("no pending results")
-        ref = self._index_to_future.pop(self._next_return_index)
+        ref = self._index_to_future[self._next_return_index]
+        if timeout is not None:
+            ready, _ = ray_tpu.wait([ref], num_returns=1,
+                                    timeout=timeout)
+            if not ready:
+                raise TimeoutError("no result within timeout")
+        del self._index_to_future[self._next_return_index]
         self._next_return_index += 1
         try:
-            return ray_tpu.get(ref, timeout=timeout)
+            return ray_tpu.get(ref)
         finally:
             self._return_actor(self._future_to_actor.pop(ref))
 
     def get_next_unordered(self, timeout: float | None = None) -> Any:
-        """Whichever pending result finishes first."""
+        """Whichever pending result finishes first. Timeout is
+        state-preserving, as above."""
         if not self._index_to_future:
             raise StopIteration("no pending results")
         refs = list(self._index_to_future.values())

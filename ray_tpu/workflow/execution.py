@@ -57,28 +57,39 @@ def _step_key(node: DAGNode, dag_path: str) -> str:
 
 
 def _execute_node(node: Any, wf_dir: str, dag_path: str,
-                  root_args: tuple) -> Any:
+                  root_args: tuple,
+                  run_cache: Optional[Dict[int, Any]] = None) -> Any:
     """Post-order execution with per-step checkpoints. Returns the
     step's VALUE (not a ref) — each step is a barrier, which is what
-    makes the checkpoint a consistent resume point."""
+    makes the checkpoint a consistent resume point. `run_cache` dedupes
+    shared (diamond) nodes within one run: a node reached via two paths
+    must execute once, like dag.execute's per-run cache."""
     if isinstance(node, InputNode):
         return node.pick(root_args)
     if not isinstance(node, DAGNode):
         return node
+    if run_cache is None:
+        run_cache = {}
+    if id(node) in run_cache:
+        return run_cache[id(node)]
     key = _step_key(node, dag_path)
     ckpt = os.path.join(wf_dir, f"step-{key}.pkl")
     if os.path.exists(ckpt):
-        return _read(ckpt)
+        value = _read(ckpt)
+        run_cache[id(node)] = value
+        return value
     args = [
-        _execute_node(a, wf_dir, f"{dag_path}/{i}", root_args)
+        _execute_node(a, wf_dir, f"{dag_path}/{i}", root_args, run_cache)
         for i, a in enumerate(node._args)
     ]
     kwargs = {
-        k: _execute_node(v, wf_dir, f"{dag_path}/{k}", root_args)
+        k: _execute_node(v, wf_dir, f"{dag_path}/{k}", root_args,
+                         run_cache)
         for k, v in node._kwargs.items()
     }
     value = ray_tpu.get(node._fn.remote(*args, **kwargs))
     _write(ckpt, value)
+    run_cache[id(node)] = value
     return value
 
 

@@ -33,6 +33,23 @@ def test_env_vars_per_task():
     assert ray_tpu.get(read_env_plain.remote(), timeout=60) is None
 
 
+def test_same_function_different_envs_do_not_share_workers():
+    """One function, two envs: each call must see ITS env — distinct
+    scheduling keys keep distinct env workers (a shared lease queue
+    would silently run the second env's task in the first's worker)."""
+    @ray_tpu.remote
+    def read_flag():
+        return os.environ.get("SHARED_FLAG")
+
+    a = read_flag.options(
+        runtime_env={"env_vars": {"SHARED_FLAG": "one"}})
+    b = read_flag.options(
+        runtime_env={"env_vars": {"SHARED_FLAG": "two"}})
+    # interleave submissions so a shared queue WOULD mix them
+    refs = [a.remote(), b.remote(), a.remote(), b.remote()]
+    assert ray_tpu.get(refs, timeout=120) == ["one", "two", "one", "two"]
+
+
 def test_env_vars_for_actor():
     @ray_tpu.remote(runtime_env={"env_vars": {"ACTOR_MODE": "42"}})
     class EnvActor:

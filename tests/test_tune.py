@@ -262,3 +262,58 @@ def test_adaptive_searcher_sees_results(storage):
     grid = tuner.fit()
     scores = sorted(r.metrics["score"] for r in grid)
     assert scores == [1.0, 2.0, 3.0]  # each suggest built on the last
+
+
+def test_tpe_search_converges_better_than_uniform():
+    """Native TPE (the reference's OptunaSearch default algorithm)
+    concentrates samples near the optimum of a smooth objective."""
+    from ray_tpu.tune.search import TPESearch
+
+    def objective(x, y):
+        return -((x - 0.7) ** 2) - ((y - 0.2) ** 2)
+
+    searcher = TPESearch(metric="score", mode="max",
+                         n_initial_points=8, seed=7)
+    searcher.set_search_properties("score", "max", {
+        "x": tune.uniform(0.0, 1.0),
+        "y": tune.uniform(0.0, 1.0),
+    })
+    best = -1e9
+    last10 = []
+    for i in range(60):
+        cfg = searcher.suggest(f"t{i}")
+        score = objective(cfg["x"], cfg["y"])
+        searcher.on_trial_complete(f"t{i}", {"score": score})
+        best = max(best, score)
+        if i >= 50:
+            last10.append(cfg)
+    assert best > -0.02, f"TPE never got close: best={best}"
+    # exploitation: late samples cluster near the optimum
+    mean_x = sum(c["x"] for c in last10) / len(last10)
+    mean_y = sum(c["y"] for c in last10) / len(last10)
+    assert abs(mean_x - 0.7) < 0.25 and abs(mean_y - 0.2) < 0.25
+
+
+def test_tpe_with_tuner(tmp_path):
+    from ray_tpu.air.config import RunConfig
+    from ray_tpu.tune.search import TPESearch
+
+    def trainable(config):
+        tune.report({"loss": (config["lr"] - 0.01) ** 2,
+                     "choice_used": config["opt"]})
+
+    tuner = tune.Tuner(
+        trainable,
+        param_space={
+            "lr": tune.loguniform(1e-4, 1.0),
+            "opt": tune.choice(["adam", "sgd"]),
+        },
+        tune_config=tune.TuneConfig(
+            metric="loss", mode="min", num_samples=12,
+            search_alg=TPESearch(seed=3)),
+        run_config=RunConfig(storage_path=str(tmp_path), name="tpe"),
+    )
+    grid = tuner.fit()
+    assert len(grid) == 12
+    best = grid.get_best_result("loss", mode="min")
+    assert best.metrics["loss"] < 0.05
