@@ -69,6 +69,10 @@ def prepare(cw, runtime_env: Dict) -> Dict:
     unknown = set(runtime_env) - {"env_vars", "working_dir", "py_modules"}
     if unknown:
         raise ValueError(f"unsupported runtime_env fields: {unknown}")
+    # precompute the pooling identity once: scheduling_key() reads it on
+    # every submit, which must not pay a json+sha1 per task
+    wire["_hash"] = hashlib.sha1(
+        json.dumps(wire, sort_keys=True).encode()).hexdigest()[:16]
     return wire
 
 
@@ -76,6 +80,9 @@ def env_hash(wire: Optional[Dict]) -> str:
     """Stable identity for worker pooling; empty env hashes to ''."""
     if not wire:
         return ""
+    cached = wire.get("_hash")
+    if cached is not None:
+        return cached
     return hashlib.sha1(
         json.dumps(wire, sort_keys=True).encode()).hexdigest()[:16]
 

@@ -1,0 +1,157 @@
+"""Dashboard: REST API + minimal HTML overview of the cluster.
+
+Reference: `dashboard/` (aiohttp head process with pluggable modules;
+`state_aggregator.py` backing the state API, `dashboard/client/` React
+SPA). Here one aiohttp app serves the same JSON surface —
+/api/nodes, /api/tasks, /api/actors, /api/objects, /api/jobs,
+/api/cluster_load, /api/timeline — plus a self-contained HTML page;
+heavyweight SPA tooling is out of scope.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+from typing import Optional
+
+_INDEX_HTML = """<!doctype html>
+<html><head><title>ray_tpu dashboard</title>
+<style>
+ body { font-family: monospace; margin: 2em; }
+ h2 { border-bottom: 1px solid #999; }
+ table { border-collapse: collapse; margin-bottom: 1.5em; }
+ td, th { border: 1px solid #ccc; padding: 4px 8px; text-align: left; }
+</style></head>
+<body>
+<h1>ray_tpu</h1>
+<div id="out">loading…</div>
+<script>
+// every GCS-sourced string is attacker-influenced (actor/task names
+// come from arbitrary cluster clients) — escape before any innerHTML
+function esc(v) {
+  return String(v).replace(/[&<>"']/g, c => ({'&':'&amp;','<':'&lt;',
+    '>':'&gt;','"':'&quot;',"'":'&#39;'}[c]));
+}
+async function refresh() {
+  const [nodes, actors, summary] = await Promise.all([
+    fetch('/api/nodes').then(r => r.json()),
+    fetch('/api/actors').then(r => r.json()),
+    fetch('/api/task_summary').then(r => r.json()),
+  ]);
+  let html = '<h2>Nodes</h2><table><tr><th>id</th><th>alive</th>' +
+             '<th>resources</th><th>available</th></tr>';
+  for (const n of nodes) {
+    html += `<tr><td>${esc(n.NodeID.slice(0,12))}</td>` +
+            `<td>${esc(n.Alive)}</td>` +
+            `<td>${esc(JSON.stringify(n.Resources))}</td>` +
+            `<td>${esc(JSON.stringify(n.Available))}</td></tr>`;
+  }
+  html += '</table><h2>Actors</h2><table><tr><th>id</th><th>name</th>' +
+          '<th>class</th><th>state</th><th>restarts</th></tr>';
+  for (const a of actors) {
+    html += `<tr><td>${esc(a.actor_id.slice(0,12))}</td>` +
+            `<td>${esc(a.name||'')}</td>` +
+            `<td>${esc(a.class_name)}</td><td>${esc(a.state)}</td>` +
+            `<td>${esc(a.num_restarts)}</td></tr>`;
+  }
+  html += '</table><h2>Tasks</h2><table><tr><th>name</th>' +
+          '<th>states</th></tr>';
+  for (const [name, states] of Object.entries(summary)) {
+    html += `<tr><td>${esc(name)}</td>` +
+            `<td>${esc(JSON.stringify(states))}</td></tr>`;
+  }
+  html += '</table>';
+  document.getElementById('out').innerHTML = html;
+}
+refresh(); setInterval(refresh, 2000);
+</script></body></html>"""
+
+
+class Dashboard:
+    """Serves the REST/HTML surface from the connected driver's state
+    APIs; runs its aiohttp loop on a thread (same pattern as the Serve
+    proxy)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 8265):
+        self._host = host
+        self._port = port
+        self._started = threading.Event()
+        self._error: Optional[BaseException] = None
+        self._thread = threading.Thread(target=self._serve_guarded,
+                                        daemon=True, name="dashboard")
+        self._thread.start()
+        if not self._started.wait(timeout=30):
+            raise RuntimeError(
+                f"dashboard failed to start on {host}:{port}"
+                + (f": {self._error!r}" if self._error else ""))
+
+    def _serve_guarded(self):
+        try:
+            self._serve()
+        except BaseException as e:  # noqa: BLE001 — surfaced in __init__
+            self._error = e
+
+    def ready(self):
+        return {"host": self._host, "port": self._port}
+
+    def _serve(self):
+        from aiohttp import web
+
+        import ray_tpu
+        from ray_tpu.util import state as state_api
+        from ray_tpu.util.timeline import timeline
+
+        def j(fn):
+            async def handler(request):
+                loop = asyncio.get_event_loop()
+                try:
+                    data = await loop.run_in_executor(None, fn)
+                except Exception as e:  # noqa: BLE001
+                    return web.json_response({"error": str(e)},
+                                             status=500)
+                return web.json_response(data)
+
+            return handler
+
+        def cluster_load():
+            from ray_tpu._private.worker_api import _require_state
+
+            cw = _require_state().core_worker
+            load = cw._run_sync(cw.gcs.call("get_cluster_load", {}))
+            return json.loads(json.dumps(load, default=lambda o: (
+                o.hex() if isinstance(o, bytes) else str(o))))
+
+        app = web.Application()
+        app.router.add_get(
+            "/", lambda r: web.Response(text=_INDEX_HTML,
+                                        content_type="text/html"))
+        app.router.add_get("/api/nodes", j(state_api.list_nodes))
+        app.router.add_get("/api/actors", j(state_api.list_actors))
+        app.router.add_get("/api/tasks", j(state_api.list_tasks))
+        app.router.add_get("/api/objects", j(state_api.list_objects))
+        app.router.add_get("/api/task_summary",
+                           j(state_api.summarize_tasks))
+        app.router.add_get("/api/timeline", j(timeline))
+        app.router.add_get(
+            "/api/cluster_resources",
+            j(lambda: {"total": ray_tpu.cluster_resources(),
+                       "available": ray_tpu.available_resources()}))
+        app.router.add_get("/api/cluster_load", j(cluster_load))
+
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        runner = web.AppRunner(app)
+        loop.run_until_complete(runner.setup())
+        site = web.TCPSite(runner, self._host, self._port)
+        loop.run_until_complete(site.start())
+        self._started.set()
+        loop.run_forever()
+
+
+def start_dashboard(host: str = "127.0.0.1",
+                    port: int = 8265) -> Dashboard:
+    """Start the dashboard in this (driver) process. For a long-lived
+    cluster service, run `python -m ray_tpu dashboard --address ...` on
+    any machine that can reach the GCS."""
+    return Dashboard(host, port)

@@ -183,6 +183,34 @@ def cmd_summary(args):
         ray_tpu.shutdown()
 
 
+def cmd_timeline(args):
+    ray_tpu = _connect(args)
+    from ray_tpu.util.timeline import timeline
+
+    try:
+        events = timeline(args.output)
+        print(f"wrote {len(events)} events to {args.output} "
+              "(open in chrome://tracing or ui.perfetto.dev)")
+    finally:
+        ray_tpu.shutdown()
+
+
+def cmd_dashboard(args):
+    ray_tpu = _connect(args)
+    from ray_tpu.dashboard import start_dashboard
+
+    try:
+        start_dashboard(port=args.port)
+        print(f"dashboard at http://127.0.0.1:{args.port} (ctrl-c to stop)")
+        import signal
+
+        signal.pause()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        ray_tpu.shutdown()
+
+
 def cmd_job(args):
     """`ray_tpu job submit|status|logs|stop|list` (reference:
     dashboard/modules/job/cli.py)."""
@@ -260,6 +288,16 @@ def main(argv=None):
     p.add_argument("script")
     p.add_argument("script_args", nargs="*")
     p.set_defaults(fn=cmd_submit)
+
+    p = sub.add_parser("timeline", help="dump a Chrome trace of tasks")
+    p.add_argument("--address")
+    p.add_argument("--output", default="timeline.json")
+    p.set_defaults(fn=cmd_timeline)
+
+    p = sub.add_parser("dashboard", help="serve the web dashboard")
+    p.add_argument("--address")
+    p.add_argument("--port", type=int, default=8265)
+    p.set_defaults(fn=cmd_dashboard)
 
     p = sub.add_parser("job", help="cluster-hosted jobs")
     p.add_argument("job_command",

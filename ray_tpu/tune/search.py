@@ -341,5 +341,8 @@ class TPESearch(Searcher):
         if isinstance(domain, Randint):
             return int(min(domain.high - 1, max(domain.low, round(out))))
         if isinstance(domain, QUniform):
-            return round(out / domain.q) * domain.q
+            # clamp AFTER quantizing: rounding a boundary value can step
+            # outside [low, high]
+            return min(domain.high,
+                       max(domain.low, round(out / domain.q) * domain.q))
         return out

@@ -4,6 +4,7 @@ Reference: `python/ray/util/` (SURVEY.md §2.3).
 """
 
 from ray_tpu.util.metrics import Counter, Gauge, Histogram
+from ray_tpu.util.timeline import timeline
 from ray_tpu.util.state import (
     list_actors,
     list_nodes,
@@ -13,4 +14,4 @@ from ray_tpu.util.state import (
 )
 
 __all__ = ["Counter", "Gauge", "Histogram", "list_actors", "list_nodes",
-           "list_objects", "list_tasks", "summarize_tasks"]
+           "list_objects", "list_tasks", "summarize_tasks", "timeline"]

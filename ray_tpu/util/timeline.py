@@ -1,0 +1,49 @@
+"""Timeline export: task events → Chrome trace JSON.
+
+Reference: `ray timeline` (`python/ray/_private/state.py:434`
+`chrome_tracing_dump`) — profile events from the GCS task table rendered
+for chrome://tracing / Perfetto. Each task becomes a complete ("X")
+event on its owner's row, spanning SUBMITTED → FINISHED/FAILED.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+
+def timeline(filename: Optional[str] = None) -> list:
+    """Build (and optionally write) the Chrome trace for everything in
+    the GCS task table. Load the file in chrome://tracing or
+    ui.perfetto.dev."""
+    from ray_tpu.util.state import list_tasks
+
+    events = []
+    for rec in list_tasks(limit=100_000):
+        transitions = dict()
+        for state, ts in rec["events"]:
+            # keep the FIRST time each state was reached
+            transitions.setdefault(state, ts)
+        start = transitions.get("SUBMITTED")
+        end = transitions.get("FINISHED", transitions.get("FAILED"))
+        if start is None:
+            continue
+        if end is None or end < start:
+            end = start
+        events.append({
+            "name": rec["name"],
+            "cat": rec["type"],
+            "ph": "X",  # complete event
+            "ts": start * 1e6,  # chrome wants microseconds
+            "dur": max(1.0, (end - start) * 1e6),
+            "pid": "ray_tpu",
+            "tid": rec["type"],
+            "args": {
+                "task_id": rec["task_id"],
+                "state": rec["state"],
+            },
+        })
+    if filename:
+        with open(filename, "w") as f:
+            json.dump(events, f)
+    return events

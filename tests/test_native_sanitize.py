@@ -1,0 +1,28 @@
+"""Sanitizer gate for the native store (SURVEY.md §5 race detection).
+
+Builds and runs the multi-threaded create/seal/get/evict stress driver
+under AddressSanitizer — the reference's TSAN/ASAN bazel-config
+equivalent for `src/ray/object_manager/plasma/`.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+_NATIVE = os.path.join(os.path.dirname(__file__), "..", "ray_tpu",
+                       "native")
+
+
+def test_shm_store_stress_under_asan():
+    build = subprocess.run(
+        ["make", "-C", _NATIVE, "build/stress_asan"],
+        capture_output=True, text=True, timeout=300)
+    assert build.returncode == 0, build.stderr[-2000:]
+    run = subprocess.run(
+        [os.path.join(_NATIVE, "build", "stress_asan")],
+        capture_output=True, text=True, timeout=300)
+    assert run.returncode == 0, \
+        f"ASAN stress failed:\n{run.stdout[-1000:]}\n{run.stderr[-3000:]}"
+    assert "stress OK" in run.stdout
