@@ -8,8 +8,14 @@ jitted, scaled over local device meshes (GSPMD) and learner actors.
 from ray_tpu.rllib.algorithms.algorithm import Algorithm
 from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
 from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
+from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
-from ray_tpu.rllib.core.learner import DQNLearner, Learner, PPOLearner
+from ray_tpu.rllib.core.learner import (
+    DQNLearner,
+    IMPALALearner,
+    Learner,
+    PPOLearner,
+)
 from ray_tpu.rllib.core.learner_group import LearnerGroup
 from ray_tpu.rllib.core.rl_module import (
     ActorCriticModule,
@@ -26,7 +32,8 @@ from ray_tpu.rllib.env.env_runner import (
 
 __all__ = [
     "Algorithm", "AlgorithmConfig", "PPO", "PPOConfig", "DQN",
-    "DQNConfig", "Learner", "PPOLearner", "DQNLearner", "LearnerGroup",
+    "DQNConfig", "IMPALA", "IMPALAConfig", "Learner", "PPOLearner",
+    "DQNLearner", "IMPALALearner", "LearnerGroup",
     "RLModule", "RLModuleSpec", "ActorCriticModule", "QModule",
     "Columns", "EnvRunnerGroup", "SingleAgentEnvRunner", "Episode",
 ]

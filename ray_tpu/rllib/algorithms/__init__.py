@@ -1,7 +1,8 @@
 from ray_tpu.rllib.algorithms.algorithm import Algorithm
 from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
 from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
+from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
 
 __all__ = ["Algorithm", "AlgorithmConfig", "PPO", "PPOConfig", "DQN",
-           "DQNConfig"]
+           "DQNConfig", "IMPALA", "IMPALAConfig"]

@@ -92,3 +92,47 @@ def standardize_advantages(episodes: List[Episode], batch: Batch) -> Batch:
     batch[Columns.ADVANTAGES] = (adv - adv.mean()) / \
         max(1e-6, adv.std())
     return batch
+
+
+def sequence_batch(episodes: List[Episode], max_len: int = 0) -> Batch:
+    """Pad episode fragments into [B, T] row-major arrays with a
+    validity mask — the layout V-trace needs (reference: IMPALA's
+    learner queue batches of trajectories). Episodes longer than T are
+    SPLIT into chained rows (never truncated): each non-final chunk
+    bootstraps from the next chunk's first observation, the final chunk
+    carries the episode's own terminated flag and last_obs.
+    """
+    T = max_len or max(ep.length for ep in episodes)
+    rows = []  # (slice of ep, terminated, bootstrap_obs)
+    for ep in episodes:
+        for start in range(0, ep.length, T):
+            end = min(start + T, ep.length)
+            final = end == ep.length
+            boot = (ep.last_obs if ep.last_obs is not None
+                    else ep.obs[-1]) if final else ep.obs[end]
+            rows.append((ep, start, end,
+                         ep.terminated and final, boot))
+    B = len(rows)
+    obs_dim = episodes[0].obs[0].shape[-1]
+    obs = np.zeros((B, T, obs_dim), np.float32)
+    actions = np.zeros((B, T), np.int64)
+    rewards = np.zeros((B, T), np.float32)
+    logp = np.zeros((B, T), np.float32)
+    mask = np.zeros((B, T), np.float32)
+    terminated = np.zeros((B,), np.float32)
+    last_obs = np.zeros((B, obs_dim), np.float32)
+    for b, (ep, start, end, term, boot) in enumerate(rows):
+        n = end - start
+        obs[b, :n] = np.stack(ep.obs[start:end])
+        actions[b, :n] = ep.actions[start:end]
+        rewards[b, :n] = ep.rewards[start:end]
+        logp[b, :n] = ep.logps[start:end]
+        mask[b, :n] = 1.0
+        terminated[b] = float(term)
+        last_obs[b] = boot
+    return {
+        Columns.OBS: obs, Columns.ACTIONS: actions,
+        Columns.REWARDS: rewards, Columns.ACTION_LOGP: logp,
+        "mask": mask, Columns.TERMINATEDS: terminated,
+        "last_obs": last_obs,
+    }

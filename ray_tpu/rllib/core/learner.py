@@ -273,3 +273,93 @@ class DQNLearner(Learner):
         not_done = 1.0 - b[Columns.TERMINATEDS].astype(jnp.float32)
         target = b[Columns.REWARDS] + gamma * not_done * q_next
         return np.asarray(jnp.abs(q_taken - target))
+
+
+def vtrace_returns(behavior_logp, target_logp, rewards, values,
+                   bootstrap_value, mask, gamma: float,
+                   rho_clip: float = 1.0, c_clip: float = 1.0):
+    """V-trace targets (Espeholt et al. 2018; reference:
+    `rllib/algorithms/impala/vtrace_tf.py` — rebuilt in jax over [B, T]
+    row-major trajectories with a validity mask).
+
+    Returns (vs, pg_advantages), both [B, T]. Computed with a reversed
+    lax.scan — TPU-friendly, no data-dependent Python control flow.
+    """
+    ratio = jnp.exp(target_logp - behavior_logp)
+    rho = jnp.minimum(rho_clip, ratio) * mask
+    c = jnp.minimum(c_clip, ratio) * mask
+    # V(x_{t+1}): shifted values, with the bootstrap placed at each
+    # row's LAST VALID step — rows shorter than T must not bootstrap
+    # from the network's value of zero-padding
+    T = values.shape[1]
+    is_last = (jnp.arange(T)[None, :]
+               == (mask.sum(axis=1, keepdims=True) - 1))
+    next_values = jnp.concatenate(
+        [values[:, 1:], jnp.zeros_like(values[:, :1])], axis=1)
+    next_values = jnp.where(is_last, bootstrap_value[:, None],
+                            next_values)
+    # padded steps contribute no TD (mask zeroes delta AND c, so the
+    # reversed scan's accumulator stays 0 until the valid region)
+    deltas = rho * (rewards + gamma * next_values - values) * mask
+
+    def step(acc, xs):
+        delta_t, c_t = xs
+        acc = delta_t + gamma * c_t * acc
+        return acc, acc
+
+    # scan backwards over time (axis 1 -> transpose to [T, B])
+    _, corr_rev = jax.lax.scan(
+        step, jnp.zeros_like(values[:, 0]),
+        (deltas.T[::-1], c.T[::-1]))
+    corrections = corr_rev[::-1].T  # [B, T]: vs_t - V_t
+    vs = values + corrections
+    next_vs = jnp.concatenate(
+        [vs[:, 1:], jnp.zeros_like(vs[:, :1])], axis=1)
+    next_vs = jnp.where(is_last, bootstrap_value[:, None], next_vs)
+    pg_adv = rho * (rewards + gamma * next_vs - values) * mask
+    return vs, pg_adv
+
+
+class IMPALALearner(Learner):
+    """IMPALA's off-policy actor-critic loss with V-trace corrections
+    (reference: `rllib/algorithms/impala/` torch/tf policies). The
+    behavior policy's log-probs come from the (possibly stale) sampling
+    weights; importance ratios correct the lag."""
+
+    def compute_loss(self, params, batch, aux=None):
+        mask = batch["mask"]
+        B, T = mask.shape
+        obs_flat = batch[Columns.OBS].reshape(B * T, -1)
+        out = self.module.forward_train(
+            params, {Columns.OBS: obs_flat})
+        logits = out[Columns.ACTION_DIST_INPUTS].reshape(B, T, -1)
+        values = out[Columns.VF_PREDS].reshape(B, T)
+        logp_all = jax.nn.log_softmax(logits)
+        actions = batch[Columns.ACTIONS].astype(jnp.int32)
+        target_logp = jnp.take_along_axis(
+            logp_all, actions[:, :, None], axis=2)[:, :, 0]
+
+        boot_out = self.module.forward_train(
+            params, {Columns.OBS: batch["last_obs"]})
+        bootstrap = boot_out[Columns.VF_PREDS] * \
+            (1.0 - batch[Columns.TERMINATEDS])
+
+        gamma = self.config.get("gamma", 0.99)
+        vs, pg_adv = vtrace_returns(
+            batch[Columns.ACTION_LOGP], target_logp,
+            batch[Columns.REWARDS], values, bootstrap, mask, gamma,
+            self.config.get("vtrace_rho_clip", 1.0),
+            self.config.get("vtrace_c_clip", 1.0))
+        vs = jax.lax.stop_gradient(vs)
+        pg_adv = jax.lax.stop_gradient(pg_adv)
+
+        n = jnp.maximum(1.0, mask.sum())
+        policy_loss = -(target_logp * pg_adv * mask).sum() / n
+        vf_loss = (jnp.square(vs - values) * mask).sum() / n
+        probs = jax.nn.softmax(logits)
+        entropy = -((probs * logp_all).sum(-1) * mask).sum() / n
+        loss = policy_loss \
+            + self.config.get("vf_loss_coeff", 0.5) * vf_loss \
+            - self.config.get("entropy_coeff", 0.01) * entropy
+        return loss, {"policy_loss": policy_loss, "vf_loss": vf_loss,
+                      "entropy": entropy}

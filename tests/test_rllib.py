@@ -223,3 +223,124 @@ def test_algorithm_on_tune(ray_start, tmp_path):
         assert res.error is None
         assert res.metrics["training_iteration"] == 2
         assert "episode_return_mean" in res.metrics
+
+
+def test_vtrace_matches_onpolicy_gae_like_returns():
+    """With rho=c=1 and behavior == target policy, V-trace targets
+    reduce to n-step TD(lambda=1)-corrected values — check against a
+    direct numpy recursion."""
+    import jax.numpy as jnp
+
+    from ray_tpu.rllib.core.learner import vtrace_returns
+
+    rng = np.random.default_rng(0)
+    B, T = 3, 6
+    logp = rng.normal(size=(B, T)).astype(np.float32)
+    rewards = rng.normal(size=(B, T)).astype(np.float32)
+    values = rng.normal(size=(B, T)).astype(np.float32)
+    boot = rng.normal(size=(B,)).astype(np.float32)
+    mask = np.ones((B, T), np.float32)
+    gamma = 0.9
+
+    vs, pg = vtrace_returns(jnp.asarray(logp), jnp.asarray(logp),
+                            jnp.asarray(rewards), jnp.asarray(values),
+                            jnp.asarray(boot), jnp.asarray(mask), gamma)
+    # numpy reference recursion (rho = c = 1)
+    expect = np.zeros((B, T), np.float32)
+    for b in range(B):
+        acc = 0.0
+        for t in range(T - 1, -1, -1):
+            nv = boot[b] if t == T - 1 else values[b, t + 1]
+            delta = rewards[b, t] + gamma * nv - values[b, t]
+            acc = delta + gamma * acc
+            expect[b, t] = values[b, t] + acc
+    np.testing.assert_allclose(np.asarray(vs), expect, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_impala_cartpole_learns():
+    """IMPALA (stale-weight sampling + V-trace correction) improves on
+    CartPole within a bounded number of iterations."""
+    from ray_tpu.rllib import IMPALA, IMPALAConfig
+
+    cfg = (
+        IMPALAConfig()
+        .environment("CartPole-v1")
+        .env_runners(num_env_runners=0, num_envs_per_env_runner=4,
+                     rollout_fragment_length=50)
+        .training(lr=1e-3, train_batch_size=800, entropy_coeff=0.005)
+        .debugging(seed=0)
+    )
+    algo = IMPALA(config=cfg)
+    try:
+        best = 0.0
+        for _ in range(60):
+            result = algo.train()
+            r = result["episode_return_mean"]
+            if np.isfinite(r):
+                best = max(best, r)
+            if best >= 80.0:
+                break
+        # untrained policy scores ~25; 80+ demonstrates off-policy
+        # V-trace learning within the CI budget
+        assert best >= 80.0, f"IMPALA failed to learn: best={best}"
+    finally:
+        algo.stop()
+
+
+def test_vtrace_short_row_bootstraps_correctly():
+    """A row shorter than T must bootstrap at its LAST VALID step from
+    bootstrap_value — never from padded-zero observations' values."""
+    import jax.numpy as jnp
+
+    from ray_tpu.rllib.core.learner import vtrace_returns
+
+    T = 5
+    gamma = 0.9
+    # one row, 2 valid steps; padding carries a huge value that must
+    # not leak into the targets
+    values = np.array([[1.0, 2.0, 99.0, 99.0, 99.0]], np.float32)
+    rewards = np.array([[1.0, 1.0, 0.0, 0.0, 0.0]], np.float32)
+    mask = np.array([[1.0, 1.0, 0.0, 0.0, 0.0]], np.float32)
+    logp = np.zeros((1, T), np.float32)
+    boot = np.array([5.0], np.float32)
+
+    vs, pg = vtrace_returns(jnp.asarray(logp), jnp.asarray(logp),
+                            jnp.asarray(rewards), jnp.asarray(values),
+                            jnp.asarray(boot), jnp.asarray(mask), gamma)
+    # hand recursion over the 2 valid steps with bootstrap 5.0
+    d1 = 1.0 + gamma * 5.0 - 2.0
+    d0 = 1.0 + gamma * 2.0 - 1.0
+    vs1 = 2.0 + d1
+    vs0 = 1.0 + d0 + gamma * d1
+    np.testing.assert_allclose(np.asarray(vs)[0, :2], [vs0, vs1],
+                               rtol=1e-5)
+    # padded region contributes nothing to pg advantages
+    np.testing.assert_allclose(np.asarray(pg)[0, 2:], 0.0)
+
+
+def test_sequence_batch_splits_long_episodes():
+    """Episodes longer than the fragment length split into chained rows
+    that bootstrap from the next chunk — no silent truncation."""
+    from ray_tpu.rllib.connectors import sequence_batch
+    from ray_tpu.rllib.env.env_runner import Episode
+
+    ep = Episode()
+    for i in range(7):
+        ep.obs.append(np.full(3, i, np.float32))
+        ep.actions.append(i % 2)
+        ep.rewards.append(1.0)
+        ep.logps.append(-0.5)
+        ep.vf_preds.append(0.0)
+    ep.terminated = True
+    ep.last_obs = np.full(3, 99, np.float32)
+
+    batch = sequence_batch([ep], max_len=3)
+    assert batch["obs"].shape == (3, 3, 3)  # 7 steps -> 3 rows of <=3
+    assert batch["mask"].sum() == 7  # every step kept
+    # chunk 0 bootstraps from step 3's obs, not terminated
+    np.testing.assert_allclose(batch["last_obs"][0], np.full(3, 3.0))
+    assert batch["terminateds"][0] == 0.0
+    # final chunk carries the episode's own termination + last_obs
+    np.testing.assert_allclose(batch["last_obs"][2], np.full(3, 99.0))
+    assert batch["terminateds"][2] == 1.0
