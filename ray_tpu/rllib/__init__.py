@@ -7,6 +7,7 @@ jitted, scaled over local device meshes (GSPMD) and learner actors.
 
 from ray_tpu.rllib.algorithms.algorithm import Algorithm
 from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.algorithms.bc import BC, BCConfig, MARWIL, MARWILConfig
 from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
 from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
@@ -32,7 +33,8 @@ from ray_tpu.rllib.env.env_runner import (
 
 __all__ = [
     "Algorithm", "AlgorithmConfig", "PPO", "PPOConfig", "DQN",
-    "DQNConfig", "IMPALA", "IMPALAConfig", "Learner", "PPOLearner",
+    "DQNConfig", "IMPALA", "IMPALAConfig", "BC", "BCConfig", "MARWIL",
+    "MARWILConfig", "Learner", "PPOLearner",
     "DQNLearner", "IMPALALearner", "LearnerGroup",
     "RLModule", "RLModuleSpec", "ActorCriticModule", "QModule",
     "Columns", "EnvRunnerGroup", "SingleAgentEnvRunner", "Episode",

@@ -1,8 +1,10 @@
 from ray_tpu.rllib.algorithms.algorithm import Algorithm
 from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.algorithms.bc import BC, BCConfig, MARWIL, MARWILConfig
 from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
 from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
 
 __all__ = ["Algorithm", "AlgorithmConfig", "PPO", "PPOConfig", "DQN",
-           "DQNConfig", "IMPALA", "IMPALAConfig"]
+           "DQNConfig", "IMPALA", "IMPALAConfig", "BC", "BCConfig",
+           "MARWIL", "MARWILConfig"]

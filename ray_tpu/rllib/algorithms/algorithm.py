@@ -37,6 +37,9 @@ class Algorithm(Trainable):
         self._algo_config = config
         self.env_runner_group: Optional[EnvRunnerGroup] = None
         self.learner_group: Optional[LearnerGroup] = None
+        # pre-set so stop()/cleanup() are safe when setup() fails early
+        self._eval_runner = None
+        self._output_writer = None
         self._setup_called = False
         if config is not None:
             # standalone construction (config.build_algo()) — Tune-hosted
@@ -77,6 +80,10 @@ class Algorithm(Trainable):
             seed=cfg.seed, explore_config=cfg.explore_config)
         self.env_runner_group.sync_weights(
             self.learner_group.get_weights())
+        if cfg.output:
+            from ray_tpu.rllib.offline.io import JsonWriter
+            self._output_writer = JsonWriter(cfg.output)
+        self._env_creator = env_creator
         self._iteration = 0
 
     @classmethod
@@ -94,7 +101,43 @@ class Algorithm(Trainable):
         results["num_episodes"] = int(
             sum(m.get("num_episodes", 0) for m in metrics))
         results["training_iteration"] = self._iteration
+        interval = self.algo_config.evaluation_interval
+        if interval and self._iteration % interval == 0:
+            results["evaluation"] = self.evaluate()
         return results
+
+    def evaluate(self) -> Dict[str, Any]:
+        """Greedy (explore=False) rollouts with the current weights.
+
+        Reference: `Algorithm.evaluate` (`rllib/algorithms/
+        algorithm.py:1061`) — like the reference's dedicated evaluation
+        workers, this samples on a SEPARATE local runner so greedy eval
+        episodes never pollute the training runners' episode metrics or
+        interrupt their in-flight episodes.
+        """
+        from ray_tpu.rllib.env.env_runner import SingleAgentEnvRunner
+
+        if self._eval_runner is None:
+            self._eval_runner = SingleAgentEnvRunner(
+                self._env_creator, self.spec,
+                num_envs=self.algo_config.num_envs_per_env_runner,
+                seed=self.algo_config.seed + 999_983)
+        self._eval_runner.set_weights(self.learner_group.get_weights())
+        episodes = self._eval_runner.sample(
+            self.algo_config.evaluation_duration, explore=False)
+        returns = [ep.total_reward for ep in episodes if ep.terminated
+                   or ep.truncated]
+        return {
+            "episode_return_mean": (float(np.mean(returns)) if returns
+                                    else float("nan")),
+            "num_episodes": len(returns),
+        }
+
+    def record_episodes(self, episodes) -> None:
+        """Persist sampled episodes when `config.offline_data(output=)`
+        is set (reference: env-runner output writers)."""
+        if self._output_writer is not None:
+            self._output_writer.write(episodes)
 
     def train(self) -> Dict[str, Any]:
         """Standalone stepping (outside Tune): one training iteration."""
@@ -140,3 +183,6 @@ class Algorithm(Trainable):
             self.env_runner_group.stop()
         if self.learner_group is not None:
             self.learner_group.stop()
+        if self._eval_runner is not None:
+            self._eval_runner._envs.close()
+            self._eval_runner = None

@@ -38,6 +38,12 @@ class AlgorithmConfig:
         # rl module
         self.hidden: Tuple[int, ...] = (64, 64)
         self.module_class: Optional[type] = None
+        # evaluation (reference .evaluation())
+        self.evaluation_interval: int = 0  # iterations; 0 = off
+        self.evaluation_duration: int = 500  # env steps per evaluate()
+        # offline data (reference .offline_data())
+        self.input_: Any = None  # path/glob of recorded episode shards
+        self.output: Any = None  # directory to record sampled episodes
         # misc
         self.seed: int = 0
         self.extra: Dict[str, Any] = {}
@@ -98,6 +104,23 @@ class AlgorithmConfig:
             self.hidden = tuple(hidden)
         if module_class is not None:
             self.module_class = module_class
+        return self
+
+    def evaluation(self, *, evaluation_interval: Optional[int] = None,
+                   evaluation_duration: Optional[int] = None
+                   ) -> "AlgorithmConfig":
+        if evaluation_interval is not None:
+            self.evaluation_interval = evaluation_interval
+        if evaluation_duration is not None:
+            self.evaluation_duration = evaluation_duration
+        return self
+
+    def offline_data(self, *, input_: Any = None, output: Any = None
+                     ) -> "AlgorithmConfig":
+        if input_ is not None:
+            self.input_ = input_
+        if output is not None:
+            self.output = output
         return self
 
     def debugging(self, *, seed: Optional[int] = None

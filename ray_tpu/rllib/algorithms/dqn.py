@@ -70,6 +70,7 @@ class DQN(Algorithm):
         self.env_runner_group.set_explore_config({"epsilon": eps})
         episodes = self.env_runner_group.sample(
             cfg.rollout_fragment_length)
+        self.record_episodes(episodes)
         for ep in episodes:
             if ep.length:
                 self.replay.add_episode(ep)

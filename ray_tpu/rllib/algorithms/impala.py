@@ -49,6 +49,7 @@ class IMPALA(Algorithm):
                 cfg.train_batch_size)
             if ep.length
         ]
+        self.record_episodes(episodes)
         batch = sequence_batch(episodes,
                                max_len=cfg.rollout_fragment_length)
         for _ in range(cfg.extra["num_updates_per_batch"]):

@@ -56,6 +56,7 @@ class PPO(Algorithm):
     def training_step(self) -> Dict[str, Any]:
         cfg = self.algo_config
         episodes = self.env_runner_group.sample(cfg.train_batch_size)
+        self.record_episodes(episodes)
         batch = columns_from_episodes(episodes, {})
         batch = self._gae(episodes, batch)
         batch = standardize_advantages(episodes, batch)

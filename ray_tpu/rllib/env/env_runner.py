@@ -175,12 +175,13 @@ class EnvRunnerGroup:
             self.manager.foreach(
                 lambda a: a.set_explore_config.remote(explore_config))
 
-    def sample(self, num_steps: int) -> List[Episode]:
+    def sample(self, num_steps: int,
+               explore: bool = True) -> List[Episode]:
         if self.local_runner is not None:
-            return self.local_runner.sample(num_steps)
+            return self.local_runner.sample(num_steps, explore)
         per = max(1, num_steps // max(1, self.manager.num_healthy()))
         results = self.manager.foreach(
-            lambda a: a.sample.remote(per), timeout=600)
+            lambda a: a.sample.remote(per, explore), timeout=600)
         out: List[Episode] = []
         for eps in results:
             out.extend(eps)
