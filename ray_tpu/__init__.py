@@ -36,6 +36,7 @@ from ray_tpu._private.worker_api import (
     shutdown,
     wait,
 )
+from ray_tpu.runtime_context import RuntimeContext, get_runtime_context
 
 __version__ = "0.1.0"
 
@@ -50,7 +51,9 @@ __all__ = [
     "PlacementGroup",
     "PlacementGroupSchedulingStrategy",
     "RayTaskError",
+    "RuntimeContext",
     "available_resources",
+    "get_runtime_context",
     "cluster_resources",
     "get",
     "get_actor",

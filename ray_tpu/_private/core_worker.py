@@ -37,6 +37,7 @@ from ray_tpu._private.config import Config
 from ray_tpu._private.ids import ActorID, JobID, ObjectID, TaskID, WorkerID
 from ray_tpu._private.object_ref import ObjectRef, set_core_worker
 from ray_tpu._private.object_store import ObjectStore
+from ray_tpu.util import tracing
 from ray_tpu._private.rpc import (
     ClientPool,
     ConnectionLost,
@@ -860,11 +861,24 @@ class CoreWorker:
     ):
         task_id = TaskID.of(self.job_id, self.current_task_id,
                             next(self._task_counter))
+        with tracing.submit_span(name, task_mod.NORMAL_TASK) as trace_ctx:
+            return self._submit_task_traced(
+                task_id, trace_ctx, function_key, args, kwargs, name,
+                num_returns, resources, max_retries, strategy, node_id,
+                soft, placement_group_id, bundle_index, streaming,
+                runtime_env)
+
+    def _submit_task_traced(
+        self, task_id, trace_ctx, function_key, args, kwargs, name,
+        num_returns, resources, max_retries, strategy, node_id, soft,
+        placement_group_id, bundle_index, streaming, runtime_env,
+    ):
         wire_args, wire_kwargs = self._serialize_args(args, kwargs)
         spec = task_mod.TaskSpec(
             task_id=task_id.binary(),
             job_id=self.job_id.binary(),
             name=name,
+            trace_ctx=trace_ctx,
             task_type=task_mod.NORMAL_TASK,
             function_key=function_key,
             args=wire_args,
@@ -1310,11 +1324,21 @@ class CoreWorker:
     ):
         task_id = TaskID.of(self.job_id, self.current_task_id,
                             next(self._task_counter), actor_id)
+        with tracing.submit_span(method_name,
+                                 task_mod.ACTOR_TASK) as trace_ctx:
+            return self._submit_actor_task_traced(
+                actor_id, task_id, trace_ctx, method_name, args, kwargs,
+                num_returns, streaming)
+
+    def _submit_actor_task_traced(self, actor_id, task_id, trace_ctx,
+                                  method_name, args, kwargs, num_returns,
+                                  streaming):
         wire_args, wire_kwargs = self._serialize_args(args, kwargs)
         spec = task_mod.TaskSpec(
             task_id=task_id.binary(),
             job_id=self.job_id.binary(),
             name=method_name,
+            trace_ctx=trace_ctx,
             task_type=task_mod.ACTOR_TASK,
             args=wire_args,
             kwargs=wire_kwargs,
@@ -1610,6 +1634,10 @@ class CoreWorker:
         )
 
     async def _execute_task_async(self, spec: task_mod.TaskSpec):
+        with tracing.execute_span(spec):
+            return await self._execute_task_async_inner(spec)
+
+    async def _execute_task_async_inner(self, spec: task_mod.TaskSpec):
         try:
             args, kwargs = await asyncio.wrap_future(
                 asyncio.run_coroutine_threadsafe(
@@ -1636,6 +1664,10 @@ class CoreWorker:
             return self._package_error(spec, e)
 
     def execute_task(self, spec: task_mod.TaskSpec) -> dict:
+        with tracing.execute_span(spec):
+            return self._execute_task_inner(spec)
+
+    def _execute_task_inner(self, spec: task_mod.TaskSpec) -> dict:
         prev_task = self.current_task_id
         self.current_task_id = TaskID(spec.task_id)
         try:

@@ -23,6 +23,7 @@ from typing import Any, Dict, List, Optional
 
 from ray_tpu._private import task as task_mod
 from ray_tpu._private.config import Config
+from ray_tpu.util import events as export_events
 from ray_tpu._private.rpc import ClientPool, ConnectionLost, RpcError, RpcServer
 from ray_tpu._private.scheduling import (
     ClusterView,
@@ -251,6 +252,10 @@ class GcsServer:
                               req["available"],
                               labels=req.get("labels", {}))
         self._last_heartbeat[node_id] = time.monotonic()
+        export_events.report(
+            "GCS", "INFO", "NODE_ADDED",
+            f"node {node_id.hex()[:8]} joined",
+            node_id=node_id.hex(), raylet_addr=req["raylet_addr"])
         await self.publish("nodes", {"event": "added", "node": self.nodes[node_id]})
         self._retry_wakeup.set()
         return {"ok": True}
@@ -403,6 +408,10 @@ class GcsServer:
         node["alive"] = False
         self.view.remove_node(node_id)
         logger.warning("node %s dead: %s", node_id.hex()[:8], reason)
+        export_events.report(
+            "GCS", "ERROR", "NODE_DEAD",
+            f"node {node_id.hex()[:8]} dead: {reason}",
+            node_id=node_id.hex(), reason=reason)
         await self.publish("nodes", {"event": "removed", "node_id": node_id,
                                      "reason": reason})
         # Fail over actors that lived on that node.
@@ -464,6 +473,9 @@ class GcsServer:
             if info["job_id"] == job_id and not info.get("detached") \
                     and info["state"] != DEAD:
                 await self._kill_actor(actor_id, "job finished")
+        export_events.report(
+            "GCS", "INFO", "JOB_FINISHED",
+            f"job {job_id.hex()[:8]} finished", job_id=job_id.hex())
         await self.publish("jobs", {"event": "finished", "job_id": job_id})
         return {"ok": True}
 
@@ -630,7 +642,14 @@ class GcsServer:
         if info is None or info["state"] == DEAD:
             return
         restarts = info["max_restarts"]
-        if restarts == -1 or info["num_restarts"] < restarts:
+        will_restart = restarts == -1 or info["num_restarts"] < restarts
+        export_events.report(
+            "GCS", "WARNING",
+            "ACTOR_RESTARTING" if will_restart else "ACTOR_DEAD",
+            f"actor {actor_id.hex()[:8]} failed: {reason}",
+            actor_id=actor_id.hex(), reason=reason,
+            num_restarts=info["num_restarts"])
+        if will_restart:
             info["num_restarts"] += 1
             info["state"] = RESTARTING
             info["addr"] = None

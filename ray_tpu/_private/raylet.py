@@ -340,6 +340,13 @@ class Raylet:
                     self._dispatch()
 
     async def _on_worker_death(self, worker: WorkerHandle):
+        from ray_tpu.util import events as export_events
+
+        export_events.report(
+            "RAYLET", "WARNING", "WORKER_DIED",
+            f"worker process {worker.pid} exited",
+            worker_id=worker.worker_id.hex(), pid=worker.pid,
+            node_id=self.node_id.hex())
         worker.alive = False
         self._workers.pop(worker.worker_id, None)
         self.unassigned_chips.extend(worker.tpu_chips)

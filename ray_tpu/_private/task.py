@@ -67,6 +67,9 @@ class TaskSpec:
     # reports each yielded item to the owner as it is produced
     # (reference: ReportGeneratorItemReturns, core_worker.proto:462).
     streaming: bool = False
+    # Tracing context {trace_id, span_id} propagated submitter → executor
+    # (reference: span context in task metadata, tracing_helper.py:326).
+    trace_ctx: Optional[dict] = None
 
     def to_wire(self) -> dict:
         return {
@@ -97,6 +100,7 @@ class TaskSpec:
             "detached": self.detached,
             "actor_name": self.actor_name,
             "streaming": self.streaming,
+            "trace_ctx": self.trace_ctx,
         }
 
     @classmethod

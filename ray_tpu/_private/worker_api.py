@@ -98,11 +98,13 @@ def init(
             head = cluster.head_node
             raylet_addr = head.raylet_addr
             store_name = head.store_name
+            node_id_hex = head.node_id_hex
         else:
             cluster = None
             owns = False
             gcs_addr = address
-            raylet_addr, store_name = _discover_local_raylet(address)
+            raylet_addr, store_name, node_id_hex = \
+                _discover_local_raylet(address)
 
         job_id = JobID.from_random()
         store = ObjectStore.attach(store_name)
@@ -112,6 +114,7 @@ def init(
             raylet_addr=raylet_addr,
             job_id=job_id,
             store=store,
+            node_id_hex=node_id_hex,
             config=cfg,
         )
         cw.start()
@@ -170,7 +173,9 @@ def _discover_local_raylet(gcs_addr: str):
         return reply
 
     reply = asyncio.run(info(node["raylet_addr"]))
-    return node["raylet_addr"], reply["store_name"]
+    return (node["raylet_addr"], reply["store_name"],
+            node["node_id"].hex() if isinstance(node.get("node_id"), bytes)
+            else str(node.get("node_id", "")))
 
 
 def shutdown():

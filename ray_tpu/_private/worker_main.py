@@ -60,6 +60,8 @@ def main():
         renv_mod.materialize(
             cw, env_wire,
             os.path.join(args.session_dir, "runtime_envs"))
+        # introspectable via ray_tpu.get_runtime_context()
+        cw.current_runtime_env = env_wire
 
     async def register():
         from ray_tpu._private import runtime_env as renv_mod

@@ -98,6 +98,9 @@ class HostGroup:
         # Per-tag round counters: every rank calls collectives in the same
         # order (SPMD), so suffixing the round number lets tags be reused.
         self._rounds = collections.defaultdict(int)
+        # self-send FIFOs, one per tag (send/recv to own rank never
+        # touches the rendezvous actor)
+        self._loopback = collections.defaultdict(collections.deque)
         if rank == 0:
             # Barrier semantics need all members' calls in flight at once.
             self._actor = _Rendezvous.options(
@@ -145,6 +148,71 @@ class HostGroup:
             timeout=300,
         )
 
+    def allgather(self, value, tag: str = "gather"):
+        """Every rank receives [value_0, ..., value_{world-1}] in rank
+        order (reference `collective.allgather`, GLOO host path)."""
+        import ray_tpu
+
+        return ray_tpu.get(
+            self._actor.gather.remote(self._round_tag(tag), self.rank,
+                                      value),
+            timeout=300,
+        )
+
+    def reducescatter_sum(self, value, tag: str = "rs"):
+        """Sum across ranks, then each rank keeps its 1/world_size shard
+        along axis 0 (reference `collective.reducescatter`). `value` must
+        be an array with leading dim divisible by world_size."""
+        import numpy as np
+
+        value = np.asarray(value)
+        if value.shape[0] % self.world_size:
+            raise ValueError(
+                f"reducescatter: leading dim {value.shape[0]} not "
+                f"divisible by world_size {self.world_size}")
+        total = self.allreduce_sum(value, tag=tag)
+        return np.array_split(total, self.world_size, axis=0)[self.rank]
+
+    # -- point-to-point (reference `collective.send/recv`) -----------------
+
+    def _p2p_tag(self, src: int, dst: int, tag: str) -> str:
+        key = (src, dst, tag)
+        n = self._rounds[key]
+        self._rounds[key] += 1
+        return f"p2p:{src}->{dst}:{tag}#{n}"
+
+    def send(self, value, dst: int, tag: str = "p2p"):
+        """Deliver `value` to rank `dst` (non-blocking handoff through
+        the rendezvous actor; pairs with exactly one recv). A self-send
+        (dst == rank) short-circuits through a local FIFO — both sides
+        of the pair live in this process, so the round counters would
+        otherwise never match."""
+        if dst == self.rank:
+            self._loopback[tag].append(value)
+            return
+        import ray_tpu
+
+        ray_tpu.get(
+            self._actor.put.remote(self._p2p_tag(self.rank, dst, tag),
+                                   value),
+            timeout=300)
+
+    def recv(self, src: int, tag: str = "p2p"):
+        """Block until the matching send from rank `src` arrives."""
+        if src == self.rank:
+            # both ends live on this thread: a recv with no prior send
+            # could only deadlock, so fail loudly instead
+            if not self._loopback[tag]:
+                raise ValueError(
+                    f"recv(src=rank) with no prior send(dst=rank) for "
+                    f"tag {tag!r} — a self-recv cannot block")
+            return self._loopback[tag].popleft()
+        import ray_tpu
+
+        return ray_tpu.get(
+            self._actor.take_pop.remote(self._p2p_tag(src, self.rank, tag)),
+            timeout=300)
+
 
 try:
     import ray_tpu as _ray_tpu
@@ -159,6 +227,7 @@ try:
             self.events = {}
             self.counts = {}
             self.reduced = {}
+            self.consumed = {}
             self._asyncio = asyncio
 
         def _event(self, tag):
@@ -166,22 +235,61 @@ try:
                 self.events[tag] = self._asyncio.Event()
             return self.events[tag]
 
+        def _release(self, key, readers: int):
+            """Free a round's state once every expected reader has
+            taken its result — long-lived groups must not accumulate
+            one entry per collective round."""
+            self.consumed[key] = self.consumed.get(key, 0) + 1
+            if self.consumed[key] >= readers:
+                self.consumed.pop(key, None)
+                self.counts.pop(key, None)
+                self.events.pop(key, None)
+                self.values.pop(key, None)
+                self.reduced.pop(key, None)
+
         async def barrier(self, tag, rank):
             key = ("b", tag)
             self.counts[key] = self.counts.get(key, 0) + 1
             if self.counts[key] >= self.world:
                 self._event(key).set()
             await self._event(key).wait()
+            self._release(key, self.world)
             return True
 
         async def put(self, tag, value):
+            if self.world == 1:
+                return True  # no takers would ever free the slot
             self.values[tag] = value
             self._event(("v", tag)).set()
             return True
 
         async def take(self, tag):
+            """Multi-consumer take (broadcast: world-1 non-root readers)."""
             await self._event(("v", tag)).wait()
-            return self.values[tag]
+            value = self.values[tag]
+            self.consumed[tag] = self.consumed.get(tag, 0) + 1
+            if self.consumed[tag] >= self.world - 1:
+                self.consumed.pop(tag, None)
+                self.events.pop(("v", tag), None)
+                self.values.pop(tag, None)
+            return value
+
+        async def take_pop(self, tag):
+            """Single-consumer take: frees the slot (p2p recv)."""
+            await self._event(("v", tag)).wait()
+            self.events.pop(("v", tag), None)
+            return self.values.pop(tag)
+
+        async def gather(self, tag, rank, value):
+            key = ("g", tag)
+            self.values.setdefault(key, {})[rank] = value
+            if len(self.values[key]) >= self.world:
+                self._event(key).set()
+            await self._event(key).wait()
+            vals = self.values[key]
+            out = [vals[r] for r in range(self.world)]
+            self._release(key, self.world)
+            return out
 
         async def reduce(self, tag, rank, value):
             key = ("r", tag)
@@ -195,6 +303,8 @@ try:
             if self.counts[key] >= self.world:
                 self._event(key).set()
             await self._event(key).wait()
-            return self.reduced[key]
+            out = self.reduced[key]
+            self._release(key, self.world)
+            return out
 except Exception:  # pragma: no cover - import-order edge in workers
     _Rendezvous = None

@@ -195,6 +195,32 @@ def cmd_timeline(args):
         ray_tpu.shutdown()
 
 
+def cmd_events(args):
+    # offline read of the structured event shards — no cluster needed
+    from ray_tpu.util.events import list_events
+
+    evs = list_events(source=args.source, severity=args.severity,
+                      label=args.label)
+    for ev in evs[-args.limit:]:
+        import datetime
+
+        ts = datetime.datetime.fromtimestamp(ev["ts"]).strftime(
+            "%H:%M:%S")
+        print(f"{ts} [{ev['severity']:7}] {ev['source']:11} "
+              f"{ev['label']:18} {ev['message']}")
+    print(f"({len(evs)} events total)")
+
+
+def cmd_trace(args):
+    # offline merge of per-process span shards — no cluster needed
+    from ray_tpu.util import tracing
+
+    spans = tracing.collect(args.trace_dir)
+    tracing.to_chrome(spans, args.output)
+    print(f"merged {len(spans)} spans from {args.trace_dir or tracing.trace_dir()} "
+          f"-> {args.output} (open in chrome://tracing)")
+
+
 def cmd_dashboard(args):
     ray_tpu = _connect(args)
     from ray_tpu.dashboard import start_dashboard
@@ -293,6 +319,19 @@ def main(argv=None):
     p.add_argument("--address")
     p.add_argument("--output", default="timeline.json")
     p.set_defaults(fn=cmd_timeline)
+
+    p = sub.add_parser("events", help="list structured cluster events")
+    p.add_argument("--source")
+    p.add_argument("--severity")
+    p.add_argument("--label")
+    p.add_argument("--limit", type=int, default=100)
+    p.set_defaults(fn=cmd_events)
+
+    p = sub.add_parser("trace",
+                       help="merge tracing spans into a Chrome trace")
+    p.add_argument("--trace-dir", default=None)
+    p.add_argument("--output", default="trace.json")
+    p.set_defaults(fn=cmd_trace)
 
     p = sub.add_parser("dashboard", help="serve the web dashboard")
     p.add_argument("--address")

@@ -1,0 +1,95 @@
+"""Structured export events: cluster lifecycle events as durable JSONL.
+
+Reference: `src/ray/util/event.h` — the reference's structured event
+framework gives every component a severity/label/source-tagged event
+stream written to per-component files under the session dir, surfaced
+by `ray list cluster-events` and the dashboard. Same design here: one
+JSONL shard per (source, pid), a module-level `report()` used by the
+GCS/raylet daemons at lifecycle transitions (node up/down, actor
+restart, worker crash, job finished), and `list_events()` merging all
+shards for the CLI/dashboard.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+SEVERITIES = ("DEBUG", "INFO", "WARNING", "ERROR", "FATAL")
+
+_lock = threading.Lock()
+_files: Dict[str, Any] = {}
+
+
+def event_dir() -> str:
+    return os.environ.get("RAY_TPU_EVENT_DIR", "/tmp/ray_tpu/events")
+
+
+def _writer(source: str):
+    f = _files.get(source)
+    if f is None:
+        with _lock:
+            f = _files.get(source)
+            if f is None:
+                os.makedirs(event_dir(), exist_ok=True)
+                f = open(
+                    os.path.join(
+                        event_dir(),
+                        f"event_{source}_{os.getpid()}.jsonl"),
+                    "a", buffering=1)
+                _files[source] = f
+    return f
+
+
+def report(source: str, severity: str, label: str, message: str,
+           **fields: Any) -> dict:
+    """Record one structured event (never raises — observability must
+    not take down the daemon emitting it)."""
+    if severity not in SEVERITIES:  # coerce, consistent with no-raise
+        severity = "INFO"
+    ev = {
+        "ts": time.time(),
+        "source": source,          # GCS | RAYLET | CORE_WORKER | ...
+        "severity": severity,
+        "label": label,            # stable machine key, e.g. NODE_DEAD
+        "message": message,
+        "pid": os.getpid(),
+        **fields,
+    }
+    try:
+        _writer(source).write(json.dumps(ev) + "\n")
+    except (OSError, TypeError):
+        pass
+    return ev
+
+
+def list_events(source: Optional[str] = None,
+                severity: Optional[str] = None,
+                label: Optional[str] = None,
+                path: Optional[str] = None) -> List[dict]:
+    """Merge every shard, oldest first, with optional filters
+    (reference `ray list cluster-events` semantics)."""
+    out: List[dict] = []
+    pattern = os.path.join(path or event_dir(),
+                           f"event_{source or '*'}_*.jsonl")
+    for fn in sorted(glob.glob(pattern)):
+        try:
+            with open(fn) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    ev = json.loads(line)
+                    if severity and ev.get("severity") != severity:
+                        continue
+                    if label and ev.get("label") != label:
+                        continue
+                    out.append(ev)
+        except (OSError, json.JSONDecodeError):
+            continue
+    out.sort(key=lambda e: e.get("ts", 0))
+    return out

@@ -1,0 +1,176 @@
+"""Distributed tracing: spans around task submit/execute with context
+propagation through TaskSpec.
+
+Reference: `python/ray/util/tracing/tracing_helper.py:326,450` — the
+reference wraps every remote function/actor method in OpenTelemetry
+spans and propagates the span context in task metadata so cross-process
+traces stitch together. Same design here without the otel dependency:
+spans are plain dicts written as JSONL per process (zero deps, zero
+cost when disabled), trace/parent ids ride `TaskSpec.trace_ctx`, and
+`collect()`/`to_chrome()` merge per-process shards into one
+chrome://tracing view.
+
+Enable with `RAY_TPU_TRACE=1` (optionally `RAY_TPU_TRACE_DIR=...`);
+every process of the cluster inherits the env through the daemons.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import json
+import os
+import threading
+import time
+import uuid
+from typing import Any, Dict, Iterator, List, Optional
+
+_current: contextvars.ContextVar[Optional[dict]] = contextvars.ContextVar(
+    "ray_tpu_trace_span", default=None)
+
+_lock = threading.Lock()
+_file = None
+
+
+def enabled() -> bool:
+    return os.environ.get("RAY_TPU_TRACE", "") in ("1", "true", "on")
+
+
+def trace_dir() -> str:
+    return os.environ.get("RAY_TPU_TRACE_DIR", "/tmp/ray_tpu/traces")
+
+
+def _writer():
+    global _file
+    if _file is None:
+        with _lock:
+            if _file is None:
+                os.makedirs(trace_dir(), exist_ok=True)
+                _file = open(
+                    os.path.join(trace_dir(), f"trace-{os.getpid()}.jsonl"),
+                    "a", buffering=1)  # line-buffered: crash-safe
+    return _file
+
+
+def _new_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+@contextlib.contextmanager
+def span(name: str, kind: str = "internal",
+         parent: Optional[Dict[str, str]] = None,
+         attrs: Optional[Dict[str, Any]] = None) -> Iterator[dict]:
+    """Record one span; nests under the context-local current span
+    unless an explicit cross-process `parent` ctx is given."""
+    if not enabled():
+        yield {}
+        return
+    cur = _current.get()
+    if parent is None and cur is not None:
+        parent = {"trace_id": cur["trace_id"], "span_id": cur["span_id"]}
+    s = {
+        "trace_id": (parent or {}).get("trace_id") or _new_id(),
+        "span_id": _new_id(),
+        "parent_id": (parent or {}).get("span_id"),
+        "name": name,
+        "kind": kind,
+        "pid": os.getpid(),
+        "start": time.time(),
+        "attrs": dict(attrs or {}),
+    }
+    token = _current.set(s)
+    try:
+        yield s
+    except Exception as e:
+        s["attrs"]["error"] = type(e).__name__
+        raise
+    finally:
+        _current.reset(token)
+        s["end"] = time.time()
+        try:
+            _writer().write(json.dumps(s) + "\n")
+        except OSError:  # tracing must never break the task path
+            pass
+
+
+def current_context() -> Optional[Dict[str, str]]:
+    """Wire form of the current span (to stuff into a TaskSpec)."""
+    cur = _current.get()
+    if cur is None:
+        return None
+    return {"trace_id": cur["trace_id"], "span_id": cur["span_id"]}
+
+
+@contextlib.contextmanager
+def submit_span(task_name: str, task_type: str):
+    """Producer-side span; yields the ctx dict to ship in the spec
+    (None when tracing is off — zero wire overhead)."""
+    if not enabled():
+        yield None
+        return
+    with span(f"{task_name}.remote", kind="producer",
+              attrs={"task_type": task_type}) as s:
+        yield {"trace_id": s["trace_id"], "span_id": s["span_id"]}
+
+
+@contextlib.contextmanager
+def execute_span(spec) -> Iterator:
+    """Consumer-side span parented on the submitter's ctx."""
+    if not enabled():
+        yield
+        return
+    parent = getattr(spec, "trace_ctx", None)
+    with span(f"{spec.name}.execute", kind="consumer", parent=parent,
+              attrs={"task_type": spec.task_type,
+                     "task_id": spec.task_id.hex()}):
+        yield
+
+
+# -- aggregation ---------------------------------------------------------
+
+def collect(path: Optional[str] = None) -> List[dict]:
+    """Merge every process's span shard (sorted by start time)."""
+    import glob
+
+    spans = []
+    for fn in sorted(glob.glob(os.path.join(path or trace_dir(),
+                                            "trace-*.jsonl"))):
+        with open(fn) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    spans.append(json.loads(line))
+    spans.sort(key=lambda s: s["start"])
+    return spans
+
+
+def to_chrome(spans: List[dict], filename: Optional[str] = None) -> list:
+    """Chrome-trace view: one complete event per span, rows = processes,
+    flow arrows producer → consumer (chrome 's'/'f' flow events)."""
+    events = []
+    for s in spans:
+        events.append({
+            "name": s["name"], "cat": s["kind"], "ph": "X",
+            "ts": s["start"] * 1e6,
+            "dur": max(1.0, (s.get("end", s["start"]) - s["start"]) * 1e6),
+            "pid": s["pid"], "tid": s["trace_id"][:8],
+            "args": {k: str(v) for k, v in s.get("attrs", {}).items()},
+        })
+        if s.get("parent_id"):
+            # flow arrow from the parent span's row
+            events.append({
+                "name": "flow", "cat": "trace", "ph": "f", "bp": "e",
+                "id": s["parent_id"], "ts": s["start"] * 1e6,
+                "pid": s["pid"], "tid": s["trace_id"][:8],
+            })
+        if s["kind"] == "producer":
+            events.append({
+                "name": "flow", "cat": "trace", "ph": "s",
+                "id": s["span_id"],
+                "ts": s["start"] * 1e6,
+                "pid": s["pid"], "tid": s["trace_id"][:8],
+            })
+    if filename:
+        with open(filename, "w") as f:
+            json.dump(events, f)
+    return events

@@ -231,3 +231,46 @@ def test_actor_seq_epoch_resync():
         assert stub.dispatched[-1] == (2, 2)
 
     asyncio.run(run())
+
+
+def test_runtime_context():
+    """ray_tpu.get_runtime_context() exposes job/node/worker identity on
+    the driver and task/actor ids inside workers (reference
+    `python/ray/runtime_context.py`)."""
+    import ray_tpu
+
+    ctx = ray_tpu.get_runtime_context()
+    assert ctx.get_worker_mode() == "driver"
+    assert ctx.get_task_id() is None
+    assert len(ctx.get_job_id()) > 0
+    assert len(ctx.get_node_id()) > 0
+    assert len(ctx.get_worker_id()) > 0
+    assert ":" in ctx.gcs_address
+    d = ctx.get()
+    assert d["worker_mode"] == "driver"
+    assert d["job_id"] == ctx.get_job_id()
+
+    @ray_tpu.remote
+    def task_ctx():
+        c = ray_tpu.get_runtime_context()
+        return {"mode": c.get_worker_mode(), "task_id": c.get_task_id(),
+                "actor_id": c.get_actor_id(), "job_id": c.get_job_id()}
+
+    info = ray_tpu.get(task_ctx.remote())
+    assert info["mode"] == "worker"
+    assert info["task_id"] is not None
+    assert info["actor_id"] is None
+    assert info["job_id"] == ctx.get_job_id()
+
+    @ray_tpu.remote
+    class A:
+        def ctx(self):
+            c = ray_tpu.get_runtime_context()
+            return {"actor_id": c.get_actor_id(),
+                    "task_id": c.get_task_id()}
+
+    a = A.remote()
+    info = ray_tpu.get(a.ctx.remote())
+    assert info["actor_id"] is not None
+    assert info["task_id"] is not None
+    ray_tpu.kill(a)

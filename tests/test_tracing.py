@@ -1,0 +1,85 @@
+"""Tracing tests: spans around submit/execute stitch into one trace.
+
+Reference ground: `python/ray/tests/test_tracing.py` — remote task and
+actor-method calls produce `.remote` (producer) and `.execute`
+(consumer) spans that share a trace id across processes.
+"""
+
+import os
+
+import pytest
+
+
+def test_task_and_actor_spans(tmp_path):
+    trace_dir = str(tmp_path / "traces")
+    os.environ["RAY_TPU_TRACE"] = "1"
+    os.environ["RAY_TPU_TRACE_DIR"] = trace_dir
+    import ray_tpu
+    from ray_tpu.util import tracing
+
+    ray_tpu.init(num_cpus=2, object_store_memory=64 * 1024 * 1024)
+    try:
+        @ray_tpu.remote
+        def traced_fn(x):
+            return x * 2
+
+        assert ray_tpu.get(traced_fn.remote(21)) == 42
+
+        @ray_tpu.remote
+        class TracedActor:
+            def method(self, x):
+                return x + 1
+
+        a = TracedActor.remote()
+        assert ray_tpu.get(a.method.remote(1)) == 2
+        ray_tpu.kill(a)
+        import time
+
+        time.sleep(0.5)  # line-buffered shard flush
+    finally:
+        ray_tpu.shutdown()
+        os.environ.pop("RAY_TPU_TRACE", None)
+        os.environ.pop("RAY_TPU_TRACE_DIR", None)
+
+    spans = tracing.collect(trace_dir)
+    by_name = {}
+    for s in spans:
+        by_name.setdefault(s["name"], []).append(s)
+
+    # producer span on the driver, consumer span in the worker process,
+    # linked by trace_id + parent_id
+    assert "traced_fn.remote" in by_name
+    assert "traced_fn.execute" in by_name
+    sub = by_name["traced_fn.remote"][0]
+    ex = by_name["traced_fn.execute"][0]
+    assert ex["trace_id"] == sub["trace_id"]
+    assert ex["parent_id"] == sub["span_id"]
+    assert ex["pid"] != sub["pid"]  # crossed a process boundary
+    assert ex["attrs"]["task_type"] == "normal"
+
+    # actor method call traced the same way
+    assert "method.remote" in by_name and "method.execute" in by_name
+    m_sub = by_name["method.remote"][0]
+    m_ex = by_name["method.execute"][0]
+    assert m_ex["trace_id"] == m_sub["trace_id"]
+    assert m_ex["attrs"]["task_type"] == "actor"
+
+    # chrome export is well-formed
+    events = tracing.to_chrome(spans)
+    assert any(e["ph"] == "X" for e in events)
+    assert any(e["ph"] == "s" for e in events)  # flow arrows
+
+
+def test_tracing_disabled_is_free(tmp_path):
+    """With tracing off, no shard files appear and spans are no-ops."""
+    from ray_tpu.util import tracing
+
+    os.environ.pop("RAY_TPU_TRACE", None)
+    os.environ["RAY_TPU_TRACE_DIR"] = str(tmp_path / "none")
+    try:
+        with tracing.span("x") as s:
+            assert s == {}
+        assert tracing.current_context() is None
+        assert not os.path.exists(str(tmp_path / "none"))
+    finally:
+        os.environ.pop("RAY_TPU_TRACE_DIR", None)
