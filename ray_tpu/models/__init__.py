@@ -1,8 +1,19 @@
 """Model zoo, TPU-first: bfloat16 by default, logical-axis-annotated
-parameters (DP/FSDP/TP/SP shardings applied by the trainer), remat-friendly
-blocks, pluggable attention (dense / ring / Ulysses)."""
+parameters (DP/FSDP/TP/SP/EP shardings applied by the trainer),
+remat-friendly blocks, pluggable attention (dense / ring / Ulysses).
+
+Families: GPT-2 decoders (`gpt`), Llama-style decoders with
+RoPE/SwiGLU/GQA (`llama`), MoE decoders (`moe_gpt`), ResNet convnets
+(`resnet`), Vision Transformers (`vit`).
+"""
 
 from ray_tpu.models.gpt import GPT, GPTConfig
+from ray_tpu.models.llama import Llama, LlamaConfig
+from ray_tpu.models.moe_gpt import MoEGPT, MoEGPTConfig
 from ray_tpu.models.resnet import ResNet, ResNetConfig
+from ray_tpu.models.vit import ViT, ViTConfig
 
-__all__ = ["GPT", "GPTConfig", "ResNet", "ResNetConfig"]
+__all__ = [
+    "GPT", "GPTConfig", "Llama", "LlamaConfig", "MoEGPT", "MoEGPTConfig",
+    "ResNet", "ResNetConfig", "ViT", "ViTConfig",
+]

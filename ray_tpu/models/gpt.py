@@ -117,13 +117,19 @@ class Block(nn.Module):
 
 class GPT(nn.Module):
     """Decoder-only LM. `attention_fn` lets the trainer swap in ring/Ulysses
-    attention bound to its mesh for sequence parallelism."""
+    attention bound to its mesh for sequence parallelism.
+
+    `return_hidden=True` skips the LM head and returns
+    `(hidden [B,T,D], wte [V,D])` for the memory-efficient chunked loss
+    (`chunked_cross_entropy`) — the full [B,T,V] logits tensor
+    (f32: 6 GiB at batch 32, seq 1024) never exists in HBM."""
 
     config: GPTConfig
     attention_fn: Optional[Callable] = None
 
     @nn.compact
-    def __call__(self, tokens, deterministic: bool = True):
+    def __call__(self, tokens, deterministic: bool = True,
+                 return_hidden: bool = False):
         cfg = self.config
         b, t = tokens.shape
         wte = self.param(
@@ -159,6 +165,8 @@ class GPT(nn.Module):
                          bias_init=nn.with_partitioning(
                              nn.initializers.zeros, ("norm",)),
                          name="ln_f")(x)
+        if return_hidden:
+            return x, wte
         # Tied LM head: logits = x @ wte^T (the vocab axis shards over tp).
         logits = jnp.einsum("btd,vd->btv", x, wte.astype(cfg.dtype))
         return logits
@@ -172,6 +180,50 @@ def cross_entropy_loss(logits, targets, ignore_index: int = -1):
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     return (nll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+
+
+def chunked_cross_entropy(hidden, wte, targets, ignore_index: int = -1,
+                          chunk_size: int = 128):
+    """LM-head + token NLL computed blockwise over the sequence.
+
+    A lax.scan keeps exactly one [B, chunk, V] logits block live (f32)
+    instead of the whole [B, T, V] tensor — the dominant HBM temp of LM
+    training (6N-param GPT-2 at batch 32 would need 6 GiB for it). Same
+    math as `cross_entropy_loss(model.apply(...), targets)` on the full
+    logits; backward rematerializes per chunk inside the scan.
+    """
+    B, T, D = hidden.shape
+    n = T // chunk_size
+    rem = T - n * chunk_size
+    dtype = hidden.dtype
+    wte_c = wte.astype(dtype)
+
+    def block_nll(h_blk, t_blk):
+        logits = jnp.einsum("bcd,vd->bcv", h_blk, wte_c)
+        logits = logits.astype(jnp.float32)
+        mask = (t_blk != ignore_index).astype(jnp.float32)
+        tt = jnp.maximum(t_blk, 0)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, tt[..., None], axis=-1)[..., 0]
+        return (nll * mask).sum(), mask.sum()
+
+    total, count = jnp.asarray(0.0), jnp.asarray(0.0)
+    if n:
+        h = hidden[:, :n * chunk_size].reshape(B, n, chunk_size, D)
+        t = targets[:, :n * chunk_size].reshape(B, n, chunk_size)
+
+        def body(carry, xt):
+            s, c = block_nll(*xt)
+            return (carry[0] + s, carry[1] + c), None
+
+        (total, count), _ = jax.lax.scan(
+            body, (total, count),
+            (h.transpose(1, 0, 2, 3), t.transpose(1, 0, 2)))
+    if rem:  # sequence not divisible by chunk_size: one tail block
+        s, c = block_nll(hidden[:, n * chunk_size:],
+                         targets[:, n * chunk_size:])
+        total, count = total + s, count + c
+    return total / jnp.maximum(count, 1.0)
 
 
 def count_params(params) -> int:

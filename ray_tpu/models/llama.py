@@ -1,0 +1,203 @@
+"""Llama-family decoder-only transformer, TPU-first.
+
+Modern LM architecture (RMSNorm, rotary embeddings, SwiGLU MLP, grouped
+-query attention) complementing the GPT-2 family in `gpt.py`. The
+reference framework ships no model zoo of its own (models arrive via
+torch/HF integrations, e.g. `python/ray/train/huggingface/`); here the
+zoo is native Flax with the same logical-axis annotations as `gpt.py`,
+so every `parallel/` sharding strategy (DP/FSDP/TP/SP) applies to this
+family unchanged.
+
+Design notes:
+- GQA: `n_kv_head <= n_head`; K/V heads are repeated query-side groups.
+  KV projections shard over the same "heads" logical axis.
+- RoPE is computed in float32 and applied per-head (precision matters
+  for long sequences); cos/sin tables are closed-over constants folded
+  by XLA, not params.
+- SwiGLU: gate/up projections fused into one matmul (MXU-friendlier
+  than two small ones), split on the last axis.
+- `attention_fn` pluggable exactly like GPT: ring/Ulysses attention for
+  sequence parallelism binds here.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.models.gpt import _dense as _gpt_dense
+from ray_tpu.parallel.ring_attention import full_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    n_layer: int = 12
+    n_head: int = 12
+    n_kv_head: int = 4          # GQA group count (== n_head -> MHA)
+    d_model: int = 768
+    ffn_mult: float = 8 / 3     # SwiGLU hidden = ffn_mult * d_model
+    max_seq_len: int = 2048
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    remat: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_head
+
+    @property
+    def ffn_dim(self) -> int:
+        # round to a multiple of 128 so the MXU tiles cleanly
+        d = int(self.ffn_mult * self.d_model)
+        return ((d + 127) // 128) * 128
+
+    @classmethod
+    def llama_125m(cls, **kw):
+        return cls(n_layer=12, n_head=12, n_kv_head=4, d_model=768, **kw)
+
+    @classmethod
+    def tiny(cls, **kw):
+        kw.setdefault("vocab_size", 512)
+        kw.setdefault("max_seq_len", 128)
+        kw.setdefault("n_kv_head", 2)
+        return cls(n_layer=2, n_head=4, d_model=64, **kw)
+
+
+class RMSNorm(nn.Module):
+    eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param(
+            "scale",
+            nn.with_partitioning(nn.initializers.ones, ("norm",)),
+            (x.shape[-1],), self.param_dtype)
+        x32 = x.astype(jnp.float32)
+        var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+        y = x32 * jax.lax.rsqrt(var + self.eps)
+        return (y * scale.astype(jnp.float32)).astype(self.dtype)
+
+
+def rope_tables(seq_len: int, head_dim: int, theta: float):
+    """(cos, sin) float32 tables [T, head_dim/2]."""
+    freqs = 1.0 / (theta ** (
+        np.arange(0, head_dim, 2, dtype=np.float32) / head_dim))
+    t = np.arange(seq_len, dtype=np.float32)
+    ang = np.outer(t, freqs)
+    return jnp.asarray(np.cos(ang)), jnp.asarray(np.sin(ang))
+
+
+def apply_rope(x, cos, sin):
+    """Rotate pairs of channels; x: [B, T, H, D] with D even."""
+    x32 = x.astype(jnp.float32)
+    x1, x2 = jnp.split(x32, 2, axis=-1)
+    c = cos[None, :x.shape[1], None, :]
+    s = sin[None, :x.shape[1], None, :]
+    return jnp.concatenate(
+        [x1 * c - x2 * s, x2 * c + x1 * s], axis=-1).astype(x.dtype)
+
+
+def _dense(features, logical_axes, name, cfg):
+    # Llama uses bias-free projections throughout
+    return _gpt_dense(features, logical_axes, name, cfg, use_bias=False)
+
+
+class LlamaBlock(nn.Module):
+    config: LlamaConfig
+    attention_fn: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):
+        cfg = self.config
+        hd = cfg.head_dim
+        groups = cfg.n_head // cfg.n_kv_head
+
+        h = RMSNorm(cfg.norm_eps, cfg.dtype, cfg.param_dtype,
+                    name="attn_norm")(x)
+        b, t = h.shape[0], h.shape[1]
+        # fused QKV: n_head q-heads + 2 * n_kv_head kv-heads in one matmul
+        fused = _dense((cfg.n_head + 2 * cfg.n_kv_head) * hd,
+                       ("embed", "qkv"), "attn_qkv", cfg)(h)
+        q, k, v = jnp.split(
+            fused, [cfg.n_head * hd, (cfg.n_head + cfg.n_kv_head) * hd],
+            axis=-1)
+        q = q.reshape(b, t, cfg.n_head, hd)
+        k = k.reshape(b, t, cfg.n_kv_head, hd)
+        v = v.reshape(b, t, cfg.n_kv_head, hd)
+        cos, sin = rope_tables(cfg.max_seq_len, hd, cfg.rope_theta)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        # GQA -> expand KV to query heads (XLA turns repeat into a
+        # broadcast inside the attention einsum; no HBM copy)
+        if groups > 1:
+            k = jnp.repeat(k, groups, axis=2)
+            v = jnp.repeat(v, groups, axis=2)
+        q = nn.with_logical_constraint(q, ("batch", "seq", "heads", None))
+        k = nn.with_logical_constraint(k, ("batch", "seq", "heads", None))
+        v = nn.with_logical_constraint(v, ("batch", "seq", "heads", None))
+        attend = self.attention_fn or partial(full_attention, causal=True)
+        att = attend(q, k, v).reshape(b, t, cfg.d_model)
+        x = x + _dense(cfg.d_model, ("heads", "embed"),
+                       "attn_out", cfg)(att)
+
+        h = RMSNorm(cfg.norm_eps, cfg.dtype, cfg.param_dtype,
+                    name="mlp_norm")(x)
+        # SwiGLU with fused gate+up matmul
+        gu = _dense(2 * cfg.ffn_dim, ("embed", "mlp"), "mlp_gate_up",
+                    cfg)(h)
+        gate, up = jnp.split(gu, 2, axis=-1)
+        h = nn.silu(gate) * up
+        x = x + _dense(cfg.d_model, ("mlp", "embed"), "mlp_down", cfg)(h)
+        return nn.with_logical_constraint(x, ("batch", "seq", "embed"))
+
+
+class Llama(nn.Module):
+    config: LlamaConfig
+    attention_fn: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, tokens, deterministic: bool = True):
+        cfg = self.config
+        wte = self.param(
+            "wte",
+            nn.with_partitioning(nn.initializers.normal(0.02),
+                                 ("vocab", "embed")),
+            (cfg.vocab_size, cfg.d_model), cfg.param_dtype)
+        x = wte.astype(cfg.dtype)[tokens]
+        x = nn.with_logical_constraint(x, ("batch", "seq", "embed"))
+
+        block = LlamaBlock
+        if cfg.remat:
+            block = nn.remat(LlamaBlock, prevent_cse=False,
+                             static_argnums=(1,))
+        for i in range(cfg.n_layer):
+            x = block(cfg, self.attention_fn,
+                      name=f"layer{i}")(x, deterministic)
+
+        x = RMSNorm(cfg.norm_eps, cfg.dtype, cfg.param_dtype,
+                    name="final_norm")(x)
+        # tied LM head
+        return jnp.einsum("btd,vd->btv", x, wte.astype(cfg.dtype))
+
+
+def flops_per_token(cfg: LlamaConfig, seq_len: int | None = None) -> float:
+    t = seq_len or cfg.max_seq_len
+    hd = cfg.head_dim
+    per_layer = (
+        2 * cfg.d_model * (cfg.n_head + 2 * cfg.n_kv_head) * hd  # qkv
+        + 2 * cfg.d_model * cfg.d_model                          # attn out
+        + 3 * 2 * cfg.d_model * cfg.ffn_dim                      # swiglu
+    )
+    n_flops = cfg.n_layer * per_layer + 2 * cfg.vocab_size * cfg.d_model
+    return 3.0 * n_flops + 12.0 * cfg.n_layer * cfg.d_model * t
