@@ -45,7 +45,7 @@ def _get_or_start_controller():
 
 def run(app: Application, *, name: str = "default",
         route_prefix: Optional[str] = "/", _blocking: bool = False,
-        http_port: int = 0) -> DeploymentHandle:
+        http_port: int = 0, grpc_port: int = 0) -> DeploymentHandle:
     """Deploy an application graph; returns the ingress handle
     (reference `python/ray/serve/api.py:545`)."""
     ctrl = _get_or_start_controller()
@@ -70,6 +70,8 @@ def run(app: Application, *, name: str = "default",
     ingress = nodes[-1]
     if http_port:
         _start_proxy(http_port)
+    if grpc_port:
+        _start_grpc_proxy(grpc_port)
     return handles[id(ingress)]
 
 
@@ -82,6 +84,21 @@ def _start_proxy(port: int):
         _state["controller"], "127.0.0.1", port)
     ray_tpu.get(proxy.ready.remote(), timeout=60)
     _state["proxy"] = proxy
+
+
+def _start_grpc_proxy(port: int) -> Dict[str, Any]:
+    """gRPC ingress (reference `_private/proxy.py:534` gRPCProxy);
+    returns {"host", "port"} with the bound port."""
+    from ray_tpu.serve.grpc_proxy import GRPCProxy
+    if _state.get("grpc_proxy") is not None:
+        return ray_tpu.get(_state["grpc_proxy"].ready.remote(),
+                           timeout=30)
+    cls = ray_tpu.remote(GRPCProxy)
+    proxy = cls.options(max_concurrency=16, num_cpus=0).remote(
+        _state["controller"], "127.0.0.1", port)
+    info = ray_tpu.get(proxy.ready.remote(), timeout=60)
+    _state["grpc_proxy"] = proxy
+    return info
 
 
 def get_deployment_handle(deployment_name: str,
@@ -112,13 +129,15 @@ def shutdown() -> None:
             ray_tpu.kill(ctrl)
         except Exception:
             pass
-    if _state.get("proxy") is not None:
-        try:
-            ray_tpu.kill(_state["proxy"])
-        except Exception:
-            pass
+    for key in ("proxy", "grpc_proxy"):
+        if _state.get(key) is not None:
+            try:
+                ray_tpu.kill(_state[key])
+            except Exception:
+                pass
     _state["controller"] = None
     _state["proxy"] = None
+    _state["grpc_proxy"] = None
 
 
 __all__ = [

@@ -238,3 +238,48 @@ def test_streaming_http_jsonl():
 
     parsed = [json_mod.loads(ln) for ln in lines]
     assert parsed == [{"chunk": i, "echo": "hi"} for i in range(3)]
+
+
+def test_grpc_proxy_unary_and_streaming():
+    """gRPC ingress (reference `_private/proxy.py:534` gRPCProxy):
+    unary Call routes to a deployment, CallStreaming streams generator
+    chunks, Healthz answers, unknown deployment -> INTERNAL."""
+    import grpc
+    import json as json_mod
+
+    @serve.deployment
+    def square(x):
+        return {"sq": x * x}
+
+    @serve.deployment
+    def counter(n):
+        for i in range(n):
+            yield {"i": i}
+
+    serve.run(square.bind(), route_prefix="/square")
+    serve.run(counter.bind(), route_prefix="/counter")
+    from ray_tpu.serve import _start_grpc_proxy
+
+    info = _start_grpc_proxy(0)  # ephemeral port
+    addr = f"127.0.0.1:{info['port']}"
+    with grpc.insecure_channel(addr) as channel:
+        call = channel.unary_unary("/ray_tpu.serve.ServeAPI/Call")
+        out = json_mod.loads(call(
+            json_mod.dumps({"deployment": "square", "data": 7}).encode(),
+            timeout=60))
+        assert out == {"result": {"sq": 49}}
+
+        healthz = channel.unary_unary("/ray_tpu.serve.ServeAPI/Healthz")
+        assert healthz(b"", timeout=30) == b"ok"
+
+        stream = channel.unary_stream(
+            "/ray_tpu.serve.ServeAPI/CallStreaming")
+        chunks = [json_mod.loads(c) for c in stream(
+            json_mod.dumps({"deployment": "counter", "data": 3}).encode(),
+            timeout=60)]
+        assert chunks == [{"result": {"i": 0}}, {"result": {"i": 1}},
+                          {"result": {"i": 2}}]
+
+        with pytest.raises(grpc.RpcError):
+            call(json_mod.dumps({"deployment": "missing",
+                                 "data": 1}).encode(), timeout=60)
