@@ -34,10 +34,13 @@ _global_state: Optional["GlobalState"] = None
 
 class GlobalState:
     def __init__(self, cluster: Cluster | None, core_worker: CoreWorker,
-                 owns_cluster: bool):
+                 owns_cluster: bool, client=None):
         self.cluster = cluster
         self.core_worker = core_worker
         self.owns_cluster = owns_cluster
+        # Ray-Client mode: a ClientContext proxying every call to a
+        # cluster-side ClientServer (reference: python/ray/util/client)
+        self.client = client
 
 
 def is_initialized() -> bool:
@@ -80,6 +83,32 @@ def init(
             # CLI-submitted drivers find their cluster through the env
             # (reference: RAY_ADDRESS)
             address = os.environ.get("RAY_TPU_ADDRESS") or None
+        if address and (address.startswith("client://")
+                        or address.startswith("ray://")):
+            # thin remote driver: no local daemons, everything proxied.
+            # Local-cluster knobs make no sense here — fail loudly
+            # rather than silently ignoring them.
+            unsupported = {
+                "num_cpus": num_cpus, "num_tpus": num_tpus,
+                "resources": resources,
+                "object_store_memory": object_store_memory,
+                "runtime_env": runtime_env,
+                "_system_config": _system_config,
+            }
+            bad = [k for k, v in unsupported.items() if v is not None]
+            if bad:
+                raise ValueError(
+                    f"init(address='client://...') does not accept "
+                    f"{bad} — configure the cluster where the "
+                    f"client-server runs")
+            from ray_tpu.util.client import ClientContext
+
+            host_port = address.split("://", 1)[1]
+            ctx = ClientContext(host_port)
+            _global_state = GlobalState(None, None, owns_cluster=False,
+                                        client=ctx)
+            atexit.register(shutdown)
+            return _global_state
         if address is None:
             node_resources = dict(resources or {})
             import os as _os
@@ -185,6 +214,9 @@ def shutdown():
         if state is None:
             return
         _global_state = None
+        if state.client is not None:
+            state.client.disconnect()
+            return
         try:
             state.core_worker._run_sync(
                 state.core_worker.gcs.call(
@@ -201,20 +233,34 @@ def shutdown():
 
 
 def put(value: Any) -> ObjectRef:
-    return _require_state().core_worker.put(value)
+    state = _require_state()
+    if state.client is not None:
+        return state.client.put(value)
+    return state.core_worker.put(value)
 
 
 def get(refs, timeout: float | None = None):
-    return _require_state().core_worker.get(refs, timeout)
+    state = _require_state()
+    if state.client is not None:
+        return state.client.get(refs, timeout=timeout)
+    return state.core_worker.get(refs, timeout)
 
 
 def wait(refs: Sequence[ObjectRef], *, num_returns: int = 1,
          timeout: float | None = None):
-    return _require_state().core_worker.wait(refs, num_returns, timeout)
+    state = _require_state()
+    if state.client is not None:
+        return state.client.wait(refs, num_returns=num_returns,
+                                 timeout=timeout)
+    return state.core_worker.wait(refs, num_returns, timeout)
 
 
 def kill(actor: "ActorHandle", *, no_restart: bool = True):
-    _require_state().core_worker.kill_actor(actor._actor_id, no_restart)
+    state = _require_state()
+    if state.client is not None:
+        state.client.kill(actor, no_restart=no_restart)
+        return
+    state.core_worker.kill_actor(actor._actor_id, no_restart)
 
 
 # ----------------------------------------------------------------------
@@ -288,6 +334,25 @@ def _strategy_fields(opts: dict):
     return strategy, node_id, soft, pg_id, bundle_index
 
 
+def _client_options(opts: dict) -> dict:
+    """Options forwarded to the cluster-side ClientServer: only
+    non-default values; scheduling objects are not client-serializable
+    yet (reference Ray Client has the same restriction surface)."""
+    out = {}
+    for k, v in opts.items():
+        if v == _OPTION_DEFAULTS.get(k, None):
+            continue
+        if k in ("scheduling_strategy", "placement_group",
+                 "placement_group_bundle_index"):
+            raise ValueError(
+                f"option {k!r} is not supported in client mode")
+        if k == "num_returns" and v == "streaming":
+            raise ValueError(
+                "num_returns='streaming' is not supported in client mode")
+        out[k] = v
+    return out
+
+
 class RemoteFunction:
     def __init__(self, fn, options: dict, function_key: bytes | None = None):
         self._fn = fn
@@ -313,7 +378,17 @@ class RemoteFunction:
         return (RemoteFunction, (self._fn, self._options, self._function_key))
 
     def remote(self, *args, **kwargs):
-        cw = _require_state().core_worker
+        state = _require_state()
+        if state.client is not None:
+            # cache keyed by context: a shutdown/re-init must not reuse
+            # a proxy bound to the old, disconnected session
+            cached = getattr(self, "_client_fn", None)
+            if cached is None or cached[0] is not state.client:
+                cached = (state.client, state.client.remote(
+                    self._fn, **_client_options(self._options)))
+                self._client_fn = cached
+            return cached[1].remote(*args, **kwargs)
+        cw = state.core_worker
         key = self._ensure_pushed(cw)
         opts = self._options
         strategy, node_id, soft, pg_id, bundle_index = _strategy_fields(opts)
@@ -407,7 +482,15 @@ class ActorClass:
         return (ActorClass, (self._cls, self._options, self._class_key))
 
     def remote(self, *args, **kwargs) -> ActorHandle:
-        cw = _require_state().core_worker
+        state = _require_state()
+        if state.client is not None:
+            cached = getattr(self, "_client_cls", None)
+            if cached is None or cached[0] is not state.client:
+                cached = (state.client, state.client.remote(
+                    self._cls, **_client_options(self._options)))
+                self._client_cls = cached
+            return cached[1].remote(*args, **kwargs)
+        cw = state.core_worker
         if self._class_key is None:
             self._class_key = cw.push_function(self._cls)
         opts = self._options
@@ -456,7 +539,10 @@ def remote(*args, **kwargs):
 
 
 def get_actor(name: str) -> ActorHandle:
-    cw = _require_state().core_worker
+    state = _require_state()
+    if state.client is not None:
+        return state.client.get_actor(name)
+    cw = state.core_worker
     reply = cw._run_sync(cw.gcs.call("get_actor", {"name": name}))
     if not reply.get("found"):
         raise ValueError(f"no actor named {name!r}")
@@ -557,6 +643,9 @@ def nodes() -> List[dict]:
 
 
 def cluster_resources() -> Dict[str, float]:
+    state = _require_state()
+    if state.client is not None:
+        return state.client.cluster_resources()
     totals: Dict[str, float] = {}
     for n in nodes():
         if n["Alive"]:
@@ -566,6 +655,9 @@ def cluster_resources() -> Dict[str, float]:
 
 
 def available_resources() -> Dict[str, float]:
+    state = _require_state()
+    if state.client is not None:
+        return state.client.available_resources()
     totals: Dict[str, float] = {}
     for n in nodes():
         if n["Alive"]:

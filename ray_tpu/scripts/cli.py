@@ -195,6 +195,16 @@ def cmd_timeline(args):
         ray_tpu.shutdown()
 
 
+def cmd_client_server(args):
+    import sys as _sys
+
+    _sys.argv = ["client-server", "--address", args.address,
+                 "--host", args.host, "--port", str(args.port)]
+    from ray_tpu.util.client.server import main as server_main
+
+    server_main()
+
+
 def cmd_events(args):
     # offline read of the structured event shards — no cluster needed
     from ray_tpu.util.events import list_events
@@ -319,6 +329,14 @@ def main(argv=None):
     p.add_argument("--address")
     p.add_argument("--output", default="timeline.json")
     p.set_defaults(fn=cmd_timeline)
+
+    p = sub.add_parser(
+        "client-server",
+        help="serve remote 'client://' drivers against this cluster")
+    p.add_argument("--address", required=True)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=10001)
+    p.set_defaults(fn=cmd_client_server)
 
     p = sub.add_parser("events", help="list structured cluster events")
     p.add_argument("--source")
