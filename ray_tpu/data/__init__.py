@@ -14,6 +14,7 @@ from ray_tpu.data.block import Block, BlockAccessor
 from ray_tpu.data.context import DataContext
 from ray_tpu.data.dataset import Dataset, GroupedData
 from ray_tpu.data.datasource import (
+    ArrowDatasource,
     BinaryDatasource,
     CSVDatasource,
     Datasource,
@@ -23,7 +24,9 @@ from ray_tpu.data.datasource import (
     NumpyDatasource,
     ParquetDatasource,
     RangeDatasource,
+    SQLDatasource,
     TextDatasource,
+    TFRecordDatasource,
 )
 from ray_tpu.data.iterator import DataIterator
 
@@ -83,6 +86,20 @@ def read_binary_files(paths, parallelism: Optional[int] = None) -> Dataset:
     return read_datasource(BinaryDatasource(paths), parallelism)
 
 
+def read_tfrecords(paths, parallelism: Optional[int] = None) -> Dataset:
+    return read_datasource(TFRecordDatasource(paths), parallelism)
+
+
+def read_sql(sql: str, connection_factory,
+             parallelism: Optional[int] = None) -> Dataset:
+    return read_datasource(SQLDatasource(sql, connection_factory),
+                           parallelism)
+
+
+def from_arrow(table, parallelism: Optional[int] = None) -> Dataset:
+    return read_datasource(ArrowDatasource(table), parallelism)
+
+
 def read_images(paths, *, size: Optional[tuple] = None, mode: str = "RGB",
                 parallelism: Optional[int] = None) -> Dataset:
     return read_datasource(ImageDatasource(paths, size=size, mode=mode),
@@ -99,6 +116,7 @@ __all__ = [
     "GroupedData",
     "from_items",
     "from_numpy",
+    "from_arrow",
     "from_pandas",
     "range",
     "range_tensor",
@@ -108,5 +126,7 @@ __all__ = [
     "read_images",
     "read_json",
     "read_parquet",
+    "read_sql",
     "read_text",
+    "read_tfrecords",
 ]

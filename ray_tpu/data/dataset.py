@@ -22,6 +22,7 @@ from ray_tpu.data.datasource import (
     write_block_csv,
     write_block_json,
     write_block_parquet,
+    write_block_tfrecords,
 )
 from ray_tpu.data.executor import StreamingExecutor, _count_rows
 from ray_tpu.data.iterator import DataIterator
@@ -133,6 +134,12 @@ class Dataset:
         return BlockAccessor(
             DataIterator(self._execute()).materialize_numpy()).to_pandas()
 
+    def to_arrow(self):
+        """Materialize as one pyarrow Table (reference
+        `Dataset.to_arrow_refs` shape, collapsed to a local table)."""
+        return BlockAccessor(
+            DataIterator(self._execute()).materialize_numpy()).to_arrow()
+
     def to_numpy(self) -> Block:
         return DataIterator(self._execute()).materialize_numpy()
 
@@ -188,6 +195,9 @@ class Dataset:
 
     def write_parquet(self, path: str) -> List[str]:
         return self._write(path, "parquet", write_block_parquet)
+
+    def write_tfrecords(self, path: str) -> List[str]:
+        return self._write(path, "tfrecords", write_block_tfrecords)
 
     def __repr__(self) -> str:
         return f"Dataset(op={self._op.name})"

@@ -205,3 +205,86 @@ def write_block_json(block: Block, path: str) -> None:
 def write_block_parquet(block: Block, path: str) -> None:
     import pyarrow.parquet as pq
     pq.write_table(BlockAccessor(block).to_arrow(), path)
+
+
+class TFRecordDatasource(_FileDatasource):
+    """TFRecord files of tf.train.Example protos, parsed with the
+    dependency-free codec in `_tfrecord.py` (reference:
+    `datasource/tfrecords_datasource.py`, which requires TensorFlow).
+    Single-element lists flatten to scalar columns, matching the
+    reference's auto-unwrap behavior. BytesList values stay bytes
+    (as in the reference/TF — the wire cannot distinguish str from
+    bytes, and arbitrary binary payloads like encoded images must not
+    be UTF-8-decoded)."""
+
+    def _read_file(self, path: str) -> Block:
+        from ray_tpu.data import _tfrecord as tfr
+
+        rows = []
+        for payload in tfr.read_records(path):
+            ex = tfr.parse_example(payload)
+            row = {k: (v[0] if len(v) == 1 else
+                       (np.asarray(v) if not isinstance(v[0], bytes)
+                        else v))
+                   for k, v in ex.items()}
+            rows.append(row)
+        return BlockAccessor.from_rows(rows)
+
+
+def write_block_tfrecords(block: Block, path: str) -> None:
+    from ray_tpu.data import _tfrecord as tfr
+
+    acc = BlockAccessor(block)
+    tfr.write_records(
+        path, [tfr.build_example(acc.row(i))
+               for i in range(acc.num_rows())])
+
+
+class SQLDatasource(Datasource):
+    """Rows from a DB-API connection (reference:
+    `datasource/sql_datasource.py` — `read_sql(sql, connection_factory)`).
+    One read task runs the query in a worker; the factory must be
+    picklable (e.g. a module-level function opening sqlite3)."""
+
+    def __init__(self, sql: str, connection_factory: Callable):
+        self.sql = sql
+        self.connection_factory = connection_factory
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        sql, factory = self.sql, self.connection_factory
+
+        def read() -> Block:
+            conn = factory()
+            try:
+                cur = conn.cursor()
+                cur.execute(sql)
+                names = [d[0] for d in cur.description]
+                rows = cur.fetchall()
+            finally:
+                conn.close()
+            return BlockAccessor.from_rows(
+                [dict(zip(names, r)) for r in rows])
+
+        return [read]
+
+
+class ArrowDatasource(Datasource):
+    """In-memory pyarrow Table(s), one block per table chunk."""
+
+    def __init__(self, table):
+        self.table = table
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        table = self.table
+        n = table.num_rows
+        parallelism = max(1, min(parallelism, n)) if n else 1
+        chunk = (n + parallelism - 1) // parallelism if n else 0
+        tasks: List[ReadTask] = []
+        for i in range(parallelism):
+            lo = i * chunk
+            hi = min(n, lo + chunk)
+            if lo >= hi:
+                break
+            part = table.slice(lo, hi - lo)  # capture only the slice
+            tasks.append(lambda part=part: BlockAccessor.from_arrow(part))
+        return tasks

@@ -11,6 +11,7 @@ from ray_tpu.rllib.algorithms.bc import BC, BCConfig, MARWIL, MARWILConfig
 from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
 from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
+from ray_tpu.rllib.algorithms.sac import SAC, SACConfig
 from ray_tpu.rllib.core.learner import (
     DQNLearner,
     IMPALALearner,
@@ -34,7 +35,7 @@ from ray_tpu.rllib.env.env_runner import (
 __all__ = [
     "Algorithm", "AlgorithmConfig", "PPO", "PPOConfig", "DQN",
     "DQNConfig", "IMPALA", "IMPALAConfig", "BC", "BCConfig", "MARWIL",
-    "MARWILConfig", "Learner", "PPOLearner",
+    "MARWILConfig", "SAC", "SACConfig", "Learner", "PPOLearner",
     "DQNLearner", "IMPALALearner", "LearnerGroup",
     "RLModule", "RLModuleSpec", "ActorCriticModule", "QModule",
     "Columns", "EnvRunnerGroup", "SingleAgentEnvRunner", "Episode",

@@ -4,7 +4,8 @@ from ray_tpu.rllib.algorithms.bc import BC, BCConfig, MARWIL, MARWILConfig
 from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
 from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
+from ray_tpu.rllib.algorithms.sac import SAC, SACConfig
 
 __all__ = ["Algorithm", "AlgorithmConfig", "PPO", "PPOConfig", "DQN",
            "DQNConfig", "IMPALA", "IMPALAConfig", "BC", "BCConfig",
-           "MARWIL", "MARWILConfig"]
+           "MARWIL", "MARWILConfig", "SAC", "SACConfig"]

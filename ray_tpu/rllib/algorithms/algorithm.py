@@ -61,12 +61,28 @@ class Algorithm(Trainable):
         probe = env_creator()
         try:
             obs_dim = int(np.prod(probe.observation_space.shape))
-            act_dim = int(probe.action_space.n)
+            space = probe.action_space
+            if hasattr(space, "n"):  # Discrete
+                act_dim, discrete = int(space.n), True
+                scale, offset = 1.0, 0.0
+            else:  # Box: per-dim affine tanh squashing onto [low, high]
+                act_dim = int(np.prod(space.shape))
+                discrete = False
+                low = np.asarray(space.low, np.float64).ravel()
+                high = np.asarray(space.high, np.float64).ravel()
+                if not (np.isfinite(low).all()
+                        and np.isfinite(high).all()):
+                    raise ValueError(
+                        f"continuous algorithms need a bounded Box "
+                        f"action space; got low={low}, high={high}")
+                scale = tuple(((high - low) / 2).tolist())
+                offset = tuple(((high + low) / 2).tolist())
         finally:
             probe.close()
         self.spec = RLModuleSpec(
             observation_dim=obs_dim, action_dim=act_dim,
-            hidden=cfg.hidden, module_class=cfg.module_class)
+            hidden=cfg.hidden, discrete=discrete, action_scale=scale,
+            action_offset=offset, module_class=cfg.module_class)
         self.learner_group = LearnerGroup(
             type(self).learner_cls, self.spec, cfg.learner_config(),
             num_learners=cfg.num_learners,

@@ -29,11 +29,17 @@ from ray_tpu.rllib.core.rl_module import (
 class Learner:
     """Owns params + optimizer state; subclasses define compute_loss.
 
+    ``BROADCAST_KEYS`` names batch entries that are NOT row columns
+    (e.g. SAC's rng key data): they replicate to every device/learner
+    instead of being sharded/split by rows.
+
     With ``num_devices > 1`` the learner shards the batch over a local
     ``dp`` device mesh (`NamedSharding`): params stay replicated, XLA
     inserts the gradient psum over ICI — the GSPMD replacement for the
     reference's intra-learner DDP.
     """
+
+    BROADCAST_KEYS = frozenset({"rng"})
 
     def __init__(self, spec: RLModuleSpec,
                  config: Optional[Dict[str, Any]] = None, seed: int = 0,
@@ -73,22 +79,32 @@ class Learner:
         if self._batch_sharding is None:
             return {k: jnp.asarray(v) for k, v in batch.items()}
         n = self.mesh.shape["dp"]
+        # non-row payloads replicate instead of sharding over dp
+        bcast = {k: jnp.asarray(v) for k, v in batch.items()
+                 if k in self.BROADCAST_KEYS}
+        batch = {k: v for k, v in batch.items() if k not in bcast}
         rows = min(v.shape[0] for v in batch.values())
         keep = (rows // n) * n
         if keep == 0:
             # fewer rows than devices: tile up to one row per device
             # rather than producing an empty (NaN-gradient) batch
             reps = -(-n // rows)
-            return {
+            out = {
                 k: jax.device_put(
                     np.concatenate([np.asarray(v[:rows])] * reps)[:n],
                     self._batch_sharding)
                 for k, v in batch.items()
             }
-        return {
-            k: jax.device_put(np.asarray(v[:keep]), self._batch_sharding)
-            for k, v in batch.items()
-        }
+        else:
+            out = {
+                k: jax.device_put(np.asarray(v[:keep]),
+                                  self._batch_sharding)
+                for k, v in batch.items()
+            }
+        if bcast:
+            out.update({k: jax.device_put(v, self._replicated)
+                        for k, v in bcast.items()})
+        return out
 
     # -- to be provided by algorithm-specific subclasses -------------------
 

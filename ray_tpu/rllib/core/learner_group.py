@@ -42,12 +42,16 @@ def _split_batch(batch: Dict[str, np.ndarray], n: int
                  ) -> List[Dict[str, np.ndarray]]:
     if n <= 0:
         raise RuntimeError("all learners failed")
-    rows = min(v.shape[0] for v in batch.values())
+    bcast = {k: v for k, v in batch.items()
+             if k in Learner.BROADCAST_KEYS}
+    rows_batch = {k: v for k, v in batch.items() if k not in bcast}
+    rows = min(v.shape[0] for v in rows_batch.values())
     per = rows // n
     if per == 0:
         # fewer rows than learners: everyone sees the whole batch
         return [batch] * n
-    return [{k: v[i * per:(i + 1) * per] for k, v in batch.items()}
+    return [{**{k: v[i * per:(i + 1) * per]
+                for k, v in rows_batch.items()}, **bcast}
             for i in range(n)]
 
 

@@ -39,6 +39,11 @@ class RLModuleSpec:
     action_dim: int
     hidden: Tuple[int, ...] = (64, 64)
     discrete: bool = True
+    # continuous (Box) action spaces: per-dim affine tanh squashing —
+    # action = tanh(u) * action_scale + action_offset, so asymmetric
+    # bounded boxes map exactly onto [low, high] (SAC-family modules)
+    action_scale: Any = 1.0
+    action_offset: Any = 0.0
     module_class: Optional[type] = None
 
     def build(self) -> "RLModule":

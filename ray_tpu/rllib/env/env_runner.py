@@ -95,7 +95,11 @@ class SingleAgentEnvRunner:
             for i in range(self.num_envs):
                 ep = self._open[i]
                 ep.obs.append(obs[i])
-                ep.actions.append(int(actions[i]))
+                # discrete -> python int; continuous (Box) -> float vec
+                a = actions[i]
+                ep.actions.append(
+                    int(a) if np.ndim(a) == 0 else
+                    np.asarray(a, np.float32))
                 ep.rewards.append(float(rewards[i]))
                 ep.logps.append(float(logps[i]))
                 ep.vf_preds.append(float(vfs[i]))

@@ -22,7 +22,9 @@ from ray_tpu.rllib.env.env_runner import Episode
 def episode_to_json(ep: Episode) -> dict:
     return {
         "obs": np.stack(ep.obs).tolist() if ep.obs else [],
-        "actions": list(map(int, ep.actions)),
+        "actions": [int(a) if np.ndim(a) == 0
+                    else np.asarray(a, np.float32).tolist()
+                    for a in ep.actions],
         "rewards": list(map(float, ep.rewards)),
         "logps": list(map(float, ep.logps)),
         "vf_preds": list(map(float, ep.vf_preds)),
@@ -36,7 +38,9 @@ def episode_to_json(ep: Episode) -> dict:
 def episode_from_json(d: dict) -> Episode:
     ep = Episode()
     ep.obs = [np.asarray(o, np.float32) for o in d["obs"]]
-    ep.actions = list(d["actions"])
+    ep.actions = [a if not isinstance(a, list)
+                  else np.asarray(a, np.float32)
+                  for a in d["actions"]]
     ep.rewards = list(d["rewards"])
     ep.logps = list(d.get("logps", [0.0] * len(d["actions"])))
     ep.vf_preds = list(d.get("vf_preds", [0.0] * len(d["actions"])))
