@@ -233,6 +233,9 @@ class CoreWorker:
         # by config.max_lineage_bytes, evicting oldest-first.
         self._lineage: "OrderedDict[bytes, tuple]" = OrderedDict()
         self._lineage_oids: Dict[bytes, bytes] = {}  # oid -> task_id
+        # put()-path pins in flight: oid -> future resolved at pin ack
+        # (consulted by _unpin_at to preserve pin-before-unpin order)
+        self._pending_pins: Dict[bytes, asyncio.Future] = {}
         self._lineage_bytes = 0
         self._reconstructing: Dict[bytes, asyncio.Future] = {}
         # Primary-copy pins (reference: local_object_manager pinning —
@@ -374,6 +377,11 @@ class CoreWorker:
                 self._lineage_bytes -= size
 
     async def _unpin_at(self, oid: bytes, addr: str):
+        # never let an unpin overtake its (async) pin — the raylet
+        # would drop the unpin as unknown and the pin would then leak
+        pending = self._pending_pins.get(oid)
+        if pending is not None:
+            await pending
         try:
             raylet = await self._clients.get(addr)
             await raylet.notify("unpin_object", {"object_id": oid})
@@ -518,7 +526,8 @@ class CoreWorker:
             # the pin is recorded — _on_ref_released must find a count
             # to decrement when the user drops the ref
             ref = ObjectRef(oid, self.address)
-            self._plasma_put_pinned(oid, pickled, buffers, size)
+            self._plasma_put_pinned(oid, pickled, buffers, size,
+                                    wait_pin=False)
             self._run_sync(self._put_plasma_meta(oid.binary()))
             return ref
         return ObjectRef(oid, self.address)
@@ -542,37 +551,50 @@ class CoreWorker:
         return write_fn()
 
     def _plasma_put_pinned(self, oid: ObjectID, pickled, buffers,
-                           size: int):
+                           size: int, wait_pin: bool = True):
         """Create+seal+pin without an unprotected window: the creator's
         store reference (held from create until after the raylet's pin
         lands) is what stops a concurrent writer's eviction from
         destroying the fresh refcount-0 object. Reference: the worker
-        pins primary copies through its raylet before the task reply."""
+        pins primary copies through its raylet before the task reply.
+
+        ``wait_pin=False`` (the driver put() fast path) takes the pin
+        RPC off the critical path: put returns after seal and the
+        create reference is released at the async pin ack. That is only
+        safe when the UNPIN is sent by this same process — `_unpin_at`
+        awaits `_pending_pins` so an unpin can never overtake its pin.
+        Executor task/stream returns MUST wait: their unpin comes from
+        the owner, a different process with no view of our in-flight
+        pin, so replying before the pin lands would let the owner's
+        unpin race ahead of it (pinning the object forever)."""
         def write():
             buf = self.store.create_buffer(oid, size)
             serialization.write_to(buf, pickled, buffers)
             self.store.seal(oid)
             # NOT released yet — we still hold the create reference
         self._plasma_write(write, size)
+        fut = asyncio.run_coroutine_threadsafe(
+            self._pin_then_release(oid), self._loop)
+        if wait_pin:
+            fut.result(timeout=35)
+
+    async def _pin_then_release(self, oid: ObjectID):
+        key = oid.binary()
+        done = self._loop.create_future()
+        self._pending_pins[key] = done
         try:
-            self._pin_local(oid.binary())
+            if self.raylet_addr is not None:
+                try:
+                    await self._pin_local_async(key)
+                except Exception as e:  # noqa: BLE001 — see _pin_local
+                    logger.warning(
+                        "pin of %s at local raylet failed: %r",
+                        key.hex()[:12], e)
         finally:
             self.store.release(oid)
-
-    def _pin_local(self, oid: bytes):
-        """Executor-side synchronous pin of a freshly-created return at
-        the local raylet (reference: the worker pins primary copies via
-        its raylet at task completion; the owner later takes over the
-        unpin side)."""
-        if self.raylet_addr is None:
-            return
-        try:
-            self._run_sync(self._pin_local_async(oid), timeout=30)
-        except Exception as e:  # noqa: BLE001 — the object stays
-            # readable now (creator still holds its reference) but is
-            # unprotected from eviction afterwards; make that traceable
-            logger.warning("pin of %s at local raylet failed: %r",
-                           oid.hex()[:12], e)
+            self._pending_pins.pop(key, None)
+            if not done.done():
+                done.set_result(None)
 
     async def _pin_local_async(self, oid: bytes):
         raylet = await self._clients.get(self.raylet_addr)
@@ -597,8 +619,9 @@ class CoreWorker:
 
     async def _put_plasma_meta(self, oid: bytes):
         self.memory_store.add_location(oid, self.raylet_addr)
-        # the raylet already holds the pin (_plasma_put_pinned); just
-        # record where, so ref release routes the unpin
+        # the pin is held or in flight (_plasma_put_pinned; in-flight
+        # pins are reconciled with unpins via _pending_pins in
+        # _unpin_at); record where, so ref release routes the unpin
         self._pinned_at[oid] = self.raylet_addr
 
     _FAST_MISS = object()

@@ -15,6 +15,7 @@ from ray_tpu.tune.schedulers import (
 )
 from ray_tpu.tune.search import (
     BasicVariantGenerator,
+    BOHBSearch,
     TPESearch,
     Searcher,
     choice,
@@ -43,6 +44,7 @@ from ray_tpu.tune.tuner import ResultGrid, TuneConfig, Tuner
 __all__ = [
     "AsyncHyperBandScheduler",
     "BasicVariantGenerator",
+    "BOHBSearch",
     "CombinedStopper",
     "FIFOScheduler",
     "FunctionStopper",

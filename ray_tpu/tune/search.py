@@ -346,3 +346,67 @@ class TPESearch(Searcher):
             return min(domain.high,
                        max(domain.low, round(out / domain.q) * domain.q))
         return out
+
+
+class BOHBSearch(TPESearch):
+    """BOHB's model-based component (Falkner et al. 2018): per-budget
+    TPE models, with suggestions always drawn from the model of the
+    LARGEST budget that has enough observations — low-budget (early
+    rung) results guide search until high-budget evidence accumulates.
+
+    Reference: `python/ray/tune/search/bohb/bohb_search.py` (`TuneBOHB`,
+    a wrapper over the hpbandster library) — native here, sharing the
+    TPE machinery above. Pair with the HyperBand scheduler the way the
+    reference pairs TuneBOHB with HyperBandForBOHB; intermediate
+    results feed the budget-binned observation sets as they stream in,
+    so the model improves while trials are still running.
+    """
+
+    def __init__(self, metric: Optional[str] = None, mode: str = "max",
+                 n_initial_points: int = 8, gamma: float = 0.25,
+                 n_candidates: int = 24, seed: Optional[int] = None,
+                 budget_key: str = "training_iteration"):
+        super().__init__(metric, mode, n_initial_points, gamma,
+                         n_candidates, seed)
+        self.budget_key = budget_key
+        # budget -> trial_id -> (score, flat_config); keyed by trial so
+        # repeated reports at the same rung overwrite, not duplicate
+        self._budget_obs: Dict[int, Dict[str, tuple]] = {}
+
+    def _observe(self, trial_id: str, result: Dict[str, Any]) -> None:
+        if not result or self.metric not in result:
+            return
+        cfg = self._configs.get(trial_id)
+        if cfg is None:
+            return
+        score = float(result[self.metric])
+        if self.mode == "min":
+            score = -score
+        budget = int(result.get(self.budget_key, 1) or 1)
+        self._budget_obs.setdefault(budget, {})[trial_id] = (score, cfg)
+
+    def on_trial_result(self, trial_id, result) -> None:
+        self._observe(trial_id, result)
+
+    def on_trial_complete(self, trial_id, result=None,
+                          error=False) -> None:
+        if not error:
+            self._observe(trial_id, result or {})
+        self._configs.pop(trial_id, None)
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        # model selection: largest budget with a full initial set
+        chosen = None
+        for b in sorted(self._budget_obs, reverse=True):
+            if len(self._budget_obs[b]) >= self.n_initial:
+                chosen = b
+                break
+        if chosen is not None:
+            self._scores = list(self._budget_obs[chosen].values())
+        else:
+            # no budget has a full initial set yet: STAY RANDOM —
+            # pooling across budgets would mix incomparable scores and
+            # duplicate one trial's config across its rungs, collapsing
+            # the TPE model onto it
+            self._scores = []
+        return super().suggest(trial_id)

@@ -39,8 +39,16 @@ def where_am_i():
 
 
 def test_spread_uses_multiple_nodes(three_nodes):
+    @ray_tpu.remote
+    def where_am_i_slow():
+        # hold the worker briefly so one fast node cannot serially
+        # absorb every task before the others finish spawning workers
+        # (the assertion is about PLACEMENT, not about timing luck)
+        time.sleep(0.3)
+        return os.environ.get("RAY_TPU_NODE_ID")
+
     locs = set(ray_tpu.get(
-        [where_am_i.options(scheduling_strategy="SPREAD").remote()
+        [where_am_i_slow.options(scheduling_strategy="SPREAD").remote()
          for _ in range(12)],
         timeout=240,
     ))

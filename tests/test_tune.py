@@ -317,3 +317,61 @@ def test_tpe_with_tuner(tmp_path):
     assert len(grid) == 12
     best = grid.get_best_result("loss", mode="min")
     assert best.metrics["loss"] < 0.05
+
+
+def test_bohb_budget_model_selection():
+    """BOHB builds its TPE model from the largest budget with enough
+    observations: misleading low-budget scores are overridden once
+    high-budget evidence accumulates."""
+    from ray_tpu.tune.search import BOHBSearch
+
+    searcher = BOHBSearch(metric="score", mode="max",
+                          n_initial_points=4, seed=11)
+    searcher.set_search_properties("score", "max",
+                                   {"x": tune.uniform(0.0, 1.0)})
+    # low budget (rung 1) lies: rewards x near 0. high budget (rung 9)
+    # tells the truth: rewards x near 0.8
+    for i in range(30):
+        cfg = searcher.suggest(f"t{i}")
+        x = cfg["x"]
+        searcher.on_trial_result(f"t{i}",
+                                 {"score": -abs(x - 0.0),
+                                  "training_iteration": 1})
+        searcher.on_trial_complete(f"t{i}",
+                                   {"score": -abs(x - 0.8),
+                                    "training_iteration": 9})
+    late = []
+    for i in range(30, 42):
+        cfg = searcher.suggest(f"t{i}")
+        late.append(cfg["x"])
+        searcher.on_trial_complete(f"t{i}",
+                                   {"score": -abs(cfg["x"] - 0.8),
+                                    "training_iteration": 9})
+    mean_x = sum(late) / len(late)
+    assert abs(mean_x - 0.8) < 0.3, f"BOHB ignored the big budget: {mean_x}"
+
+
+def test_bohb_with_hyperband_tuner(tmp_path):
+    """BOHB + HyperBand end-to-end through the Tuner (the reference's
+    TuneBOHB + HyperBandForBOHB pairing)."""
+    from ray_tpu.air.config import RunConfig
+    from ray_tpu.tune.schedulers import HyperBandScheduler
+    from ray_tpu.tune.search import BOHBSearch
+
+    def trainable(config):
+        for i in range(8):
+            tune.report({"loss": (config["lr"] - 0.1) ** 2 / (i + 1)})
+
+    tuner = tune.Tuner(
+        trainable,
+        param_space={"lr": tune.loguniform(1e-3, 1.0)},
+        tune_config=tune.TuneConfig(
+            metric="loss", mode="min", num_samples=10,
+            search_alg=BOHBSearch(seed=5),
+            scheduler=HyperBandScheduler(max_t=8)),
+        run_config=RunConfig(storage_path=str(tmp_path), name="bohb"),
+    )
+    grid = tuner.fit()
+    assert len(grid) == 10
+    best = min(r.metrics["loss"] for r in grid if r.error is None)
+    assert best < 0.5
