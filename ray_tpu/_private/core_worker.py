@@ -773,6 +773,10 @@ class CoreWorker:
         finally:
             for fut in pending.values():
                 fut.cancel()
+        # one asyncio.wait pass can complete several futures at once;
+        # the API contract (reference ray.wait) caps ready at
+        # num_returns — the surplus stays claimable in not_ready
+        ready = ready[:num_returns]
         not_ready = [r for r in refs if r not in ready]
         return ready, not_ready
 

@@ -36,12 +36,13 @@ class ProcessHandle:
 
 
 def _spawn(args: List[str], log_path: str, ready_prefix: str,
-           timeout: float = 30.0, env: dict | None = None,
+           timeout: float = 120.0, env: dict | None = None,
            detach: bool = False) -> ProcessHandle:
     """Spawn a daemon and wait for its READY line. `detach` puts it in
     its own session (CLI-started nodes that outlive the launcher). The
     ready wait is non-blocking so a wedged daemon that never prints and
-    never exits still trips the deadline."""
+    never exits still trips the deadline — generous by default because
+    on a loaded box interpreter start alone can take tens of seconds."""
     env = dict(env or os.environ)
     env.setdefault("PYTHONPATH", REPO_ROOT)
     # Daemons never touch accelerators; workers get chips explicitly. Keep

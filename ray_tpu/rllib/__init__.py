@@ -6,6 +6,7 @@ jitted, scaled over local device meshes (GSPMD) and learner actors.
 """
 
 from ray_tpu.rllib.algorithms.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.appo import APPO, APPOConfig
 from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
 from ray_tpu.rllib.algorithms.bc import BC, BCConfig, MARWIL, MARWILConfig
 from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
@@ -33,7 +34,8 @@ from ray_tpu.rllib.env.env_runner import (
 )
 
 __all__ = [
-    "Algorithm", "AlgorithmConfig", "PPO", "PPOConfig", "DQN",
+    "Algorithm", "AlgorithmConfig", "APPO", "APPOConfig",
+    "PPO", "PPOConfig", "DQN",
     "DQNConfig", "IMPALA", "IMPALAConfig", "BC", "BCConfig", "MARWIL",
     "MARWILConfig", "SAC", "SACConfig", "Learner", "PPOLearner",
     "DQNLearner", "IMPALALearner", "LearnerGroup",

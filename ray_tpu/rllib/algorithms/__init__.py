@@ -1,4 +1,5 @@
 from ray_tpu.rllib.algorithms.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.appo import APPO, APPOConfig
 from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
 from ray_tpu.rllib.algorithms.bc import BC, BCConfig, MARWIL, MARWILConfig
 from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
@@ -6,6 +7,7 @@ from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
 from ray_tpu.rllib.algorithms.sac import SAC, SACConfig
 
-__all__ = ["Algorithm", "AlgorithmConfig", "PPO", "PPOConfig", "DQN",
+__all__ = ["Algorithm", "AlgorithmConfig", "APPO", "APPOConfig",
+           "PPO", "PPOConfig", "DQN",
            "DQNConfig", "IMPALA", "IMPALAConfig", "BC", "BCConfig",
            "MARWIL", "MARWILConfig", "SAC", "SACConfig"]

@@ -370,7 +370,8 @@ class IMPALALearner(Learner):
         pg_adv = jax.lax.stop_gradient(pg_adv)
 
         n = jnp.maximum(1.0, mask.sum())
-        policy_loss = -(target_logp * pg_adv * mask).sum() / n
+        policy_loss, extra = self._policy_loss(
+            target_logp, batch[Columns.ACTION_LOGP], pg_adv, mask, n)
         vf_loss = (jnp.square(vs - values) * mask).sum() / n
         probs = jax.nn.softmax(logits)
         entropy = -((probs * logp_all).sum(-1) * mask).sum() / n
@@ -378,4 +379,10 @@ class IMPALALearner(Learner):
             + self.config.get("vf_loss_coeff", 0.5) * vf_loss \
             - self.config.get("entropy_coeff", 0.01) * entropy
         return loss, {"policy_loss": policy_loss, "vf_loss": vf_loss,
-                      "entropy": entropy}
+                      "entropy": entropy, **extra}
+
+    def _policy_loss(self, target_logp, behavior_logp, pg_adv, mask, n):
+        """Policy objective over V-trace advantages; subclasses swap
+        the surrogate (APPO uses the PPO clip) while sharing all the
+        V-trace machinery above. Returns (loss, extra_stats)."""
+        return -(target_logp * pg_adv * mask).sum() / n, {}

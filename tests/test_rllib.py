@@ -390,3 +390,32 @@ def test_sac_pendulum_smoke():
         assert "episode_return_mean" in ev
     finally:
         algo.stop()
+
+
+def test_appo_cartpole_learns():
+    """APPO (V-trace + PPO clip on stale-weight samples) improves on
+    CartPole within a bounded number of iterations."""
+    from ray_tpu.rllib import APPO, APPOConfig
+
+    cfg = (
+        APPOConfig()
+        .environment("CartPole-v1")
+        .env_runners(num_env_runners=0, num_envs_per_env_runner=4,
+                     rollout_fragment_length=50)
+        .training(lr=1e-3, train_batch_size=800, entropy_coeff=0.005)
+        .debugging(seed=0)
+    )
+    algo = APPO(config=cfg)
+    try:
+        best = 0.0
+        for _ in range(60):
+            result = algo.train()
+            r = result["episode_return_mean"]
+            if np.isfinite(r):
+                best = max(best, r)
+            assert np.isfinite(result["mean_ratio"])
+            if best >= 80.0:
+                break
+        assert best >= 80.0, f"APPO failed to learn: best={best}"
+    finally:
+        algo.stop()

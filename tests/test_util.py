@@ -78,3 +78,35 @@ def test_queue_blocking_get_across_callers():
     t.join(timeout=30)
     assert got == ["handoff"]
     q.shutdown()
+
+
+def test_multiprocessing_pool():
+    """ray_tpu.util.multiprocessing.Pool: the stdlib surface over
+    actors (reference `ray.util.multiprocessing.pool`)."""
+    from ray_tpu.util.multiprocessing import Pool
+
+    with Pool(processes=2) as pool:
+        # map preserves order across chunks
+        assert pool.map(lambda x: x * x, range(10), chunksize=3) == [
+            x * x for x in range(10)]
+        # starmap unpacks tuples
+        assert pool.starmap(lambda a, b: a + b,
+                            [(1, 2), (3, 4)]) == [3, 7]
+        # apply/apply_async
+        assert pool.apply(lambda a, k=0: a + k, (5,), {"k": 2}) == 7
+        ar = pool.apply_async(lambda: "ok")
+        assert ar.get(timeout=60) == "ok"
+        assert ar.successful()
+        # imap yields in order; imap_unordered yields everything
+        assert list(pool.imap(lambda x: x + 1, range(6),
+                              chunksize=2)) == [1, 2, 3, 4, 5, 6]
+        assert sorted(pool.imap_unordered(
+            lambda x: x * 2, range(6), chunksize=2)) == [
+                0, 2, 4, 6, 8, 10]
+        # map_async + wait/ready
+        mr = pool.map_async(lambda x: -x, range(4))
+        mr.wait(timeout=60)
+        assert mr.ready() and mr.get() == [0, -1, -2, -3]
+        # close/join drains, then terminate via context exit
+        pool.close()
+        pool.join()
