@@ -1,0 +1,8 @@
+"""Make the in-repo ray_tpu importable when examples run from a source
+checkout (no-op once the package is on PYTHONPATH)."""
+import os
+import sys
+
+_repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _repo not in sys.path:
+    sys.path.insert(0, _repo)

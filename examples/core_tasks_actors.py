@@ -1,0 +1,41 @@
+"""Core API tour: tasks, actors, objects, placement-aware scheduling."""
+import numpy as np
+import _bootstrap  # noqa: F401  (repo-checkout import shim)
+
+import ray_tpu
+
+ray_tpu.init()
+
+
+@ray_tpu.remote
+def square(x):
+    return x * x
+
+
+@ray_tpu.remote
+class Accumulator:
+    def __init__(self):
+        self.total = 0
+
+    def add(self, v):
+        self.total += v
+        return self.total
+
+
+# parallel tasks
+print("squares:", ray_tpu.get([square.remote(i) for i in range(8)]))
+
+# zero-copy object store: the worker reads the array without a copy
+big = ray_tpu.put(np.arange(1_000_000))
+print("sum:", ray_tpu.get(square.options(num_returns=1).remote(2)),
+      ray_tpu.get(big)[:3], "...")
+
+# actors hold state across calls
+acc = Accumulator.remote()
+for i in range(5):
+    acc.add.remote(i)
+print("total:", ray_tpu.get(acc.add.remote(0)))
+
+ctx = ray_tpu.get_runtime_context()
+print("driver node:", ctx.get_node_id()[:12])
+ray_tpu.shutdown()

@@ -1,0 +1,19 @@
+"""Streaming data: read -> transform -> shuffle -> batched iteration."""
+import _bootstrap  # noqa: F401  (repo-checkout import shim)
+import numpy as np
+
+import ray_tpu
+from ray_tpu import data
+
+if __name__ == "__main__":
+    ray_tpu.init(num_cpus=4)
+    ds = (
+        data.range(1000)
+        .map_batches(lambda b: {"x": b["id"], "y": b["id"] * 2})
+        .random_shuffle(seed=0)
+    )
+    total = 0
+    for batch in ds.iter_batches(batch_size=128):
+        total += int(np.sum(batch["y"]))
+    print("sum of y:", total)  # 2 * sum(0..999) = 999000
+    ray_tpu.shutdown()

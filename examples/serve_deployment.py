@@ -1,0 +1,27 @@
+"""Online serving: deploy, call through the handle and over HTTP."""
+import _bootstrap  # noqa: F401  (repo-checkout import shim)
+import json
+import urllib.request
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@serve.deployment(num_replicas=2)
+class Doubler:
+    def __call__(self, x):
+        return {"doubled": x * 2}
+
+
+if __name__ == "__main__":
+    ray_tpu.init(num_cpus=4)
+    handle = serve.run(Doubler.bind(), route_prefix="/double",
+                       http_port=8123)
+    print("handle:", handle.remote(21).result(timeout=60))
+    req = urllib.request.Request(
+        "http://127.0.0.1:8123/double", data=b"4",
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=60) as resp:
+        print("http:", json.loads(resp.read()))
+    serve.shutdown()
+    ray_tpu.shutdown()

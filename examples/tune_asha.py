@@ -1,0 +1,32 @@
+"""Hyperparameter search: ASHA early-stops bad lr choices."""
+import _bootstrap  # noqa: F401  (repo-checkout import shim)
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.air.config import RunConfig
+from ray_tpu.tune.schedulers import ASHAScheduler
+
+
+def objective(config):
+    x = 1.0
+    for i in range(20):
+        x = x - config["lr"] * (2 * x)  # minimize x^2
+        tune.report({"loss": x * x})
+
+
+if __name__ == "__main__":
+    import tempfile
+
+    ray_tpu.init(num_cpus=4)
+    tuner = tune.Tuner(
+        objective,
+        param_space={"lr": tune.loguniform(1e-3, 1.0)},
+        tune_config=tune.TuneConfig(
+            metric="loss", mode="min", num_samples=8,
+            scheduler=ASHAScheduler(max_t=20)),
+        run_config=RunConfig(storage_path=tempfile.mkdtemp(),
+                             name="asha_demo"),
+    )
+    results = tuner.fit()
+    best = results.get_best_result()
+    print("best lr:", best.config["lr"], "loss:", best.metrics["loss"])
+    ray_tpu.shutdown()

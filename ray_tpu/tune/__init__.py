@@ -6,6 +6,7 @@ schedulers make early-stopping / PBT decisions, stoppers/loggers observe.
 """
 
 from ray_tpu.tune.schedulers import (
+    ASHAScheduler,
     AsyncHyperBandScheduler,
     FIFOScheduler,
     HyperBandScheduler,
@@ -42,6 +43,7 @@ from ray_tpu.tune.trainable import (
 from ray_tpu.tune.tuner import ResultGrid, TuneConfig, Tuner
 
 __all__ = [
+    "ASHAScheduler",
     "AsyncHyperBandScheduler",
     "BasicVariantGenerator",
     "BOHBSearch",

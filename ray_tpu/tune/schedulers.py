@@ -98,6 +98,10 @@ class AsyncHyperBandScheduler(TrialScheduler):
         return decision
 
 
+# the reference exports ASHA under both names
+ASHAScheduler = AsyncHyperBandScheduler
+
+
 class HyperBandScheduler(TrialScheduler):
     """Bracketed successive halving (reference `hyperband.py:42`).
 

@@ -42,9 +42,13 @@ class TuneConfig:
 class ResultGrid:
     """Reference: `python/ray/tune/result_grid.py`."""
 
-    def __init__(self, results: List[Result], trials: List[Trial]):
+    def __init__(self, results: List[Result], trials: List[Trial],
+                 default_metric: Optional[str] = None,
+                 default_mode: Optional[str] = None):
         self._results = results
         self._trials = trials
+        self._default_metric = default_metric
+        self._default_mode = default_mode
 
     def __getitem__(self, i: int) -> Result:
         return self._results[i]
@@ -60,7 +64,11 @@ class ResultGrid:
         return [r.error for r in self._results if r.error is not None]
 
     def get_best_result(self, metric: Optional[str] = None,
-                        mode: str = "max") -> Result:
+                        mode: Optional[str] = None) -> Result:
+        # default to the experiment's TuneConfig metric/mode (reference
+        # semantics) so bare get_best_result() means what it says
+        metric = metric or self._default_metric
+        mode = mode or self._default_mode or "max"
         candidates = [r for r in self._results if r.metrics]
         if metric:
             candidates = [r for r in candidates if metric in r.metrics]
@@ -142,12 +150,19 @@ class Tuner:
         trials = controller.run(timeout=tc.time_budget_s)
         results = []
         for t in trials:
+            metrics = dict(t.last_result) if t.last_result else None
+            if metrics is not None:
+                # every result carries its trial's config (reference:
+                # result dicts always include "config"), so
+                # Result.config / get_best_result().config just work
+                metrics.setdefault("config", t.config)
             results.append(Result(
-                metrics=t.last_result,
+                metrics=metrics,
                 checkpoint=(Checkpoint(t.checkpoint_path)
                             if t.checkpoint_path else None),
                 error=(RuntimeError(t.error) if t.error else None),
                 path=t.trial_dir,
                 metrics_history=t.metrics_history,
             ))
-        return ResultGrid(results, trials)
+        return ResultGrid(results, trials, default_metric=tc.metric,
+                          default_mode=tc.mode)
