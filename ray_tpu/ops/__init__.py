@@ -1,0 +1,11 @@
+"""ray_tpu.ops — Pallas TPU kernels for the hot ops.
+
+The compute path is JAX/XLA; these kernels cover the cases where XLA's
+fusion leaves HBM bandwidth on the table (attention score materialisation
+being the big one). Reference counterpart: the CUDA kernels the reference
+ships for the same ops (e.g. fused attention in its model runners) —
+re-designed here for the TPU memory hierarchy (HBM -> VMEM -> MXU/VPU)
+rather than translated.
+"""
+
+from ray_tpu.ops.flash_attention import flash_attention  # noqa: F401

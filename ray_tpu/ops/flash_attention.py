@@ -1,0 +1,234 @@
+"""Flash attention as a Pallas TPU kernel.
+
+Why: XLA's dense softmax attention materialises the [B, H, T, T] score
+tensor in HBM (f32: ~800 MB per layer at B=16, T=1024) and walks it
+several times (mask, max, exp, sum, divide, then again in the backward).
+At GPT-2 shapes that makes attention bandwidth-bound at ~15% of peak.
+This kernel streams Q blocks through VMEM, computes scores against the
+whole K/V (which fit comfortably in VMEM for T <= ~4k at head_dim 64-128)
+and writes only the [block_q, head_dim] output back — scores never exist
+in HBM, in either the forward or the backward pass.
+
+Design notes (see /opt/skills/guides/pallas_guide.md):
+- grid = (batch, heads, num_q_blocks); the last grid dim is innermost-
+  sequential on TPU, which the backward exploits to accumulate dK/dV in
+  VMEM scratch across Q blocks and flush once at the end.
+- Softmax statistics are computed in f32 on the VPU; the matmuls
+  (Q@K^T, P@V and the grad contractions) run on the MXU with
+  preferred_element_type=f32.
+- The backward is a custom VJP whose only residuals are the inputs and
+  the output: the softmax normalisers are *recomputed* from the in-VMEM
+  score block (one extra max+sum on the VPU) rather than stored — that
+  keeps every intermediate tensor out of HBM and sidesteps awkward
+  [B, H, T]-shaped outputs that don't tile.
+- Causal masking is done in-register with a broadcasted iota; for fully
+  masked (upper-triangular) Q/KV block pairs the FLOPs still execute —
+  at these sizes skipping them saves less than the pipeline bubbles cost.
+
+Reference parity: fcas/ray has no TPU attention kernel; its model-side
+equivalent is torch F.scaled_dot_product_attention (flash backend) used
+by its model code. API matches `full_attention` in
+ray_tpu/parallel/ring_attention.py so models can swap it in untouched.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def _pick_block_q(t: int) -> int:
+    # budget the f32 [block_q, T] VMEM temporaries (the backward keeps
+    # several live at once: s, p, dp, ds — plus K/V and dK/dV scratch),
+    # so the block shrinks as T grows instead of cliffing at ~16 MB VMEM
+    if t <= 1024:
+        cap = 512
+    elif t <= 2048:
+        cap = 256
+    else:
+        cap = 128
+    for cand in (512, 256, 128):
+        if cand <= cap and t % cand == 0:
+            return cand
+    return 0  # caller falls back to the XLA path
+
+
+def _scores(q, k, scale, causal, qi, block_q):
+    """[bq, T] f32 masked scores for one Q block — shared by fwd and bwd."""
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale
+    if causal:
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 0)
+        k_pos = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+    return s
+
+
+# --------------------------------------------------------------------------
+# forward
+# --------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_q):
+    # refs: q, o [1, 1, bq, d]; k, v [1, 1, T, d]
+    qi = pl.program_id(2)
+    q = q_ref[0, 0, :, :]
+    k = k_ref[0, 0, :, :]
+    v = v_ref[0, 0, :, :]
+
+    s = _scores(q, k, scale, causal, qi, block_q)             # [bq, T]
+    m = jnp.max(s, axis=1, keepdims=True)                     # [bq, 1]
+    p = jnp.exp(s - m)                                        # [bq, T] f32
+    l = jnp.sum(p, axis=1, keepdims=True)                     # [bq, 1]
+    o = jax.lax.dot_general(
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)                   # [bq, d]
+    o_ref[0, 0, :, :] = (o / l).astype(o_ref.dtype)
+
+
+def _fwd(q, k, v, scale, causal, block_q, interpret):
+    b, h, t, d = q.shape
+    grid = (b, h, t // block_q)
+    q_spec = pl.BlockSpec((1, 1, block_q, d),
+                          lambda bi, hi, qi: (bi, hi, qi, 0))
+    kv_spec = pl.BlockSpec((1, 1, t, d), lambda bi, hi, qi: (bi, hi, 0, 0))
+    return pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                          block_q=block_q),
+        grid=grid,
+        in_specs=[q_spec, kv_spec, kv_spec],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, t, d), q.dtype),
+        interpret=interpret,
+    )(q, k, v)
+
+
+# --------------------------------------------------------------------------
+# backward
+# --------------------------------------------------------------------------
+
+def _bwd_kernel(q_ref, k_ref, v_ref, o_ref, do_ref,
+                dq_ref, dk_ref, dv_ref, dk_acc, dv_acc, *,
+                scale, causal, block_q):
+    # grid = (b, h, nq); nq is innermost-sequential: accumulate dK/dV for
+    # this (b, h) in f32 VMEM scratch, flush on the last Q block.
+    qi = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    q = q_ref[0, 0, :, :]
+    k = k_ref[0, 0, :, :]
+    v = v_ref[0, 0, :, :]
+    do = do_ref[0, 0, :, :].astype(jnp.float32)
+    o = o_ref[0, 0, :, :].astype(jnp.float32)
+
+    # recompute the softmax for this block (scores live only in VMEM)
+    s = _scores(q, k, scale, causal, qi, block_q)             # [bq, T]
+    m = jnp.max(s, axis=1, keepdims=True)
+    e = jnp.exp(s - m)
+    p = e / jnp.sum(e, axis=1, keepdims=True)                 # [bq, T] f32
+
+    # delta_i = rowsum(dO_i * O_i)  (the -P^T dP P term folded via O)
+    delta = jnp.sum(do * o, axis=1, keepdims=True)            # [bq, 1]
+    dp = jax.lax.dot_general(
+        do.astype(v.dtype), v, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)                   # [bq, T]
+    ds = p * (dp - delta)                                     # [bq, T] f32
+
+    dq = jax.lax.dot_general(
+        ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale           # [bq, d]
+    dq_ref[0, 0, :, :] = dq.astype(dq_ref.dtype)
+
+    dk_acc[...] += jax.lax.dot_general(
+        ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale           # [T, d]
+    dv_acc[...] += jax.lax.dot_general(
+        p.astype(do_ref.dtype), do.astype(do_ref.dtype),
+        (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)                   # [T, d]
+
+    @pl.when(qi == nq - 1)
+    def _():
+        dk_ref[0, 0, :, :] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0, 0, :, :] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _bwd(scale, causal, block_q, interpret, res, g):
+    q, k, v, out = res
+    b, h, t, d = q.shape
+    grid = (b, h, t // block_q)
+    q_spec = pl.BlockSpec((1, 1, block_q, d),
+                          lambda bi, hi, qi: (bi, hi, qi, 0))
+    kv_spec = pl.BlockSpec((1, 1, t, d), lambda bi, hi, qi: (bi, hi, 0, 0))
+    dq, dk, dv = pl.pallas_call(
+        functools.partial(_bwd_kernel, scale=scale, causal=causal,
+                          block_q=block_q),
+        grid=grid,
+        in_specs=[q_spec, kv_spec, kv_spec, q_spec, q_spec],
+        out_specs=[q_spec, kv_spec, kv_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, t, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, t, d), k.dtype),
+            jax.ShapeDtypeStruct((b, h, t, d), v.dtype),
+        ],
+        scratch_shapes=[pltpu.VMEM((t, d), jnp.float32),
+                        pltpu.VMEM((t, d), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, out, g)
+    return dq, dk, dv
+
+
+# --------------------------------------------------------------------------
+# public API
+# --------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, scale, causal, block_q, interpret):
+    return _fwd(q, k, v, scale, causal, block_q, interpret)
+
+
+def _flash_fwd(q, k, v, scale, causal, block_q, interpret):
+    out = _fwd(q, k, v, scale, causal, block_q, interpret)
+    return out, (q, k, v, out)
+
+
+_flash.defvjp(_flash_fwd, _bwd)
+
+
+def flash_attention(q, k, v, *, causal: bool = True,
+                    scale: Optional[float] = None,
+                    block_q: Optional[int] = None):
+    """Drop-in for `full_attention`: q/k/v are [B, T, H, head_dim].
+
+    Falls back to the XLA dense path when (a) not running on TPU (the
+    interpret-mode kernel is for tests, not speed), (b) the shape doesn't
+    block evenly, or (c) K/V + a score block would overflow VMEM
+    (T > 4096) — same semantics either way. For sequence-sharded meshes
+    use ring/Ulysses attention (ray_tpu/parallel/ring_attention.py);
+    this kernel is the single-chip hot path.
+    """
+    b, t, h, d = q.shape
+    if scale is None:
+        scale = d ** -0.5
+    bq = block_q or _pick_block_q(t)
+    if (bq == 0 or t % bq or t > 4096 or d % 64
+            or jax.default_backend() != "tpu"):
+        from ray_tpu.parallel.ring_attention import full_attention
+        return full_attention(q, k, v, causal=causal, scale=scale)
+    # kernel layout is [B, H, T, d] so the T dim is block-sliceable
+    qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
+    out = _flash(qt, kt, vt, scale, causal, bq, False)
+    return out.transpose(0, 2, 1, 3)
