@@ -68,8 +68,7 @@ def bench_gpt2_tokens_per_sec(steps: int = 20):
     import optax
 
     from ray_tpu.models import GPT, GPTConfig
-    from ray_tpu.models.gpt import cross_entropy_loss
-    from ray_tpu.ops import flash_attention
+    from ray_tpu.ops import flash_attention, fused_cross_entropy
 
     dev = jax.devices()[0]
     on_tpu = dev.platform == "tpu"
@@ -97,7 +96,9 @@ def bench_gpt2_tokens_per_sec(steps: int = 20):
     @partial(jax.jit, donate_argnums=(0, 1))
     def train_step(params, opt_state, inputs, targets):
         def loss_fn(p):
-            return cross_entropy_loss(model.apply(p, inputs), targets)
+            # fused head: bf16 logits end-to-end, hand-written backward
+            hidden, wte = model.apply(p, inputs, return_hidden=True)
+            return fused_cross_entropy(hidden, wte, targets)
 
         loss, grads = jax.value_and_grad(loss_fn)(params)
         updates, opt_state = tx.update(grads, opt_state, params)

@@ -9,3 +9,4 @@ rather than translated.
 """
 
 from ray_tpu.ops.flash_attention import flash_attention  # noqa: F401
+from ray_tpu.ops.fused_ce import fused_cross_entropy  # noqa: F401
