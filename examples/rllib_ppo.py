@@ -1,5 +1,10 @@
 """RL: a few PPO iterations on CartPole."""
 import _bootstrap  # noqa: F401  (repo-checkout import shim)
+# sim-env RL is latency-bound: tiny MLP forwards gain nothing from an
+# accelerator (in a cluster, env-runner actors have no TPU chips bound
+# anyway). Force CPU so a tunneled/remote TPU doesn't add per-step RTTs.
+import jax
+jax.config.update("jax_platforms", "cpu")
 import ray_tpu
 from ray_tpu.rllib import PPOConfig
 

@@ -279,7 +279,8 @@ class CoreWorker:
         self.gcs = ReconnectingClient(self._clients, self.gcs_addr)
         await self.gcs.call("subscribe",
                             {"channel": "actors", "addr": self._server.address})
-        asyncio.ensure_future(self._event_flush_loop())
+        self._event_flush_task = asyncio.ensure_future(
+            self._event_flush_loop())
 
     def _emit_task_event(self, task_id: bytes, name: str,
                          task_type: str, state: str):
@@ -318,6 +319,9 @@ class CoreWorker:
         set_core_worker(None)
 
     async def _stop_async(self):
+        task = getattr(self, "_event_flush_task", None)
+        if task is not None:
+            task.cancel()  # mid-sleep; the tail flush below covers it
         if self._task_events:
             # a short-lived driver exits before the periodic flush —
             # ship the tail so its tasks appear in `list tasks`

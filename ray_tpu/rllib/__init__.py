@@ -9,8 +9,13 @@ from ray_tpu.rllib.algorithms.algorithm import Algorithm
 from ray_tpu.rllib.algorithms.appo import APPO, APPOConfig
 from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
 from ray_tpu.rllib.algorithms.bc import BC, BCConfig, MARWIL, MARWILConfig
+from ray_tpu.rllib.algorithms.cql import CQL, CQLConfig
 from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
 from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig
+from ray_tpu.rllib.algorithms.multi_agent_ppo import (
+    MultiAgentPPO,
+    MultiAgentPPOConfig,
+)
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
 from ray_tpu.rllib.algorithms.sac import SAC, SACConfig
 from ray_tpu.rllib.core.learner import (
@@ -27,6 +32,11 @@ from ray_tpu.rllib.core.rl_module import (
     RLModule,
     RLModuleSpec,
 )
+from ray_tpu.rllib.env.multi_agent import (
+    MultiAgentEnv,
+    MultiAgentEnvRunner,
+    MultiAgentEnvRunnerGroup,
+)
 from ray_tpu.rllib.env.env_runner import (
     EnvRunnerGroup,
     Episode,
@@ -35,10 +45,12 @@ from ray_tpu.rllib.env.env_runner import (
 
 __all__ = [
     "Algorithm", "AlgorithmConfig", "APPO", "APPOConfig",
-    "PPO", "PPOConfig", "DQN",
+    "CQL", "CQLConfig", "PPO", "PPOConfig", "DQN",
     "DQNConfig", "IMPALA", "IMPALAConfig", "BC", "BCConfig", "MARWIL",
     "MARWILConfig", "SAC", "SACConfig", "Learner", "PPOLearner",
     "DQNLearner", "IMPALALearner", "LearnerGroup",
     "RLModule", "RLModuleSpec", "ActorCriticModule", "QModule",
     "Columns", "EnvRunnerGroup", "SingleAgentEnvRunner", "Episode",
+    "MultiAgentPPO", "MultiAgentPPOConfig", "MultiAgentEnv",
+    "MultiAgentEnvRunner", "MultiAgentEnvRunnerGroup",
 ]

@@ -2,12 +2,18 @@ from ray_tpu.rllib.algorithms.algorithm import Algorithm
 from ray_tpu.rllib.algorithms.appo import APPO, APPOConfig
 from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
 from ray_tpu.rllib.algorithms.bc import BC, BCConfig, MARWIL, MARWILConfig
+from ray_tpu.rllib.algorithms.cql import CQL, CQLConfig
 from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
 from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig
+from ray_tpu.rllib.algorithms.multi_agent_ppo import (
+    MultiAgentPPO,
+    MultiAgentPPOConfig,
+)
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
 from ray_tpu.rllib.algorithms.sac import SAC, SACConfig
 
 __all__ = ["Algorithm", "AlgorithmConfig", "APPO", "APPOConfig",
-           "PPO", "PPOConfig", "DQN",
-           "DQNConfig", "IMPALA", "IMPALAConfig", "BC", "BCConfig",
+           "CQL", "CQLConfig", "PPO", "PPOConfig", "DQN",
+           "DQNConfig", "IMPALA", "IMPALAConfig",
+           "MultiAgentPPO", "MultiAgentPPOConfig", "BC", "BCConfig",
            "MARWIL", "MARWILConfig", "SAC", "SACConfig"]

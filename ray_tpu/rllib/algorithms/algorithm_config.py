@@ -44,6 +44,9 @@ class AlgorithmConfig:
         # offline data (reference .offline_data())
         self.input_: Any = None  # path/glob of recorded episode shards
         self.output: Any = None  # directory to record sampled episodes
+        # multi-agent (reference .multi_agent(); empty = single-agent)
+        self.policies: Dict[str, Any] = {}
+        self.policy_mapping_fn: Optional[Callable] = None
         # misc
         self.seed: int = 0
         self.extra: Dict[str, Any] = {}
@@ -138,6 +141,22 @@ class AlgorithmConfig:
              if k not in self._SKIP and k != "extra"}
         d.update(self.extra)
         return d
+
+    def multi_agent(self, *, policies: Optional[Dict[str, Any]] = None,
+                    policy_mapping_fn: Optional[Callable] = None
+                    ) -> "AlgorithmConfig":
+        """Declare the policy modules and the agent->module routing.
+
+        `policies` maps module ids to an RLModuleSpec or None (None =
+        infer the spec from the env's per-agent spaces); every agent id
+        is routed by `policy_mapping_fn(agent_id) -> module_id`
+        (reference `algorithm_config.py` .multi_agent()).
+        """
+        if policies is not None:
+            self.policies = dict(policies)
+        if policy_mapping_fn is not None:
+            self.policy_mapping_fn = policy_mapping_fn
+        return self
 
     def update_from_dict(self, d: Dict[str, Any]) -> "AlgorithmConfig":
         for k, v in d.items():

@@ -207,8 +207,13 @@ def cmd_client_server(args):
 
 def cmd_events(args):
     # offline read of the structured event shards — no cluster needed
-    from ray_tpu.util.events import list_events
+    from ray_tpu.util.events import export_otlp, list_events
 
+    if getattr(args, "otlp", None):
+        n = export_otlp(args.otlp, source=args.source,
+                        severity=args.severity, label=args.label)
+        print(f"wrote {n} OTLP log records to {args.otlp}")
+        return
     evs = list_events(source=args.source, severity=args.severity,
                       label=args.label)
     for ev in evs[-args.limit:]:
@@ -343,6 +348,8 @@ def main(argv=None):
     p.add_argument("--severity")
     p.add_argument("--label")
     p.add_argument("--limit", type=int, default=100)
+    p.add_argument("--otlp", metavar="FILE",
+                   help="export as an OTLP/JSON Logs payload instead")
     p.set_defaults(fn=cmd_events)
 
     p = sub.add_parser("trace",

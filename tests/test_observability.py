@@ -5,6 +5,7 @@ Reference ground: `python/ray/tests/test_state_api.py`,
 """
 
 import json
+import os
 import subprocess
 import sys
 import time
@@ -220,3 +221,42 @@ def test_gcs_emits_lifecycle_events():
     evs = list_events(source="GCS", label="NODE_ADDED")
     assert evs, "GCS should have recorded node registrations"
     assert all(e["severity"] == "INFO" for e in evs)
+
+
+def test_events_export_otlp(tmp_path):
+    """The structured event log exports as a valid OTLP/JSON Logs
+    payload (resourceLogs -> scopeLogs -> logRecords), one resource per
+    (source, pid) shard."""
+    import json
+
+    from ray_tpu.util import events as ev
+
+    d = str(tmp_path / "events")
+    old = os.environ.get("RAY_TPU_EVENT_DIR")
+    os.environ["RAY_TPU_EVENT_DIR"] = d
+    ev._files.clear()
+    try:
+        ev.report("GCS", "INFO", "NODE_ADDED", "node up", node_id="n1")
+        ev.report("GCS", "ERROR", "NODE_DEAD", "node lost", node_id="n1")
+        out = str(tmp_path / "logs.otlp.json")
+        n = ev.export_otlp(out, path=d)
+        assert n == 2
+        payload = json.load(open(out))
+        rl = payload["resourceLogs"]
+        assert len(rl) == 1  # one (source, pid)
+        svc = {a["key"]: a["value"] for a in rl[0]["resource"]["attributes"]}
+        assert svc["service.name"]["stringValue"] == "ray_tpu.gcs"
+        recs = rl[0]["scopeLogs"][0]["logRecords"]
+        assert [r["severityText"] for r in recs] == ["INFO", "ERROR"]
+        assert recs[1]["body"]["stringValue"] == "node lost"
+        attrs = {a["key"]: a["value"]["stringValue"]
+                 for a in recs[0]["attributes"]}
+        assert attrs["node_id"] == "n1"
+        assert attrs["label"] == "NODE_ADDED"
+        assert int(recs[0]["timeUnixNano"]) > 1e18
+    finally:
+        ev._files.clear()
+        if old is None:
+            os.environ.pop("RAY_TPU_EVENT_DIR", None)
+        else:
+            os.environ["RAY_TPU_EVENT_DIR"] = old

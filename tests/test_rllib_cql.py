@@ -1,0 +1,103 @@
+"""CQL: conservative offline Q-learning from logged episodes.
+
+Covers: dataset loading through JsonReader into the transition buffer,
+the CQL(H) regularizer inside the jitted SAC update (finite, positive on
+random data — Q must be pushed below the logsumexp of sampled actions),
+and that the conservative penalty actually suppresses Q on
+out-of-distribution actions relative to plain SAC updates.
+"""
+
+import numpy as np
+
+from ray_tpu.rllib.algorithms.cql import CQL, CQLConfig
+from ray_tpu.rllib.env.env_runner import Episode
+from ray_tpu.rllib.offline.io import JsonWriter
+
+
+def _write_pendulum_dataset(path, n_episodes=30, ep_len=50, seed=0):
+    """Mediocre behavior policy on Pendulum: random torques."""
+    import gymnasium as gym
+
+    env = gym.make("Pendulum-v1")
+    writer = JsonWriter(str(path))
+    rng = np.random.default_rng(seed)
+    episodes = []
+    for i in range(n_episodes):
+        obs, _ = env.reset(seed=seed + i)
+        ep = Episode()
+        for _ in range(ep_len):
+            a = rng.uniform(-2.0, 2.0, size=(1,)).astype(np.float32)
+            nxt, r, term, trunc, _ = env.step(a)
+            ep.obs.append(np.asarray(obs, np.float32))
+            ep.actions.append(a)
+            ep.rewards.append(float(r))
+            ep.logps.append(0.0)
+            ep.vf_preds.append(0.0)
+            obs = nxt
+            if term or trunc:
+                break
+        ep.truncated = True
+        ep.last_obs = np.asarray(obs, np.float32)
+        episodes.append(ep)
+    writer.write(episodes)
+    env.close()
+
+
+def test_cql_trains_offline(tmp_path):
+    data = tmp_path / "pendulum"
+    _write_pendulum_dataset(data)
+    cfg = (
+        CQLConfig()
+        .environment("Pendulum-v1")
+        .offline_data(input_=str(data))
+        .training(lr=3e-4, train_batch_size=64,
+                  num_updates_per_iteration=6, cql_alpha=5.0,
+                  num_sampled_actions=4)
+        .debugging(seed=0)
+    )
+    algo = CQL(config=cfg)
+    try:
+        assert len(algo.replay) > 1000  # dataset loaded as transitions
+        stats = algo.train()
+        for k in ("q_loss", "policy_loss", "cql_loss", "alpha"):
+            assert np.isfinite(stats[k]), (k, stats)
+        # on a random-behavior dataset the logsumexp over sampled actions
+        # exceeds the dataset-action Q -> positive conservative gap
+        assert stats["cql_loss"] > 0.0
+        assert stats["num_offline_steps_trained"] == 6 * 64
+        # a second iteration keeps training from the same buffer
+        stats2 = algo.train()
+        assert np.isfinite(stats2["q_loss"])
+    finally:
+        algo.stop()
+
+
+def test_cql_suppresses_q_vs_sac(tmp_path):
+    """Same data, same seeds: the conservative penalty must leave the
+    mean dataset Q estimate below plain SAC's after equal updates."""
+    data = tmp_path / "pendulum"
+    _write_pendulum_dataset(data)
+
+    def train(alpha):
+        cfg = (
+            CQLConfig()
+            .environment("Pendulum-v1")
+            .offline_data(input_=str(data))
+            .training(lr=1e-3, train_batch_size=64,
+                      num_updates_per_iteration=50, cql_alpha=alpha,
+                      num_sampled_actions=4)
+            .debugging(seed=0)
+        )
+        algo = CQL(config=cfg)
+        try:
+            for _ in range(3):
+                stats = algo.train()
+            return stats["q_mean"], stats["cql_loss"]
+        finally:
+            algo.stop()
+
+    q_conservative, gap_conservative = train(alpha=10.0)
+    q_plain, gap_plain = train(alpha=0.0)
+    assert q_conservative < q_plain
+    # the penalty also narrows the OOD-vs-data Q gap it optimizes
+    assert gap_conservative < gap_plain

@@ -1,0 +1,220 @@
+"""Multi-agent RLlib: env API, runner routing, and PPO learning.
+
+Reference behaviors covered: dict-keyed MultiAgentEnv stepping
+(`rllib/env/multi_agent_env.py`), per-agent episode collection routed by
+policy_mapping_fn (`multi_agent_env_runner.py`), shared-vs-independent
+policies, and a MultiAgentPPO run that actually improves reward.
+"""
+
+import numpy as np
+import pytest
+
+from ray_tpu.rllib import (MultiAgentEnv, MultiAgentEnvRunner,
+                           MultiAgentPPO, MultiAgentPPOConfig)
+from ray_tpu.rllib.core.rl_module import RLModuleSpec
+
+
+class MatchingEnv(MultiAgentEnv):
+    """Cooperative 2-agent game: each agent sees a 4-state one-hot and
+    earns +1 per step for choosing action == state % 2. Episode length 8.
+    Optimal per-episode return (summed over both agents): 16.
+    """
+
+    possible_agents = ["a0", "a1"]
+
+    def __init__(self):
+        import gymnasium as gym
+
+        obs_sp = gym.spaces.Box(0.0, 1.0, (4,), np.float32)
+        act_sp = gym.spaces.Discrete(2)
+        self.observation_spaces = {a: obs_sp for a in self.possible_agents}
+        self.action_spaces = {a: act_sp for a in self.possible_agents}
+        self._rng = np.random.default_rng(0)
+        self._t = 0
+        self._state = {}
+
+    def _obs(self):
+        out = {}
+        for a in self.possible_agents:
+            s = int(self._rng.integers(0, 4))
+            self._state[a] = s
+            onehot = np.zeros(4, np.float32)
+            onehot[s] = 1.0
+            out[a] = onehot
+        return out
+
+    def reset(self, *, seed=None):
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._t = 0
+        return self._obs(), {}
+
+    def step(self, actions):
+        rewards = {
+            a: float(int(actions[a]) == self._state[a] % 2)
+            for a in self.possible_agents
+        }
+        self._t += 1
+        done = self._t >= 8
+        obs = self._obs()
+        terms = {a: done for a in self.possible_agents}
+        terms["__all__"] = done
+        truncs = {a: False for a in self.possible_agents}
+        truncs["__all__"] = False
+        return obs, rewards, terms, truncs, {}
+
+
+def _specs(module_ids):
+    return {m: RLModuleSpec(observation_dim=4, action_dim=2,
+                            hidden=(32,), discrete=True)
+            for m in module_ids}
+
+
+def test_runner_routes_episodes_by_module():
+    import jax
+
+    specs = _specs(["p0", "p1"])
+    runner = MultiAgentEnvRunner(
+        MatchingEnv, specs, lambda a: "p0" if a == "a0" else "p1",
+        seed=0)
+    weights = {
+        mid: specs[mid].build().init_params(jax.random.PRNGKey(i))
+        for i, mid in enumerate(specs)
+    }
+    runner.set_weights(weights)
+    out = runner.sample(num_steps=64)
+    assert set(out) == {"p0", "p1"}
+    # both agents act every step, so both modules collected episodes
+    for mid, eps in out.items():
+        assert eps, mid
+        for ep in eps:
+            assert ep.length > 0
+            assert len(ep.obs) == ep.length == len(ep.rewards)
+            assert ep.obs[0].shape == (4,)
+    m = runner.get_metrics()
+    assert m["num_episodes"] > 0
+
+
+def test_runner_shared_policy():
+    import jax
+
+    specs = _specs(["shared"])
+    runner = MultiAgentEnvRunner(
+        MatchingEnv, specs, lambda a: "shared", seed=1)
+    runner.set_weights({
+        "shared": specs["shared"].build().init_params(
+            jax.random.PRNGKey(0))})
+    out = runner.sample(num_steps=32)
+    assert set(out) == {"shared"}
+    # two agents per step -> roughly 2x episodes land on the one module
+    assert len(out["shared"]) >= 2
+
+
+@pytest.mark.parametrize("shared", [True, False])
+def test_multi_agent_ppo_learns(shared):
+    if shared:
+        policies = {"shared": None}
+        mapping = lambda a: "shared"  # noqa: E731
+    else:
+        policies = {"p0": None, "p1": None}
+        mapping = lambda a: "p0" if a == "a0" else "p1"  # noqa: E731
+    config = (
+        MultiAgentPPOConfig()
+        .environment(env=lambda: MatchingEnv())
+        .multi_agent(policies=policies, policy_mapping_fn=mapping)
+        .training(train_batch_size=512, minibatch_size=128,
+                  num_epochs=4, lr=3e-3, entropy_coeff=0.01)
+    )
+    algo = MultiAgentPPO(config)
+    try:
+        best = -np.inf
+        for _ in range(12):
+            result = algo.train()
+            r = result.get("episode_return_mean")
+            if r is not None and not np.isnan(r):
+                best = max(best, r)
+            if best >= 13.0:
+                break
+        # random play scores ~8/16; learned play should clearly beat it
+        assert best >= 13.0, f"best return {best}"
+    finally:
+        algo.stop()
+
+
+def test_multi_agent_checkpoint_roundtrip(tmp_path):
+    policies = {"p0": None, "p1": None}
+    mapping = lambda a: "p0" if a == "a0" else "p1"  # noqa: E731
+    config = (
+        MultiAgentPPOConfig()
+        .environment(env=lambda: MatchingEnv())
+        .multi_agent(policies=policies, policy_mapping_fn=mapping)
+        .training(train_batch_size=128, minibatch_size=64, num_epochs=1)
+    )
+    algo = MultiAgentPPO(config)
+    try:
+        algo.train()
+        algo.save_checkpoint(str(tmp_path))
+        w_before = {mid: lg.get_weights()
+                    for mid, lg in algo.learner_groups.items()}
+        algo.train()  # mutate
+        algo.load_checkpoint(str(tmp_path))
+        import jax
+        for mid, w in w_before.items():
+            restored = algo.learner_groups[mid].get_weights()
+            for a, b in zip(jax.tree_util.tree_leaves(w),
+                            jax.tree_util.tree_leaves(restored)):
+                np.testing.assert_allclose(a, b)
+    finally:
+        algo.stop()
+
+
+class VanishingAgentEnv(MultiAgentEnv):
+    """a1 leaves (no obs, no term flag) after step 3; a0 runs 8 steps."""
+
+    possible_agents = ["a0", "a1"]
+
+    def __init__(self):
+        import gymnasium as gym
+
+        obs_sp = gym.spaces.Box(0.0, 1.0, (4,), np.float32)
+        act_sp = gym.spaces.Discrete(2)
+        self.observation_spaces = {a: obs_sp for a in self.possible_agents}
+        self.action_spaces = {a: act_sp for a in self.possible_agents}
+        self._t = 0
+
+    def _obs_for(self, agents):
+        return {a: np.ones(4, np.float32) for a in agents}
+
+    def reset(self, *, seed=None):
+        self._t = 0
+        return self._obs_for(self.possible_agents), {}
+
+    def step(self, actions):
+        self._t += 1
+        live = (self.possible_agents if self._t < 3 else ["a0"])
+        done = self._t >= 8
+        obs = self._obs_for(live if not done else self.possible_agents)
+        rewards = {a: 1.0 for a in actions}
+        terms = {a: done for a in self.possible_agents}
+        terms["__all__"] = done
+        truncs = {a: False for a in self.possible_agents}
+        truncs["__all__"] = False
+        return obs, rewards, terms, truncs, {}
+
+
+def test_vanishing_agent_fragment_not_lost():
+    import jax
+
+    specs = _specs(["p0", "p1"])
+    runner = MultiAgentEnvRunner(
+        VanishingAgentEnv, specs, lambda a: "p0" if a == "a0" else "p1",
+        seed=0)
+    runner.set_weights({
+        mid: specs[mid].build().init_params(jax.random.PRNGKey(i))
+        for i, mid in enumerate(specs)})
+    out = runner.sample(num_steps=20)
+    # a1's 3-step fragment closed as truncated when it vanished mid-episode
+    assert out["p1"], "vanished agent's episode was dropped"
+    assert all(ep.length == 3 and ep.truncated for ep in out["p1"][:1])
+    # a0 kept playing to the episode end
+    assert any(ep.terminated for ep in out["p0"])
