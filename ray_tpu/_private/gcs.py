@@ -633,6 +633,32 @@ class GcsServer:
             for a in self.actors.values()
         ]
 
+    async def rpc_list_events(self, req):
+        """Recent structured events, served from the GCS host's event
+        dir (all daemons of a multi-node-on-one-machine cluster write
+        there; remote-machine raylet events are not forwarded — same
+        node-local scope as the reference's event agent). A short TTL
+        cache bounds the re-read cost under dashboard polling."""
+        now = time.time()
+        cached = getattr(self, "_events_cache", None)
+        if cached is not None and now - cached[0] < 2.0:
+            return cached[1]
+        out = export_events.list_events()[-500:]
+        self._events_cache = (now, out)
+        return out
+
+    async def rpc_list_jobs(self, req):
+        return [
+            {
+                "job_id": jb["job_id"],
+                "driver_addr": jb.get("driver_addr", ""),
+                "start_time": jb.get("start_time"),
+                "end_time": jb.get("end_time"),
+                "finished": jb.get("finished", False),
+            }
+            for jb in self.jobs.values()
+        ]
+
     async def rpc_report_actor_death(self, req):
         await self._on_actor_failure(req["actor_id"], req.get("reason", "died"))
         return {"ok": True}

@@ -13,6 +13,7 @@ from __future__ import annotations
 import asyncio
 import json
 import threading
+import time
 from typing import Optional
 
 _INDEX_HTML = """<!doctype html>
@@ -34,12 +35,20 @@ function esc(v) {
     '>':'&gt;','"':'&quot;',"'":'&#39;'}[c]));
 }
 async function refresh() {
-  const [nodes, actors, summary] = await Promise.all([
+  const [nodes, actors, summary, jobs, res, events] = await Promise.all([
     fetch('/api/nodes').then(r => r.json()),
     fetch('/api/actors').then(r => r.json()),
     fetch('/api/task_summary').then(r => r.json()),
+    fetch('/api/jobs').then(r => r.json()),
+    fetch('/api/cluster_resources').then(r => r.json()),
+    fetch('/api/events').then(r => r.json()),
   ]);
-  let html = '<h2>Nodes</h2><table><tr><th>id</th><th>alive</th>' +
+  let html = '<h2>Cluster</h2><table><tr><th>total</th>' +
+             '<th>available</th></tr>' +
+             `<tr><td>${esc(JSON.stringify(res.total))}</td>` +
+             `<td>${esc(JSON.stringify(res.available))}</td></tr>` +
+             '</table>';
+  html += '<h2>Nodes</h2><table><tr><th>id</th><th>alive</th>' +
              '<th>resources</th><th>available</th></tr>';
   for (const n of nodes) {
     html += `<tr><td>${esc(n.NodeID.slice(0,12))}</td>` +
@@ -60,6 +69,23 @@ async function refresh() {
   for (const [name, states] of Object.entries(summary)) {
     html += `<tr><td>${esc(name)}</td>` +
             `<td>${esc(JSON.stringify(states))}</td></tr>`;
+  }
+  html += '</table><h2>Jobs</h2><table><tr><th>id</th>' +
+          '<th>driver</th><th>state</th><th>runtime</th></tr>';
+  for (const jb of jobs) {
+    html += `<tr><td>${esc(jb.job_id.slice(0,12))}</td>` +
+            `<td>${esc(jb.driver_addr)}</td>` +
+            `<td>${jb.finished ? 'FINISHED' : 'RUNNING'}</td>` +
+            `<td>${esc(jb.runtime_s ?? '?')}s</td></tr>`;
+  }
+  html += '</table><h2>Recent events</h2><table><tr><th>time</th>' +
+          '<th>severity</th><th>source</th><th>label</th>' +
+          '<th>message</th></tr>';
+  for (const ev of events.slice(-25).reverse()) {
+    const ts = new Date(ev.ts * 1000).toLocaleTimeString();
+    html += `<tr><td>${esc(ts)}</td><td>${esc(ev.severity)}</td>` +
+            `<td>${esc(ev.source)}</td><td>${esc(ev.label)}</td>` +
+            `<td>${esc(ev.message)}</td></tr>`;
   }
   html += '</table>';
   document.getElementById('out').innerHTML = html;
@@ -138,6 +164,22 @@ class Dashboard:
             j(lambda: {"total": ray_tpu.cluster_resources(),
                        "available": ray_tpu.available_resources()}))
         app.router.add_get("/api/cluster_load", j(cluster_load))
+
+        def jobs_with_runtime():
+            # duration computed server-side so browser clock skew can't
+            # produce negative runtimes
+            now = time.time()
+            out = state_api.list_jobs()
+            for jb in out:
+                start = jb.get("start_time")
+                end = jb["end_time"] if jb.get("finished") else now
+                jb["runtime_s"] = (round(end - start, 1)
+                                   if start is not None else None)
+            return out
+
+        app.router.add_get("/api/jobs", j(jobs_with_runtime))
+        app.router.add_get("/api/events",
+                           j(lambda: state_api.list_cluster_events()[-200:]))
 
         loop = asyncio.new_event_loop()
         asyncio.set_event_loop(loop)

@@ -18,6 +18,14 @@ class DataContext:
     default_batch_size: int = 1024
     read_parallelism: int = 8
     shuffle_partitions: Optional[int] = None
+    # push-based shuffle (reference DataContext.use_push_based_shuffle /
+    # the magnet-style pipelined shuffle): mappers' partials are merged
+    # incrementally in rounds of `shuffle_merge_factor` blocks, so
+    # reducer fan-in (and peak arg memory) is bounded by the merge
+    # factor instead of the input block count. Engages automatically
+    # when an exchange has more inputs than the merge factor.
+    use_push_based_shuffle: bool = True
+    shuffle_merge_factor: int = 8
     eager_free: bool = True
 
     _instance = None

@@ -57,6 +57,11 @@ def _reduce_concat(*parts: Block) -> Block:
     return BlockAccessor.concat(list(parts))
 
 
+# merge stage of the push-based exchange: folding a round's partition
+# pieces (plus the running merged block) IS a concat
+_merge_partials = _reduce_concat
+
+
 def _reduce_shuffle(seed: Optional[int], part_idx: int = 0,
                     *parts: Block) -> Block:
     block = BlockAccessor.concat(list(parts))
@@ -251,6 +256,10 @@ class StreamingExecutor:
         if p == 1:
             # degenerate exchange: one reduce over all input blocks
             return [rf.remote(*extra_args(0), *inputs)]
+        if (self.ctx.use_push_based_shuffle
+                and len(inputs) > self.ctx.shuffle_merge_factor):
+            return self._exchange_push(inputs, p, assign_fn, reduce_fn,
+                                       rf, extra_args)
         split_rf = self._remote.get(_split_for_partition, num_returns=p)
         cols = self._windowed([
             (lambda b=b: split_rf.remote(b, assign_fn, p)) for b in inputs])
@@ -260,6 +269,45 @@ class StreamingExecutor:
             submit.append(lambda i=i, parts=parts_i:
                           rf.remote(*extra_args(i), *parts))
         return self._windowed(submit)
+
+    def _exchange_push(self, inputs: List[Any], p: int, assign_fn,
+                       reduce_fn, reduce_rf, extra_args) -> List[Any]:
+        """Push-based (pipelined-merge) exchange.
+
+        Reference: the push-based shuffle behind
+        `DataContext.use_push_based_shuffle` (`python/ray/data/
+        _internal/planner/exchange/push_based_shuffle_task_scheduler.py`)
+        — instead of every reducer consuming one partial from EVERY map
+        task (fan-in = num input blocks, all partials alive at once),
+        map tasks run in rounds of `shuffle_merge_factor` and each
+        round's partials are merged into a running per-partition block.
+        Fan-in of any task is bounded by the merge factor + 1, partials
+        die after their round's merge, and merging for round r overlaps
+        the split tasks of round r+1 through the windowed submitter.
+        """
+        k = self.ctx.shuffle_merge_factor
+        split_rf = self._remote.get(_split_for_partition, num_returns=p)
+        merge_rf = self._remote.get(_merge_partials)
+        merged: List[Any] = [None] * p
+        for start in range(0, len(inputs), k):
+            round_blocks = inputs[start:start + k]
+            cols = self._windowed([
+                (lambda b=b: split_rf.remote(b, assign_fn, p))
+                for b in round_blocks])
+            submit = []
+            for i in range(p):
+                parts = [cols[j][i] for j in range(len(round_blocks))]
+                if merged[i] is not None:
+                    parts = [merged[i]] + parts
+                submit.append(lambda parts=parts: merge_rf.remote(*parts))
+            merged = self._windowed(submit)
+        if reduce_fn is _reduce_concat:
+            # the merged blocks already ARE the concatenated partitions —
+            # a final concat-of-one reduce would just re-copy everything
+            return merged
+        return self._windowed([
+            (lambda i=i: reduce_rf.remote(*extra_args(i), merged[i]))
+            for i in range(p)])
 
     def _exec_limit(self, op: L.Limit) -> List[Any]:
         inputs = self._exec(op.input_op)

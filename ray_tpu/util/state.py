@@ -60,6 +60,28 @@ def list_actors(limit: int = 1000) -> List[Dict[str, Any]]:
     ]
 
 
+def list_jobs() -> List[Dict[str, Any]]:
+    cw = _core_worker()
+    raw = cw._run_sync(cw.gcs.call("list_jobs", {}))
+    return [
+        {
+            "job_id": jb["job_id"].hex(),
+            "driver_addr": jb.get("driver_addr", ""),
+            "start_time": jb.get("start_time"),
+            "end_time": jb.get("end_time"),
+            "finished": jb.get("finished", False),
+        }
+        for jb in raw
+    ]
+
+
+def list_cluster_events() -> List[Dict[str, Any]]:
+    """Recent structured cluster events via the GCS (reference:
+    `ray list cluster-events`)."""
+    cw = _core_worker()
+    return cw._run_sync(cw.gcs.call("list_events", {}))
+
+
 def list_nodes() -> List[Dict[str, Any]]:
     import ray_tpu
 

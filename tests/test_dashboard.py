@@ -75,4 +75,13 @@ def test_dashboard_rest_and_html():
     tl = json.loads(urllib.request.urlopen(
         base + "/api/timeline", timeout=30).read())
     assert isinstance(tl, list)
+
+    jobs = json.loads(urllib.request.urlopen(
+        base + "/api/jobs", timeout=30).read())
+    assert len(jobs) >= 1  # this driver's job
+    assert all(not jb["finished"] or jb["end_time"] for jb in jobs)
+
+    events = json.loads(urllib.request.urlopen(
+        base + "/api/events", timeout=30).read())
+    assert isinstance(events, list)  # GCS/raylet lifecycle events
     ray_tpu.kill(v)

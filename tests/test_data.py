@@ -259,3 +259,49 @@ def test_from_arrow_to_arrow():
     total = data.from_arrow(table).map_batches(
         lambda b: {"z": b["x"] + b["y"]}).to_pandas()["z"].sum()
     assert total == sum(i + 2 * i for i in range(12))
+
+
+def test_push_based_shuffle_paths():
+    """With many input blocks and a small merge factor, repartition/
+    shuffle/sort/groupby route through the push-based (pipelined-merge)
+    exchange and must produce identical results to the pull-based path."""
+    from ray_tpu.data.context import DataContext
+
+    ctx = DataContext.get_current()
+    old_factor, old_flag = ctx.shuffle_merge_factor, \
+        ctx.use_push_based_shuffle
+    try:
+        ctx.shuffle_merge_factor = 3
+        ctx.use_push_based_shuffle = True
+        # 12 blocks > merge factor 3 -> push path engages
+        ds = rd.range(240, parallelism=12)
+        assert ds.repartition(4).count() == 240
+        vals = [r["id"] for r in
+                rd.range(240, parallelism=12)
+                .random_shuffle(seed=3).take_all()]
+        assert sorted(vals) == list(range(240))
+        assert vals != list(range(240))
+
+        rng = np.random.default_rng(1)
+        raw = rng.permutation(300)
+        out = [r["v"] for r in
+               rd.from_numpy({"v": raw}, parallelism=12)
+               .sort("v").take_all()]
+        assert out == sorted(out)
+
+        items = [{"k": i % 4, "v": float(i)} for i in range(120)]
+        sums = {r["k"]: r["sum(v)"] for r in
+                rd.from_items(items, parallelism=12)
+                .groupby("k").sum("v").take_all()}
+        assert sums == {k: float(sum(i for i in range(120) if i % 4 == k))
+                        for k in range(4)}
+
+        # pull path (flag off) agrees exactly on the same seed
+        ctx.use_push_based_shuffle = False
+        vals_pull = [r["id"] for r in
+                     rd.range(240, parallelism=12)
+                     .random_shuffle(seed=3).take_all()]
+        assert vals_pull == vals
+    finally:
+        ctx.shuffle_merge_factor = old_factor
+        ctx.use_push_based_shuffle = old_flag
