@@ -121,7 +121,6 @@ class LlamaBlock(nn.Module):
     def __call__(self, x, deterministic: bool = True):
         cfg = self.config
         hd = cfg.head_dim
-        groups = cfg.n_head // cfg.n_kv_head
 
         h = RMSNorm(cfg.norm_eps, cfg.dtype, cfg.param_dtype,
                     name="attn_norm")(x)
@@ -138,11 +137,10 @@ class LlamaBlock(nn.Module):
         cos, sin = rope_tables(cfg.max_seq_len, hd, cfg.rope_theta)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
-        # GQA -> expand KV to query heads (XLA turns repeat into a
-        # broadcast inside the attention einsum; no HBM copy)
-        if groups > 1:
-            k = jnp.repeat(k, groups, axis=2)
-            v = jnp.repeat(v, groups, axis=2)
+        # GQA: KV keeps its n_kv_head heads here — every attention_fn
+        # (dense/ring/Ulysses via expand_kv_heads, flash via its KV
+        # index map) handles the grouping itself, so the expansion is a
+        # broadcast (or nothing at all), never an HBM copy
         q = nn.with_logical_constraint(q, ("batch", "seq", "heads", None))
         k = nn.with_logical_constraint(k, ("batch", "seq", "heads", None))
         v = nn.with_logical_constraint(v, ("batch", "seq", "heads", None))

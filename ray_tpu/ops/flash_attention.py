@@ -94,12 +94,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_q):
     o_ref[0, 0, :, :] = (o / l).astype(o_ref.dtype)
 
 
-def _fwd(q, k, v, scale, causal, block_q, interpret):
+def _fwd(q, k, v, scale, causal, block_q, group, interpret):
     b, h, t, d = q.shape
     grid = (b, h, t // block_q)
     q_spec = pl.BlockSpec((1, 1, block_q, d),
                           lambda bi, hi, qi: (bi, hi, qi, 0))
-    kv_spec = pl.BlockSpec((1, 1, t, d), lambda bi, hi, qi: (bi, hi, 0, 0))
+    # GQA: query head hi reads KV head hi // group (group == 1 -> MHA)
+    kv_spec = pl.BlockSpec((1, 1, t, d),
+                           lambda bi, hi, qi: (bi, hi // group, 0, 0))
     return pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, causal=causal,
                           block_q=block_q),
@@ -117,13 +119,15 @@ def _fwd(q, k, v, scale, causal, block_q, interpret):
 
 def _bwd_kernel(q_ref, k_ref, v_ref, o_ref, do_ref,
                 dq_ref, dk_ref, dv_ref, dk_acc, dv_acc, *,
-                scale, causal, block_q):
-    # grid = (b, h, nq); nq is innermost-sequential: accumulate dK/dV for
-    # this (b, h) in f32 VMEM scratch, flush on the last Q block.
+                scale, causal, block_q, group):
+    # grid = (b, h, nq); h then nq iterate sequentially on a TPU core:
+    # accumulate dK/dV in f32 VMEM scratch across a KV head's whole
+    # group of query heads (GQA) x Q blocks, flush once per KV head.
+    hi = pl.program_id(1)
     qi = pl.program_id(2)
     nq = pl.num_programs(2)
 
-    @pl.when(qi == 0)
+    @pl.when((qi == 0) & (hi % group == 0))
     def _():
         dk_acc[...] = jnp.zeros_like(dk_acc)
         dv_acc[...] = jnp.zeros_like(dv_acc)
@@ -160,29 +164,31 @@ def _bwd_kernel(q_ref, k_ref, v_ref, o_ref, do_ref,
         (((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)                   # [T, d]
 
-    @pl.when(qi == nq - 1)
+    @pl.when((qi == nq - 1) & (hi % group == group - 1))
     def _():
         dk_ref[0, 0, :, :] = dk_acc[...].astype(dk_ref.dtype)
         dv_ref[0, 0, :, :] = dv_acc[...].astype(dv_ref.dtype)
 
 
-def _bwd(scale, causal, block_q, interpret, res, g):
+def _bwd(scale, causal, block_q, group, interpret, res, g):
     q, k, v, out = res
     b, h, t, d = q.shape
+    h_kv = k.shape[1]
     grid = (b, h, t // block_q)
     q_spec = pl.BlockSpec((1, 1, block_q, d),
                           lambda bi, hi, qi: (bi, hi, qi, 0))
-    kv_spec = pl.BlockSpec((1, 1, t, d), lambda bi, hi, qi: (bi, hi, 0, 0))
+    kv_spec = pl.BlockSpec((1, 1, t, d),
+                           lambda bi, hi, qi: (bi, hi // group, 0, 0))
     dq, dk, dv = pl.pallas_call(
         functools.partial(_bwd_kernel, scale=scale, causal=causal,
-                          block_q=block_q),
+                          block_q=block_q, group=group),
         grid=grid,
         in_specs=[q_spec, kv_spec, kv_spec, q_spec, q_spec],
         out_specs=[q_spec, kv_spec, kv_spec],
         out_shape=[
             jax.ShapeDtypeStruct((b, h, t, d), q.dtype),
-            jax.ShapeDtypeStruct((b, h, t, d), k.dtype),
-            jax.ShapeDtypeStruct((b, h, t, d), v.dtype),
+            jax.ShapeDtypeStruct((b, h_kv, t, d), k.dtype),
+            jax.ShapeDtypeStruct((b, h_kv, t, d), v.dtype),
         ],
         scratch_shapes=[pltpu.VMEM((t, d), jnp.float32),
                         pltpu.VMEM((t, d), jnp.float32)],
@@ -195,13 +201,13 @@ def _bwd(scale, causal, block_q, interpret, res, g):
 # public API
 # --------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, scale, causal, block_q, interpret):
-    return _fwd(q, k, v, scale, causal, block_q, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, scale, causal, block_q, group, interpret):
+    return _fwd(q, k, v, scale, causal, block_q, group, interpret)
 
 
-def _flash_fwd(q, k, v, scale, causal, block_q, interpret):
-    out = _fwd(q, k, v, scale, causal, block_q, interpret)
+def _flash_fwd(q, k, v, scale, causal, block_q, group, interpret):
+    out = _fwd(q, k, v, scale, causal, block_q, group, interpret)
     return out, (q, k, v, out)
 
 
@@ -211,7 +217,10 @@ _flash.defvjp(_flash_fwd, _bwd)
 def flash_attention(q, k, v, *, causal: bool = True,
                     scale: Optional[float] = None,
                     block_q: Optional[int] = None):
-    """Drop-in for `full_attention`: q/k/v are [B, T, H, head_dim].
+    """Drop-in for `full_attention`: q is [B, T, H, head_dim]; k/v may
+    carry fewer (grouped-query) heads — [B, T, H_kv, head_dim] with
+    H % H_kv == 0 — which the kernel serves natively via its KV index
+    map, with no query-side KV expansion in HBM.
 
     Falls back to the XLA dense path when (a) not running on TPU (the
     interpret-mode kernel is for tests, not speed), (b) the shape doesn't
@@ -221,14 +230,15 @@ def flash_attention(q, k, v, *, causal: bool = True,
     this kernel is the single-chip hot path.
     """
     b, t, h, d = q.shape
+    h_kv = k.shape[2]
     if scale is None:
         scale = d ** -0.5
     bq = block_q or _pick_block_q(t)
-    if (bq == 0 or t % bq or t > 4096 or d % 64
+    if (bq == 0 or t % bq or t > 4096 or d % 64 or h % h_kv
             or jax.default_backend() != "tpu"):
         from ray_tpu.parallel.ring_attention import full_attention
         return full_attention(q, k, v, causal=causal, scale=scale)
     # kernel layout is [B, H, T, d] so the T dim is block-sliceable
     qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
-    out = _flash(qt, kt, vt, scale, causal, bq, False)
+    out = _flash(qt, kt, vt, scale, causal, bq, h // h_kv, False)
     return out.transpose(0, 2, 1, 3)

@@ -48,12 +48,25 @@ def _block_attend(q, k, v, q_offset, kv_offset, causal: bool,
     return out, row_max, row_sum
 
 
+def expand_kv_heads(q, k, v):
+    """GQA inputs (fewer KV heads than Q heads) -> repeat KV query-side.
+    XLA folds the repeat into the attention einsum as a broadcast; the
+    pallas flash kernel instead handles grouping natively and never
+    calls this."""
+    if k.shape[2] != q.shape[2]:
+        groups = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, groups, axis=2)
+        v = jnp.repeat(v, groups, axis=2)
+    return k, v
+
+
 def full_attention(q, k, v, *, causal: bool = True,
                    scale: Optional[float] = None):
     """Dense (unsharded) softmax attention — the single-device reference
     all sharded variants must match."""
     if scale is None:
         scale = q.shape[-1] ** -0.5
+    k, v = expand_kv_heads(q, k, v)
     out, _, row_sum = _block_attend(q, k, v, 0, 0, causal, scale)
     return out / jnp.maximum(row_sum, 1e-20).transpose(0, 2, 1)[..., None]
 
@@ -79,8 +92,12 @@ def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool,
 
         def attend(operands):
             acc, row_max, row_sum = operands
+            # GQA KV rotates the ring at its narrow h_kv width; the
+            # expansion here feeds straight into the block einsum, so
+            # XLA lowers it to a broadcast, not an HBM copy
+            k_e, v_e = expand_kv_heads(q, k_blk, v_blk)
             out, blk_max, blk_sum = _block_attend(
-                q, k_blk, v_blk, q_offset, kv_offset, causal, scale
+                q, k_e, v_e, q_offset, kv_offset, causal, scale
             )
             new_max = jnp.maximum(row_max, blk_max)
             old_scale = jnp.exp(row_max - new_max)
@@ -173,6 +190,10 @@ def ulysses_attention(
         scale = q.shape[-1] ** -0.5
     if axis_name not in mesh.axis_names or mesh.shape[axis_name] == 1:
         return full_attention(q, k, v, causal=causal, scale=scale)
+    if k.shape[2] % mesh.shape[axis_name]:
+        # GQA with kv heads not divisible by sp: the head all_to_all
+        # can't split h_kv evenly — expand first (full-width comm)
+        k, v = expand_kv_heads(q, k, v)
     batch = tuple(a for a in batch_axes if a in mesh.axis_names)
     bspec = batch if len(batch) > 1 else (batch[0] if batch else None)
     spec = P(bspec, axis_name, None, None)

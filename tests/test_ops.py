@@ -24,7 +24,9 @@ def _flash_bthd(q, k, v, causal, block_q=128):
     # test through the raw kernel with interpret=True (public wrapper
     # only engages the kernel on real TPU)
     qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
-    out = _flash(qt, kt, vt, q.shape[-1] ** -0.5, causal, block_q, True)
+    group = q.shape[2] // k.shape[2]
+    out = _flash(qt, kt, vt, q.shape[-1] ** -0.5, causal, block_q,
+                 group, True)
     return out.transpose(0, 2, 1, 3)
 
 
@@ -53,6 +55,54 @@ def test_flash_grads_match_dense(causal):
         scale = float(jnp.abs(a).max())
         np.testing.assert_allclose(np.asarray(b) / scale,
                                    np.asarray(a) / scale, atol=1e-5)
+
+
+@pytest.mark.parametrize("h_kv", [1, 2])
+def test_flash_gqa_matches_expanded_dense(h_kv):
+    """Grouped-query attention through the kernel's KV index map must
+    equal dense attention over query-side-expanded KV — forward and
+    both KV gradients (dK/dV accumulate across each head group)."""
+    rng = np.random.default_rng(3)
+    b, t, h, d = 2, 256, 4, 64
+    q = jnp.asarray(rng.standard_normal((b, t, h, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, t, h_kv, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, t, h_kv, d)), jnp.float32)
+    group = h // h_kv
+
+    def expand(x):
+        return jnp.repeat(x, group, axis=2)
+
+    ref = full_attention(q, expand(k), expand(v), causal=True)
+    got = _flash_bthd(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(full_attention(q, expand(k), expand(v),
+                                      causal=True) ** 2)
+
+    def loss_fl(q, k, v):
+        return jnp.sum(_flash_bthd(q, k, v, causal=True) ** 2)
+
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    gf = jax.grad(loss_fl, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(gr, gf):
+        scale = float(jnp.abs(a).max())
+        np.testing.assert_allclose(np.asarray(b_) / scale,
+                                   np.asarray(a) / scale, atol=1e-5)
+
+
+def test_gqa_autoexpand_in_dense_path():
+    """full_attention accepts unexpanded GQA KV directly (the Llama
+    block passes n_kv_head KV to any attention_fn)."""
+    rng = np.random.default_rng(4)
+    q = jnp.asarray(rng.standard_normal((2, 64, 4, 32)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((2, 64, 2, 32)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((2, 64, 2, 32)), jnp.float32)
+    ref = full_attention(q, jnp.repeat(k, 2, axis=2),
+                         jnp.repeat(v, 2, axis=2), causal=True)
+    got = full_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref))
 
 
 def test_flash_block_q_shapes():
