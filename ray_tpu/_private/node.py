@@ -36,7 +36,7 @@ class ProcessHandle:
 
 
 def _spawn(args: List[str], log_path: str, ready_prefix: str,
-           timeout: float = 120.0, env: dict | None = None,
+           timeout: float = 240.0, env: dict | None = None,
            detach: bool = False) -> ProcessHandle:
     """Spawn a daemon and wait for its READY line. `detach` puts it in
     its own session (CLI-started nodes that outlive the launcher). The

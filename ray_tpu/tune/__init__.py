@@ -11,6 +11,7 @@ from ray_tpu.tune.schedulers import (
     FIFOScheduler,
     HyperBandScheduler,
     MedianStoppingRule,
+    PB2,
     PopulationBasedTraining,
     TrialScheduler,
 )
@@ -53,6 +54,7 @@ __all__ = [
     "HyperBandScheduler",
     "MaximumIterationStopper",
     "MedianStoppingRule",
+    "PB2",
     "PopulationBasedTraining",
     "ResultGrid",
     "Searcher",

@@ -262,4 +262,138 @@ class PopulationBasedTraining(TrialScheduler):
                 new_config = self._mutate(donor_trial.config)
                 controller.exploit_trial(trial, new_config,
                                          self._ckpts[donor])
+                self._on_exploit(tid)
         return self.CONTINUE
+
+    def _on_exploit(self, trial_id: str) -> None:
+        """Hook for subclasses observing exploit events (PB2 resets its
+        reward-improvement baseline so the donor-checkpoint score jump
+        is never credited to the new config)."""
+
+
+class PB2(PopulationBasedTraining):
+    """Population-Based Bandits (reference `tune/schedulers/pb2.py`):
+    PBT's exploit machinery, but explore selects new hyperparameters by
+    GP-UCB over the observed (time, config) -> reward-improvement
+    surface instead of random 0.8x/1.2x perturbation — far more sample
+    efficient for small populations.
+
+    The reference wraps GPy; here the GP is ~40 lines of numpy (RBF
+    kernel, fixed lengthscale in the normalized unit cube, jittered
+    Cholesky solve) — no external dependency, same acquisition shape
+    (UCB over random candidates within `hyperparam_bounds`).
+    """
+
+    def __init__(self, metric: Optional[str] = None,
+                 mode: Optional[str] = None,
+                 time_attr: str = "training_iteration",
+                 perturbation_interval: int = 4,
+                 hyperparam_bounds: Optional[
+                     Dict[str, List[float]]] = None,
+                 quantile_fraction: float = 0.25,
+                 ucb_kappa: float = 2.0,
+                 num_candidates: int = 256,
+                 seed: Optional[int] = None):
+        if not hyperparam_bounds:
+            raise ValueError("PB2 requires hyperparam_bounds="
+                             "{name: [low, high], ...}")
+        super().__init__(metric=metric, mode=mode, time_attr=time_attr,
+                         perturbation_interval=perturbation_interval,
+                         hyperparam_mutations={},
+                         quantile_fraction=quantile_fraction, seed=seed)
+        self.bounds = {k: (float(lo), float(hi))
+                       for k, (lo, hi) in hyperparam_bounds.items()}
+        self.kappa = ucb_kappa
+        self.num_candidates = num_candidates
+        # observation rows: [t, hp..., reward-improvement-per-step]
+        self._obs: List[List[float]] = []
+        self._seg_start: Dict[str, tuple] = {}  # tid -> (t, raw score)
+
+    # -- data collection ---------------------------------------------------
+
+    def on_trial_add(self, controller, trial) -> None:
+        missing = [k for k in self.bounds if k not in trial.config]
+        if missing:
+            raise ValueError(
+                f"hyperparam_bounds keys {missing} not present in trial "
+                f"config {sorted(trial.config)} — PB2 would silently "
+                f"optimize nothing")
+
+    def _on_exploit(self, trial_id: str) -> None:
+        # drop the exploited trial's segment baseline: its next report
+        # starts from the donor checkpoint, and crediting that score
+        # jump to the freshly selected config would poison the GP
+        self._seg_start.pop(trial_id, None)
+
+    def on_trial_result(self, controller, trial, result: Dict) -> str:
+        if self.metric in result:
+            t = float(result.get(self.time_attr, 0))
+            score = self._val(result)
+            tid = trial.trial_id
+            if tid in self._seg_start:
+                t0, s0 = self._seg_start[tid]
+                if t > t0:
+                    row = [t]
+                    row += [float(trial.config.get(k, lo))
+                            for k, (lo, _) in self.bounds.items()]
+                    row.append((score - s0) / (t - t0))
+                    self._obs.append(row)
+                    if len(self._obs) > 500:  # bound GP cost
+                        self._obs = self._obs[-500:]
+            self._seg_start[tid] = (t, score)
+        return super().on_trial_result(controller, trial, result)
+
+    # -- GP-UCB explore (replaces PBT's random perturbation) ---------------
+
+    def _mutate(self, config: Dict[str, Any]) -> Dict[str, Any]:
+        import numpy as np
+
+        new = dict(config)
+        keys = list(self.bounds)
+        if len(self._obs) < 4:
+            # cold start: uniform sample within bounds
+            for k in keys:
+                lo, hi = self.bounds[k]
+                new[k] = self._cast(config.get(k), lo + (hi - lo)
+                                    * self.rng.random())
+            return new
+
+        data = np.asarray(self._obs, np.float64)
+        X_raw, y = data[:, :-1], data[:, -1]
+        # normalize X to the unit cube (time axis by its observed range)
+        lows = np.array([X_raw[:, 0].min()]
+                        + [self.bounds[k][0] for k in keys])
+        highs = np.array([max(X_raw[:, 0].max(), lows[0] + 1e-9)]
+                         + [self.bounds[k][1] for k in keys])
+        X = (X_raw - lows) / np.maximum(highs - lows, 1e-12)
+        y_mu, y_sd = y.mean(), max(y.std(), 1e-9)
+        yn = (y - y_mu) / y_sd
+
+        ls, noise = 0.3, 1e-3
+        def kern(A, B):
+            d2 = ((A[:, None, :] - B[None, :, :]) ** 2).sum(-1)
+            return np.exp(-d2 / (2 * ls * ls))
+
+        K = kern(X, X) + noise * np.eye(len(X))
+        L = np.linalg.cholesky(K + 1e-8 * np.eye(len(X)))
+        alpha = np.linalg.solve(L.T, np.linalg.solve(L, yn))
+
+        # candidates at the current (max observed) time
+        rs = np.random.default_rng(self.rng.randrange(2 ** 31))
+        cand = rs.uniform(size=(self.num_candidates, len(keys) + 1))
+        cand[:, 0] = 1.0  # "now" in normalized time
+        Ks = kern(cand, X)
+        mu = Ks @ alpha
+        v = np.linalg.solve(L, Ks.T)
+        var = np.maximum(1.0 - (v * v).sum(0), 1e-12)
+        ucb = mu + self.kappa * np.sqrt(var)
+        best = cand[int(ucb.argmax())]
+        for i, k in enumerate(keys):
+            lo, hi = self.bounds[k]
+            new[k] = self._cast(config.get(k), lo + (hi - lo)
+                                * float(best[i + 1]))
+        return new
+
+    @staticmethod
+    def _cast(old, val):
+        return int(round(val)) if isinstance(old, int) else float(val)
