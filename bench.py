@@ -32,9 +32,11 @@ BASELINES = {
     "1_1_actor_calls_sync": 2097.0,
     "1_1_actor_calls_async": 9063.0,
     "n_n_actor_calls_async": 27688.0,
+    "single_client_tasks_sync": 971.0,
     "single_client_tasks_async": 8194.0,
     "single_client_put_gigabytes": 20.1,
     "single_client_get_calls": 10270.0,
+    "placement_group_create_removal": 839.0,
 }
 
 A100_BF16_PEAK = 312e12
@@ -246,6 +248,31 @@ def bench_control_plane():
             ray_tpu.get(refs)
             n += 1000
         out["single_client_tasks_async"] = n / (time.perf_counter() - start)
+
+        n, start = 0, time.perf_counter()
+        while time.perf_counter() - start < 3.0:
+            ray_tpu.get(noop.remote())
+            n += 1
+        out["single_client_tasks_sync"] = n / (time.perf_counter() - start)
+
+        # placement-group create+remove cycle (reference
+        # `placement_group_create/removal`: 10 trivial PGs per loop).
+        # Create the batch first so the GCS scheduler pass overlaps the
+        # ready-polling (polling serially per PG would measure the 50 ms
+        # poll granularity, not the control plane), and fail loudly if a
+        # PG never schedules instead of counting it as done.
+        n, start = 0, time.perf_counter()
+        while time.perf_counter() - start < 3.0:
+            pgs = [ray_tpu.placement_group([{"CPU": 0.01}])
+                   for _ in range(10)]
+            for pg in pgs:
+                if not pg.ready(timeout=30.0):
+                    raise RuntimeError("placement group never scheduled")
+            for pg in pgs:
+                ray_tpu.remove_placement_group(pg)
+            n += 20  # 10 creations + 10 removals, reference accounting
+        out["placement_group_create_removal"] = (
+            n / (time.perf_counter() - start))
     finally:
         ray_tpu.shutdown()
 

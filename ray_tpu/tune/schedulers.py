@@ -378,10 +378,13 @@ class PB2(PopulationBasedTraining):
         L = np.linalg.cholesky(K + 1e-8 * np.eye(len(X)))
         alpha = np.linalg.solve(L.T, np.linalg.solve(L, yn))
 
-        # candidates at the current (max observed) time
+        # candidates at the latest OBSERVED normalized time — X[:, 0]
+        # collapses to 0 when all rows share one time value, and pinning
+        # candidates at 1.0 would then put them ~1 unit away from every
+        # observation (mu~0, var~1: uniform-random selection in GP garb)
         rs = np.random.default_rng(self.rng.randrange(2 ** 31))
         cand = rs.uniform(size=(self.num_candidates, len(keys) + 1))
-        cand[:, 0] = 1.0  # "now" in normalized time
+        cand[:, 0] = X[:, 0].max()
         Ks = kern(cand, X)
         mu = Ks @ alpha
         v = np.linalg.solve(L, Ks.T)

@@ -182,6 +182,22 @@ class TuneController:
             running += 1
 
     def run(self, timeout: Optional[float] = None) -> List[Trial]:
+        # scheduler/searcher hooks may raise (e.g. PB2 validating its
+        # hyperparam_bounds against a trial config) — never leak live
+        # trial actors on the way out
+        try:
+            return self._run(timeout)
+        except Exception:
+            for t in self.trials:
+                if not t.is_finished:
+                    try:
+                        self._terminate(t, exp.ERROR,
+                                        error="controller aborted")
+                    except Exception:  # noqa: BLE001
+                        pass
+            raise
+
+    def _run(self, timeout: Optional[float] = None) -> List[Trial]:
         deadline = time.monotonic() + timeout if timeout else None
         stop_all = False
         while True:
