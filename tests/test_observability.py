@@ -154,7 +154,7 @@ def test_cli_status_and_list(tmp_path):
     out = subprocess.run(
         [sys.executable, "-m", "ray_tpu", "start", "--head",
          "--port", "0", "--resources", '{"CPU": 2.0}'],
-        capture_output=True, text=True, env=env, timeout=120)
+        capture_output=True, text=True, env=env, timeout=300)
     assert out.returncode == 0, out.stderr
     assert "GCS started at" in out.stdout
 
@@ -164,14 +164,14 @@ def test_cli_status_and_list(tmp_path):
     status = subprocess.run(
         [sys.executable, "-m", "ray_tpu", "status",
          "--address", gcs_addr],
-        capture_output=True, text=True, env=env, timeout=120)
+        capture_output=True, text=True, env=env, timeout=300)
     assert status.returncode == 0, status.stderr
     assert "alive node(s)" in status.stdout
 
     nodes = subprocess.run(
         [sys.executable, "-m", "ray_tpu", "list", "nodes",
          "--address", gcs_addr],
-        capture_output=True, text=True, env=env, timeout=120)
+        capture_output=True, text=True, env=env, timeout=300)
     assert nodes.returncode == 0, nodes.stderr
     assert gcs_addr.split(":")[0] in nodes.stdout  # host appears
 
