@@ -1,0 +1,62 @@
+"""Offline RL: record a behavior dataset, train CQL from it, evaluate.
+
+The pipeline the reference documents for offline RL: (1) log episodes
+with an output writer, (2) train a conservative Q-learner purely from
+the logged data, (3) evaluate the learned policy on the real env.
+"""
+import _bootstrap  # noqa: F401  (repo-checkout import shim)
+# sim-env RL is latency-bound; see rllib_ppo.py
+import jax
+jax.config.update("jax_platforms", "cpu")
+import tempfile
+
+import numpy as np
+
+from ray_tpu.rllib import CQL, CQLConfig
+from ray_tpu.rllib.env.env_runner import Episode
+from ray_tpu.rllib.offline.io import JsonWriter
+
+if __name__ == "__main__":
+    import gymnasium as gym
+
+    # 1) behavior dataset: random torques on Pendulum
+    data_dir = tempfile.mkdtemp(prefix="pendulum_offline_")
+    env = gym.make("Pendulum-v1")
+    writer, rng, episodes = JsonWriter(data_dir), np.random.default_rng(0), []
+    for i in range(30):
+        obs, _ = env.reset(seed=i)
+        ep = Episode()
+        for _ in range(60):
+            a = rng.uniform(-2.0, 2.0, size=(1,)).astype(np.float32)
+            nxt, r, term, trunc, _ = env.step(a)
+            ep.obs.append(np.asarray(obs, np.float32))
+            ep.actions.append(a)
+            ep.rewards.append(float(r))
+            ep.logps.append(0.0)
+            ep.vf_preds.append(0.0)
+            obs = nxt
+        ep.truncated = True
+        ep.last_obs = np.asarray(obs, np.float32)
+        episodes.append(ep)
+    writer.write(episodes)
+    env.close()
+    print(f"recorded {len(episodes)} episodes to {data_dir}")
+
+    # 2) offline training + 3) greedy eval on the real env
+    algo = (
+        CQLConfig()
+        .environment("Pendulum-v1")
+        .offline_data(input_=data_dir)
+        .training(train_batch_size=64, num_updates_per_iteration=32,
+                  cql_alpha=5.0, num_sampled_actions=4)
+        .evaluation(evaluation_interval=2, evaluation_duration=400)
+        .build_algo()
+    )
+    for i in range(4):
+        r = algo.train()
+        line = (f"iter {i}: q_loss={r['q_loss']:.2f} "
+                f"cql_gap={r['cql_loss']:.2f}")
+        if "evaluation" in r:
+            line += f" eval_return={r['evaluation']['episode_return_mean']:.0f}"
+        print(line)
+    algo.stop()
