@@ -107,24 +107,26 @@ def _bench_train(model, loss_fn, vocab_size: int, batch: int, seq: int,
     return batch * seq * steps / elapsed, n_params
 
 
-def bench_gpt2_tokens_per_sec(steps: int = 20):
+def bench_gpt2_tokens_per_sec(steps: int = 20, batch: int = None,
+                              seq: int = None):
     from functools import partial
 
     import jax
 
     from ray_tpu.models import GPT, GPTConfig
+    from ray_tpu.models.gpt import flops_per_token as gpt_flops_per_token
     from ray_tpu.ops import flash_attention, fused_cross_entropy
 
     dev = jax.devices()[0]
     on_tpu = dev.platform == "tpu"
     # sized for one chip; on CPU shrink so the bench stays fast
     if on_tpu:
-        cfg = GPTConfig.gpt2_125m(remat=False)
-        batch, seq = 16, 1024
+        batch, seq = batch or 16, seq or 1024
+        cfg = GPTConfig.gpt2_125m(remat=False, max_seq_len=seq)
         peak_flops = _tpu_peak_bf16_flops(dev)
     else:
         cfg = GPTConfig.tiny()
-        batch, seq = 4, 128
+        batch, seq = batch or 4, seq or 128
         peak_flops = None
 
     # single-chip hot path: pallas flash attention (scores never touch
@@ -138,10 +140,9 @@ def bench_gpt2_tokens_per_sec(steps: int = 20):
     tokens_per_sec, n_params = _bench_train(
         model, loss_fn, cfg.vocab_size, batch, seq, steps)
 
-    # PaLM appendix-B accounting: 6N matmul + 12*L*h*s attention
-    # flops per token (fwd+bwd).
-    flops_per_token = 6 * n_params + \
-        12 * cfg.n_layer * cfg.d_model * seq
+    # PaLM appendix-B accounting (6N + attention term), shared with the
+    # model module so the two can't drift
+    fpt = gpt_flops_per_token(cfg, seq)
     result = {
         "tokens_per_sec_per_chip": round(tokens_per_sec, 1),
         "platform": dev.platform,
@@ -150,12 +151,28 @@ def bench_gpt2_tokens_per_sec(steps: int = 20):
         "seq": seq,
     }
     if peak_flops is not None:
-        mfu = tokens_per_sec * flops_per_token / peak_flops
-        a100_tokens = A100_ASSUMED_MFU * A100_BF16_PEAK / flops_per_token
+        mfu = tokens_per_sec * fpt / peak_flops
+        a100_tokens = A100_ASSUMED_MFU * A100_BF16_PEAK / fpt
         result["mfu"] = round(mfu, 4)
         result["vs_baseline"] = round(
             tokens_per_sec / (NORTH_STAR_FACTOR * a100_tokens), 3)
     return result
+
+
+def bench_gpt2_long_context(steps: int = 10):
+    """Single-chip long-context: GPT-2 at seq 4096 through the flash
+    kernel (dense attention's f32 scores would be ~3.2 GB per layer at
+    this shape). Multi-chip long context is ring/Ulysses attention —
+    exercised by the driver's dryrun, not benchable on one chip."""
+    import jax
+
+    if jax.devices()[0].platform != "tpu":
+        return {"skipped": "no TPU"}
+    out = bench_gpt2_tokens_per_sec(steps=steps, batch=4, seq=4096)
+    # vs_baseline is the seq-1024 north-star comparison; at 4096 the
+    # per-token flops differ, so only throughput + MFU are meaningful
+    out.pop("vs_baseline", None)
+    return out
 
 
 def bench_llama_tokens_per_sec(steps: int = 20):
@@ -330,6 +347,11 @@ def main():
         suite["llama_125m_train"] = bench_llama_tokens_per_sec()
     except Exception as e:  # noqa: BLE001
         suite["llama_125m_train"] = {"error": repr(e)[:300]}
+
+    try:
+        suite["gpt2_long_context_4096"] = bench_gpt2_long_context()
+    except Exception as e:  # noqa: BLE001
+        suite["gpt2_long_context_4096"] = {"error": repr(e)[:300]}
 
     try:
         cp = bench_control_plane()
