@@ -379,6 +379,8 @@ def main():
                 }
         except Exception as e:  # noqa: BLE001
             suite["control_plane_error"] = repr(e)[:300]
+    else:
+        suite["control_plane"] = {"skipped": "budget"}
 
     if "tokens_per_sec_per_chip" in gpt2 and gpt2.get("platform") == "tpu":
         headline = {

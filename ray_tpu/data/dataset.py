@@ -80,6 +80,38 @@ class Dataset:
     def zip(self, other: "Dataset") -> "Dataset":
         return self._derive(L.Zip(self._op, other._op))
 
+    def _global_agg(self, kind: str, on: Optional[str]):
+        label = f"{kind}({on or ''})"
+        rows = self.groupby(None)._agg([(kind, on, label)]).take_all()
+        return rows[0][label] if rows else None
+
+    def sum(self, on: str):
+        """Scalar column sum (reference `Dataset.sum`)."""
+        return self._global_agg("sum", on)
+
+    def min(self, on: str):
+        return self._global_agg("min", on)
+
+    def max(self, on: str):
+        return self._global_agg("max", on)
+
+    def mean(self, on: str):
+        return self._global_agg("mean", on)
+
+    def std(self, on: str):
+        return self._global_agg("std", on)
+
+    def unique(self, column: str) -> List[Any]:
+        """Distinct values of a column (reference `Dataset.unique`).
+        Sorted when the values are orderable, else first-seen order."""
+        out: Dict[Any, None] = {}
+        for row in self.select_columns([column]).iter_rows():
+            out.setdefault(row[column])
+        try:
+            return sorted(out)
+        except TypeError:  # mixed / None values have no total order
+            return list(out)
+
     def groupby(self, key: Optional[str]) -> "GroupedData":
         return GroupedData(self, key)
 
@@ -153,6 +185,74 @@ class Dataset:
 
     def iterator(self) -> DataIterator:
         return DataIterator(self._execute())
+
+    def random_sample(self, fraction: float,
+                      *, seed: Optional[int] = None) -> "Dataset":
+        """Bernoulli row sample (reference `Dataset.random_sample`):
+        every row gets an independent draw (duplicate rows sample
+        independently). Deterministic per (seed, partitioning) — the
+        per-block RNG is derived from the block's content, not builtin
+        hash() (which is per-process randomized)."""
+        if not 0.0 <= fraction <= 1.0:
+            raise ValueError(f"fraction must be in [0, 1]: {fraction}")
+        base = int(np.random.default_rng(seed).integers(0, 2 ** 31))
+
+        def sample_block(batch: Dict[str, Any]) -> Dict[str, Any]:
+            import zlib
+            n = len(next(iter(batch.values()))) if batch else 0
+            if n == 0:
+                return batch
+            h = zlib.crc32(b"".join(
+                np.ascontiguousarray(v).tobytes()
+                for _, v in sorted(batch.items())))
+            mask = np.random.default_rng(
+                (base, h)).random(n) < fraction
+            return {k: np.asarray(v)[mask] for k, v in batch.items()}
+
+        return self.map_batches(sample_block, batch_size=None)
+
+    def train_test_split(self, test_size: float, *,
+                         shuffle: bool = False,
+                         seed: Optional[int] = None
+                         ) -> Tuple["Dataset", "Dataset"]:
+        """(train, test) row split (reference `Dataset.train_test_split`:
+        test gets the LAST `test_size` fraction of rows; pass
+        shuffle=True to randomize first)."""
+        if not 0.0 < test_size < 1.0:
+            raise ValueError(
+                f"test_size must be in (0, 1): {test_size}")
+        ds = self.random_shuffle(seed=seed) if shuffle else self
+        refs = ds._execute()
+        ds = Dataset(L.InputBlocks(refs))
+        ds._materialized = refs
+        # one parallel count pass gives total + per-block sizes without
+        # moving any block bytes to the driver
+        rf_count = ray_tpu.remote(_count_rows)
+        counts = ray_tpu.get([rf_count.remote(b) for b in refs],
+                             timeout=600)
+        total = int(sum(counts))
+        n_test = int(total * test_size)
+        train = ds.limit(total - n_test)
+        # tail slice: skip the first total-n_test rows
+
+        @ray_tpu.remote
+        def _tail(block, skip):
+            acc = BlockAccessor(block)
+            return acc.slice(min(skip, acc.num_rows()), acc.num_rows())
+
+        out, seen = [], 0
+        cut = total - n_test
+        for b, rows in zip(refs, counts):
+            if seen + rows <= cut:
+                pass  # entirely train
+            elif seen >= cut:
+                out.append(b)  # entirely test
+            else:
+                out.append(_tail.remote(b, cut - seen))
+            seen += rows
+        test = Dataset(L.InputBlocks(out))
+        test._materialized = out
+        return train, test
 
     def split(self, n: int) -> List["Dataset"]:
         """Split into n datasets by round-robin over blocks (reference

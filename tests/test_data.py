@@ -305,3 +305,49 @@ def test_push_based_shuffle_paths():
     finally:
         ctx.shuffle_merge_factor = old_factor
         ctx.use_push_based_shuffle = old_flag
+
+
+def test_scalar_aggregates_and_unique():
+    ds = rd.from_items([{"k": i % 3, "v": float(i)} for i in range(30)],
+                       parallelism=4)
+    assert ds.sum("v") == sum(range(30))
+    assert ds.min("v") == 0.0
+    assert ds.max("v") == 29.0
+    assert abs(ds.mean("v") - 14.5) < 1e-9
+    assert ds.unique("k") == [0, 1, 2]
+    # mixed/None columns fall back to first-seen order instead of raising
+    mixed = rd.from_items([{"k": 1}, {"k": None}, {"k": 1}],
+                          parallelism=1)
+    vals = mixed.unique("k")
+    assert len(vals) == 2 and 1 in vals
+
+
+def test_random_sample():
+    ds = rd.range(2000, parallelism=4)
+    frac = ds.random_sample(0.3, seed=5)
+    n = frac.count()
+    assert 400 < n < 800  # ~600 expected
+    # deterministic per (seed, partitioning)
+    assert rd.range(2000, parallelism=4).random_sample(
+        0.3, seed=5).count() == n
+    # duplicate rows draw independently (not all-or-nothing)
+    dup = rd.from_items([{"x": 1}] * 1000, parallelism=2)
+    m = dup.random_sample(0.5, seed=1).count()
+    assert 300 < m < 700, m
+
+
+def test_train_test_split():
+    ds = rd.range(100, parallelism=4)
+    train, test = ds.train_test_split(0.25)
+    train_ids = [r["id"] for r in train.take_all()]
+    test_ids = [r["id"] for r in test.take_all()]
+    assert len(train_ids) == 75 and len(test_ids) == 25
+    # unshuffled contract: test is the LAST fraction, order preserved
+    assert sorted(train_ids + test_ids) == list(range(100))
+    assert test_ids == list(range(75, 100))
+
+    train, test = ds.train_test_split(0.25, shuffle=True, seed=0)
+    ids = sorted([r["id"] for r in train.take_all()]
+                 + [r["id"] for r in test.take_all()])
+    assert ids == list(range(100))
+    assert test.count() == 25
