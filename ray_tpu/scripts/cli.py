@@ -171,6 +171,35 @@ def cmd_list(args):
         ray_tpu.shutdown()
 
 
+def cmd_memory(args):
+    """Object-store usage per node + largest objects (reference
+    `ray memory`: per-process ref table; here the primary-copy view —
+    what each raylet pins in shm and has spilled to disk)."""
+    ray_tpu = _connect(args)
+    from ray_tpu.util import state as state_api
+
+    try:
+        objs = state_api.list_objects(limit=args.limit)
+        by_node = {}
+        for o in objs:
+            agg = by_node.setdefault(
+                o["node_id"], {"shm": 0, "spilled": 0, "count": 0})
+            agg[o["where"]] += o["size"]
+            agg["count"] += 1
+        for node_id, agg in sorted(by_node.items()):
+            print(f"node {node_id[:12]}: {agg['count']} objects, "
+                  f"{agg['shm'] / 1e6:.1f} MB shm, "
+                  f"{agg['spilled'] / 1e6:.1f} MB spilled")
+        print()
+        for o in sorted(objs, key=lambda o: -o["size"])[:args.top]:
+            print(f"{o['object_id'][:16]} {o['size']:>12} B "
+                  f"{o['where']:8} node {o['node_id'][:12]}")
+        total = sum(o["size"] for o in objs)
+        print(f"\n{len(objs)} primary copies, {total / 1e6:.1f} MB total")
+    finally:
+        ray_tpu.shutdown()
+
+
 def cmd_summary(args):
     ray_tpu = _connect(args)
     from ray_tpu.util import state as state_api
@@ -319,6 +348,13 @@ def main(argv=None):
                    choices=["tasks", "actors", "objects", "nodes"])
     p.add_argument("--address")
     p.set_defaults(fn=cmd_list)
+
+    p = sub.add_parser("memory",
+                       help="object-store usage per node + largest objects")
+    p.add_argument("--address")
+    p.add_argument("--limit", type=int, default=10000)
+    p.add_argument("--top", type=int, default=10)
+    p.set_defaults(fn=cmd_memory)
 
     p = sub.add_parser("summary", help="task summary by name/state")
     p.add_argument("--address")

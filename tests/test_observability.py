@@ -260,3 +260,42 @@ def test_events_export_otlp(tmp_path):
             os.environ.pop("RAY_TPU_EVENT_DIR", None)
         else:
             os.environ["RAY_TPU_EVENT_DIR"] = old
+
+
+def test_cli_memory(tmp_path):
+    """`memory` reports per-node object-store usage and largest objects
+    (reference `ray memory`'s primary-copy view)."""
+    env = dict(os.environ)
+    env.pop("RAY_TPU_ADDRESS", None)
+
+    out = subprocess.run(
+        [sys.executable, "-m", "ray_tpu", "start", "--head",
+         "--port", "0", "--resources", '{"CPU": 2.0}'],
+        capture_output=True, text=True, env=env, timeout=300)
+    assert out.returncode == 0, out.stderr
+    with open("/tmp/ray_tpu/cli_node.json") as f:
+        gcs_addr = json.load(f)["gcs_addr"]
+    try:
+        driver = (
+            "import numpy as np\n"
+            "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+            "import ray_tpu\n"
+            f"ray_tpu.init(address={gcs_addr!r})\n"
+            "refs = [ray_tpu.put(np.ones(1 << 20, np.uint8))"
+            " for _ in range(3)]\n"
+            "import time; time.sleep(0.5)\n"
+        )
+        r = subprocess.run([sys.executable, "-c", driver],
+                           capture_output=True, text=True, env=env,
+                           timeout=300)
+        assert r.returncode == 0, r.stderr
+        mem = subprocess.run(
+            [sys.executable, "-m", "ray_tpu", "memory",
+             "--address", gcs_addr],
+            capture_output=True, text=True, env=env, timeout=300)
+        assert mem.returncode == 0, mem.stderr
+        assert "MB shm" in mem.stdout
+        assert "primary copies" in mem.stdout
+    finally:
+        subprocess.run([sys.executable, "-m", "ray_tpu", "stop"],
+                       capture_output=True, env=env, timeout=120)
