@@ -196,6 +196,9 @@ def cmd_memory(args):
                   f"{o['where']:8} node {o['node_id'][:12]}")
         total = sum(o["size"] for o in objs)
         print(f"\n{len(objs)} primary copies, {total / 1e6:.1f} MB total")
+        if len(objs) >= args.limit:
+            print(f"WARNING: listing truncated at --limit {args.limit}; "
+                  f"totals and top-N understate actual usage")
     finally:
         ray_tpu.shutdown()
 
