@@ -203,6 +203,33 @@ def cmd_memory(args):
         ray_tpu.shutdown()
 
 
+def cmd_serve(args):
+    """Operator view of a running Serve instance (reference `serve
+    status` / `serve shutdown`). Pure observer: connects to the existing
+    controller actor by name and never starts one."""
+    ray_tpu = _connect(args)
+    from ray_tpu.serve.controller import CONTROLLER_NAME
+
+    try:
+        try:
+            ctrl = ray_tpu.get_actor(CONTROLLER_NAME)
+        except ValueError:  # actor-not-found; real RPC errors propagate
+            print("no serve instance running")
+            return
+        if args.action == "status":
+            deployments = ray_tpu.get(ctrl.list_deployments.remote(),
+                                      timeout=30)
+            print(json.dumps(deployments, indent=2, default=str))
+        elif args.action == "shutdown":
+            # direct call so a wedged controller FAILS loudly instead of
+            # being swallowed by serve.shutdown()'s best-effort cleanup
+            ray_tpu.get(ctrl.shutdown.remote(), timeout=60)
+            ray_tpu.kill(ctrl)
+            print("serve instance shut down")
+    finally:
+        ray_tpu.shutdown()
+
+
 def cmd_summary(args):
     ray_tpu = _connect(args)
     from ray_tpu.util import state as state_api
@@ -358,6 +385,11 @@ def main(argv=None):
     p.add_argument("--limit", type=int, default=10000)
     p.add_argument("--top", type=int, default=10)
     p.set_defaults(fn=cmd_memory)
+
+    p = sub.add_parser("serve", help="observe/stop a Serve instance")
+    p.add_argument("action", choices=["status", "shutdown"])
+    p.add_argument("--address")
+    p.set_defaults(fn=cmd_serve)
 
     p = sub.add_parser("summary", help="task summary by name/state")
     p.add_argument("--address")

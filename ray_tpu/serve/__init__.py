@@ -83,6 +83,8 @@ def _start_proxy(port: int):
     proxy = cls.options(max_concurrency=16, num_cpus=0).remote(
         _state["controller"], "127.0.0.1", port)
     ray_tpu.get(proxy.ready.remote(), timeout=60)
+    ray_tpu.get(_state["controller"].register_proxy.remote(proxy),
+                timeout=30)
     _state["proxy"] = proxy
 
 
@@ -97,6 +99,8 @@ def _start_grpc_proxy(port: int) -> Dict[str, Any]:
     proxy = cls.options(max_concurrency=16, num_cpus=0).remote(
         _state["controller"], "127.0.0.1", port)
     info = ray_tpu.get(proxy.ready.remote(), timeout=60)
+    ray_tpu.get(_state["controller"].register_proxy.remote(proxy),
+                timeout=30)
     _state["grpc_proxy"] = proxy
     return info
 

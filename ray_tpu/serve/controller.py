@@ -57,6 +57,10 @@ class ServeController:
         # requests: (replica, kill deadline). Reference: graceful replica
         # shutdown in `deployment_state.py` (stop routing → drain → kill).
         self._draining: List[Tuple[Any, float]] = []
+        # proxy actors registered by the driver that started them — the
+        # controller kills them on shutdown so a CLI-issued shutdown
+        # from another process tears the whole instance down
+        self._proxies: List[Any] = []
 
     # -- API ---------------------------------------------------------------
 
@@ -115,6 +119,13 @@ class ServeController:
                     for name, st in self._deployments.items()
                     if st.route_prefix}
 
+    def register_proxy(self, proxy) -> None:
+        """Track a proxy actor so shutdown reaches it from ANY process
+        (reference: the controller owns proxy lifecycle — a CLI-issued
+        shutdown must kill proxies started by some other driver)."""
+        with self._lock:
+            self._proxies.append(proxy)
+
     def shutdown(self) -> None:
         self._running = False
         with self._lock:
@@ -123,6 +134,9 @@ class ServeController:
             for r, _ in self._draining:
                 self._kill(r)
             self._draining = []
+            for p in self._proxies:
+                self._kill(p)
+            self._proxies = []
 
     # -- reconciliation ----------------------------------------------------
 

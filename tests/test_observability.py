@@ -299,3 +299,64 @@ def test_cli_memory(tmp_path):
     finally:
         subprocess.run([sys.executable, "-m", "ray_tpu", "stop"],
                        capture_output=True, env=env, timeout=120)
+
+
+def test_cli_serve_status_and_shutdown(tmp_path):
+    """`serve status` observes a live Serve instance without starting
+    one, and `serve shutdown` stops it (reference serve CLI)."""
+    env = dict(os.environ)
+    env.pop("RAY_TPU_ADDRESS", None)
+
+    out = subprocess.run(
+        [sys.executable, "-m", "ray_tpu", "start", "--head",
+         "--port", "0", "--resources", '{"CPU": 4.0}'],
+        capture_output=True, text=True, env=env, timeout=300)
+    assert out.returncode == 0, out.stderr
+    with open("/tmp/ray_tpu/cli_node.json") as f:
+        gcs_addr = json.load(f)["gcs_addr"]
+    try:
+        # status with no serve instance: observer must not start one
+        st = subprocess.run(
+            [sys.executable, "-m", "ray_tpu", "serve", "status",
+             "--address", gcs_addr],
+            capture_output=True, text=True, env=env, timeout=300)
+        assert st.returncode == 0, st.stderr
+        assert "no serve instance" in st.stdout
+
+        driver = (
+            "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+            "import ray_tpu\n"
+            "from ray_tpu import serve\n"
+            f"ray_tpu.init(address={gcs_addr!r})\n"
+            "@serve.deployment\n"
+            "def echo(x):\n"
+            "    return x\n"
+            "serve.run(echo.bind())\n"
+            "import time; time.sleep(30)\n"
+        )
+        drv = subprocess.Popen([sys.executable, "-c", driver], env=env,
+                               stdout=subprocess.DEVNULL,
+                               stderr=subprocess.DEVNULL)
+        try:
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                st = subprocess.run(
+                    [sys.executable, "-m", "ray_tpu", "serve", "status",
+                     "--address", gcs_addr],
+                    capture_output=True, text=True, env=env, timeout=300)
+                if '"echo"' in st.stdout:
+                    break
+                time.sleep(2)
+            assert '"echo"' in st.stdout, st.stdout
+
+            down = subprocess.run(
+                [sys.executable, "-m", "ray_tpu", "serve", "shutdown",
+                 "--address", gcs_addr],
+                capture_output=True, text=True, env=env, timeout=300)
+            assert down.returncode == 0, down.stderr
+            assert "shut down" in down.stdout
+        finally:
+            drv.terminate()
+    finally:
+        subprocess.run([sys.executable, "-m", "ray_tpu", "stop"],
+                       capture_output=True, env=env, timeout=120)
