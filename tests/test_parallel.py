@@ -171,3 +171,45 @@ def test_moe_dispatch_combines():
     y_ref, _ = apply_moe(x, router_w, expert_w, expert_fn, mesh1,
                          capacity_factor=8.0)
     np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref), atol=1e-4)
+
+
+@pytest.mark.parametrize("variant", ["ring", "ulysses"])
+def test_sequence_parallel_attention_gqa(variant):
+    """GQA (fewer KV heads) through the sequence-parallel paths: ring
+    rotates KV at its narrow h_kv width (expanding per-block); Ulysses
+    all_to_alls the narrow KV then expands post-split. Both must match
+    dense attention over query-side-expanded KV."""
+    import numpy as np
+
+    from ray_tpu.parallel.mesh import build_mesh
+    from ray_tpu.parallel.ring_attention import (full_attention,
+                                                 ring_attention,
+                                                 ulysses_attention)
+
+    mesh = build_mesh({"dp": 2, "sp": 4})
+    rng = np.random.default_rng(0)
+    b, t, h, h_kv, d = 2, 64, 8, 2, 16
+    q = jnp.asarray(rng.standard_normal((b, t, h, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, t, h_kv, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, t, h_kv, d)), jnp.float32)
+    ref = full_attention(q, jnp.repeat(k, h // h_kv, axis=2),
+                         jnp.repeat(v, h // h_kv, axis=2), causal=True)
+    if variant == "ring":
+        with mesh:
+            got = ring_attention(q, k, v, mesh, causal=True,
+                                 head_axis=None)
+    else:
+        # h_kv=2 not divisible by sp=4 -> pre-expansion fallback; also
+        # exercise the narrow path with h_kv=4
+        with mesh:
+            got = ulysses_attention(q, k, v, mesh, causal=True)
+        k4 = jnp.asarray(rng.standard_normal((b, t, 4, d)), jnp.float32)
+        v4 = jnp.asarray(rng.standard_normal((b, t, 4, d)), jnp.float32)
+        ref4 = full_attention(q, jnp.repeat(k4, 2, axis=2),
+                              jnp.repeat(v4, 2, axis=2), causal=True)
+        with mesh:
+            got4 = ulysses_attention(q, k4, v4, mesh, causal=True)
+        np.testing.assert_allclose(np.asarray(got4), np.asarray(ref4),
+                                   atol=2e-5, rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=2e-5, rtol=1e-4)
