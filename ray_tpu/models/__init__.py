@@ -7,6 +7,8 @@ RoPE/SwiGLU/GQA (`llama`), MoE decoders (`moe_gpt`), ResNet convnets
 (`resnet`), Vision Transformers (`vit`).
 """
 
+from ray_tpu.models.bert import (BertConfig, BertEncoder,
+                                 mask_tokens, mlm_loss)
 from ray_tpu.models.gpt import GPT, GPTConfig
 from ray_tpu.models.llama import Llama, LlamaConfig
 from ray_tpu.models.moe_gpt import MoEGPT, MoEGPTConfig
@@ -14,6 +16,7 @@ from ray_tpu.models.resnet import ResNet, ResNetConfig
 from ray_tpu.models.vit import ViT, ViTConfig
 
 __all__ = [
+    "BertConfig", "BertEncoder", "mask_tokens", "mlm_loss",
     "GPT", "GPTConfig", "Llama", "LlamaConfig", "MoEGPT", "MoEGPTConfig",
     "ResNet", "ResNetConfig", "ViT", "ViTConfig",
 ]
