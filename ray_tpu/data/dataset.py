@@ -191,25 +191,23 @@ class Dataset:
         """Bernoulli row sample (reference `Dataset.random_sample`):
         every row gets an independent draw (duplicate rows sample
         independently). Deterministic per (seed, partitioning) — the
-        per-block RNG is derived from the block's content, not builtin
-        hash() (which is per-process randomized)."""
+        per-block RNG mixes the block's position in the dataset with the
+        seed, so identical-content blocks still draw independent masks."""
         if not 0.0 <= fraction <= 1.0:
             raise ValueError(f"fraction must be in [0, 1]: {fraction}")
         base = int(np.random.default_rng(seed).integers(0, 2 ** 31))
 
-        def sample_block(batch: Dict[str, Any]) -> Dict[str, Any]:
-            import zlib
+        def sample_block(batch: Dict[str, Any],
+                         block_idx: int) -> Dict[str, Any]:
             n = len(next(iter(batch.values()))) if batch else 0
             if n == 0:
                 return batch
-            h = zlib.crc32(b"".join(
-                np.ascontiguousarray(v).tobytes()
-                for _, v in sorted(batch.items())))
             mask = np.random.default_rng(
-                (base, h)).random(n) < fraction
+                (base, block_idx)).random(n) < fraction
             return {k: np.asarray(v)[mask] for k, v in batch.items()}
 
-        return self.map_batches(sample_block, batch_size=None)
+        return self._derive(L.MapBatches(
+            self._op, sample_block, None, with_block_index=True))
 
     def train_test_split(self, test_size: float, *,
                          shuffle: bool = False,

@@ -31,8 +31,8 @@ def _run_read(read_task) -> Block:
     return read_task()
 
 
-def _run_transform(transform, block: Block) -> Block:
-    return transform(block)
+def _run_transform(transform, block: Block, idx: int = 0) -> Block:
+    return transform(block, idx)
 
 
 def _count_rows(block: Block) -> int:
@@ -208,7 +208,8 @@ class StreamingExecutor:
             transform = op.make_transform()
             rf = self._remote.get(_run_transform)
             return self._windowed([
-                (lambda b=b: rf.remote(transform, b)) for b in inputs])
+                (lambda b=b, i=i: rf.remote(transform, b, i))
+                for i, b in enumerate(inputs)])
         if isinstance(op, L.Limit):
             return self._exec_limit(op)
         if isinstance(op, L.Repartition):

@@ -47,26 +47,34 @@ class InputBlocks(LogicalOp):
 
 
 class AbstractMap(LogicalOp):
-    """One-to-one block transform; fusable."""
+    """One-to-one block transform; fusable.
 
-    def make_transform(self) -> Callable[[Block], Block]:
+    Transforms take ``(block, block_index)`` — the index is the block's
+    position in the stage's input list, giving deterministic per-block
+    identity to transforms that need it (e.g. ``random_sample``'s RNG).
+    """
+
+    def make_transform(self) -> Callable[[Block, int], Block]:
         raise NotImplementedError
 
 
 class MapBatches(AbstractMap):
     def __init__(self, input_op, fn: Callable, batch_size: Optional[int],
-                 fn_args: tuple = (), fn_kwargs: Optional[dict] = None):
+                 fn_args: tuple = (), fn_kwargs: Optional[dict] = None,
+                 with_block_index: bool = False):
         super().__init__(input_op)
         self.fn = fn
         self.batch_size = batch_size
         self.fn_args = fn_args
         self.fn_kwargs = fn_kwargs or {}
+        self.with_block_index = with_block_index
 
     def make_transform(self):
         fn, bs = self.fn, self.batch_size
         args, kwargs = self.fn_args, self.fn_kwargs
+        with_idx = self.with_block_index
 
-        def transform(block: Block) -> Block:
+        def transform(block: Block, idx: int) -> Block:
             acc = BlockAccessor(block)
             n = acc.num_rows()
             if n == 0:
@@ -75,8 +83,9 @@ class MapBatches(AbstractMap):
             outs = []
             for lo in range(0, n, size):
                 batch = acc.slice(lo, min(lo + size, n))
+                extra = (idx,) if with_idx else ()
                 outs.append(normalize_batch_output(
-                    fn(batch, *args, **kwargs)))
+                    fn(batch, *extra, *args, **kwargs)))
             return BlockAccessor.concat(outs)
 
         return transform
@@ -90,7 +99,7 @@ class MapRows(AbstractMap):
     def make_transform(self):
         fn = self.fn
 
-        def transform(block: Block) -> Block:
+        def transform(block: Block, idx: int) -> Block:
             rows = [fn(r) for r in BlockAccessor(block).iter_rows()]
             return BlockAccessor.from_rows(rows)
 
@@ -105,7 +114,7 @@ class Filter(AbstractMap):
     def make_transform(self):
         fn = self.fn
 
-        def transform(block: Block) -> Block:
+        def transform(block: Block, idx: int) -> Block:
             acc = BlockAccessor(block)
             keep = np.asarray([bool(fn(r)) for r in acc.iter_rows()],
                               dtype=bool)
@@ -122,7 +131,7 @@ class FlatMap(AbstractMap):
     def make_transform(self):
         fn = self.fn
 
-        def transform(block: Block) -> Block:
+        def transform(block: Block, idx: int) -> Block:
             rows: List[dict] = []
             for r in BlockAccessor(block).iter_rows():
                 rows.extend(fn(r))
@@ -140,7 +149,7 @@ class AddColumn(AbstractMap):
     def make_transform(self):
         col, fn = self.col, self.fn
 
-        def transform(block: Block) -> Block:
+        def transform(block: Block, idx: int) -> Block:
             out = dict(block)
             out[col] = np.asarray(fn(BlockAccessor(block)))
             return out
@@ -155,8 +164,8 @@ class DropColumns(AbstractMap):
 
     def make_transform(self):
         cols = set(self.cols)
-        return lambda block: {k: v for k, v in block.items()
-                              if k not in cols}
+        return lambda block, idx: {k: v for k, v in block.items()
+                                   if k not in cols}
 
 
 class SelectColumns(AbstractMap):
@@ -166,13 +175,14 @@ class SelectColumns(AbstractMap):
 
     def make_transform(self):
         cols = list(self.cols)
-        return lambda block: {k: block[k] for k in cols}
+        return lambda block, idx: {k: block[k] for k in cols}
 
 
 class FusedMap(AbstractMap):
     """Fusion product: run several transforms in one task."""
 
-    def __init__(self, input_op, transforms: List[Callable[[Block], Block]],
+    def __init__(self, input_op,
+                 transforms: List[Callable[[Block, int], Block]],
                  fused_names: List[str]):
         super().__init__(input_op)
         self.transforms = transforms
@@ -185,9 +195,9 @@ class FusedMap(AbstractMap):
     def make_transform(self):
         transforms = self.transforms
 
-        def transform(block: Block) -> Block:
+        def transform(block: Block, idx: int) -> Block:
             for t in transforms:
-                block = t(block)
+                block = t(block, idx)
             return block
 
         return transform

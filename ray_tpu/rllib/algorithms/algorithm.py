@@ -49,6 +49,11 @@ class Algorithm(Trainable):
     # -- Trainable interface ----------------------------------------------
 
     def setup(self, config: Dict[str, Any]) -> None:
+        """Shared scaffolding (guard, config merge, output writer,
+        iteration counter); the algorithm-specific spec inference and
+        runner/learner-group construction live in `_build_groups` so
+        variants (e.g. MultiAgentPPO) override that hook instead of
+        re-implementing setup."""
         if self._setup_called:
             return
         self._setup_called = True
@@ -58,6 +63,16 @@ class Algorithm(Trainable):
             cfg.update_from_dict(config)
         self.algo_config = cfg
         env_creator = cfg.env_creator()
+        self._env_creator = env_creator
+        self._build_groups(cfg, env_creator)
+        if cfg.output:
+            from ray_tpu.rllib.offline.io import JsonWriter
+            self._output_writer = JsonWriter(cfg.output)
+        self._iteration = 0
+
+    def _build_groups(self, cfg: AlgorithmConfig, env_creator) -> None:
+        """Infer the module spec from the env and build the learner +
+        env-runner groups. Overridable construction hook."""
         probe = env_creator()
         try:
             obs_dim = int(np.prod(probe.observation_space.shape))
@@ -96,11 +111,6 @@ class Algorithm(Trainable):
             seed=cfg.seed, explore_config=cfg.explore_config)
         self.env_runner_group.sync_weights(
             self.learner_group.get_weights())
-        if cfg.output:
-            from ray_tpu.rllib.offline.io import JsonWriter
-            self._output_writer = JsonWriter(cfg.output)
-        self._env_creator = env_creator
-        self._iteration = 0
 
     @classmethod
     def default_config(cls) -> AlgorithmConfig:

@@ -51,8 +51,14 @@ class CQLLearner(SACLearner):
         log_unif = -jnp.sum(jnp.log(2.0 * jnp.broadcast_to(
             m.scale, (m.spec.action_dim,)) + 1e-8))
 
+        # The conservative term trains the CRITICS only (reference CQL
+        # attaches it to the critic optimizers and detaches the policy
+        # log-probs) — sample from a gradient-stopped copy of the policy
+        # so cql_alpha * logsumexp can't push a spurious actor gradient.
+        frozen_policy = jax.lax.stop_gradient(params["policy"])
+
         def policy_samples(o, k):
-            mean, log_std = m.policy.apply(params["policy"], o)
+            mean, log_std = m.policy.apply(frozen_policy, o)
             ks = jax.random.split(k, n)
             a, logp = jax.vmap(
                 lambda kk: _squash(mean, log_std, kk, m.scale, m.offset)
@@ -72,12 +78,18 @@ class CQLLearner(SACLearner):
             lse = jax.scipy.special.logsumexp(
                 q - log_dens, axis=0) - jnp.log(3.0 * n)
             q_data = m.q.apply(q_params, obs, batch[Columns.ACTIONS])
-            return jnp.mean(lse - q_data)
+            # mean critic value on the policy's own (OOD) actions — the
+            # quantity the conservative penalty is meant to suppress
+            q_ood = jnp.mean(q[n:2 * n])
+            return jnp.mean(lse - q_data), q_ood
 
-        cql_term = ood_term(params["q1"]) + ood_term(params["q2"])
+        t1, ood1 = ood_term(params["q1"])
+        t2, ood2 = ood_term(params["q2"])
+        cql_term = t1 + t2
         loss = sac_loss + cql_alpha * cql_term
         stats = dict(stats)
         stats["cql_loss"] = cql_term
+        stats["q_ood_mean"] = 0.5 * (ood1 + ood2)
         return loss, stats
 
 

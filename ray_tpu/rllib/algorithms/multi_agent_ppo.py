@@ -54,20 +54,15 @@ class MultiAgentPPO(Algorithm):
 
     config_cls = MultiAgentPPOConfig
 
-    def setup(self, config: Dict[str, Any]) -> None:
-        if self._setup_called:
-            return
-        self._setup_called = True
-        cfg = (self._algo_config.copy() if self._algo_config is not None
-               else self.default_config())
-        if config:
-            cfg.update_from_dict(config)
-        self.algo_config = cfg
+    def _build_groups(self, cfg, env_creator) -> None:
+        """Multi-module construction: one LearnerGroup per policy module
+        plus the multi-agent runner fleet (the shared setup scaffolding —
+        config merge, output writer, iteration counter — stays in
+        Algorithm.setup)."""
         if not cfg.policies or cfg.policy_mapping_fn is None:
             raise ValueError(
                 "MultiAgentPPO needs config.multi_agent(policies=..., "
                 "policy_mapping_fn=...)")
-        env_creator = cfg.env_creator()
         mapping = cfg.policy_mapping_fn
 
         # infer unspecified module specs from the env's declared spaces
@@ -110,13 +105,6 @@ class MultiAgentPPO(Algorithm):
                      params_getter=self.learner_groups[mid].get_weights)
             for mid, spec in self.specs.items()
         }
-        self._env_creator = env_creator
-        self._eval_runner = None
-        self._output_writer = None
-        if cfg.output:
-            from ray_tpu.rllib.offline.io import JsonWriter
-            self._output_writer = JsonWriter(cfg.output)
-        self._iteration = 0
 
     def _weights(self) -> Dict[str, Any]:
         return {mid: lg.get_weights()

@@ -161,9 +161,9 @@ class EnvRunnerGroup:
                            seed + 1000 * (i + 1), explore_config)
                 for i in range(num_env_runners)
             ]
-            restart = (lambda: cls.remote(
-                env_creator, spec, num_envs_per_runner, seed,
-                explore_config))
+            restart = (lambda i: cls.remote(
+                env_creator, spec, num_envs_per_runner,
+                seed + 1000 * (i + 1), explore_config))
             self.manager = FaultTolerantActorManager(actors, restart)
 
     def sync_weights(self, weights) -> None:

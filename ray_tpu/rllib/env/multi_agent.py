@@ -229,9 +229,9 @@ class MultiAgentEnvRunnerGroup:
                            seed + 1000 * (i + 1), explore_config)
                 for i in range(num_env_runners)
             ]
-            restart = (lambda: cls.remote(
-                env_creator, specs, policy_mapping_fn, seed,
-                explore_config))
+            restart = (lambda i: cls.remote(
+                env_creator, specs, policy_mapping_fn,
+                seed + 1000 * (i + 1), explore_config))
             self.manager = FaultTolerantActorManager(actors, restart)
 
     def sync_weights(self, weights: Dict[ModuleID, Any]) -> None:

@@ -7,6 +7,7 @@ LearnerGroup) so a dead sampler never sinks the training loop.
 
 from __future__ import annotations
 
+import inspect
 from typing import Any, Callable, List, Optional
 
 import ray_tpu
@@ -14,11 +15,17 @@ import ray_tpu
 
 class FaultTolerantActorManager:
     def __init__(self, actors: List[Any],
-                 restart_fn: Optional[Callable[[], Any]] = None,
+                 restart_fn: Optional[Callable[..., Any]] = None,
                  max_restarts: int = 3):
+        # restart_fn may take zero args or the dead actor's index —
+        # index-aware factories let callers rebuild per-actor state
+        # (e.g. the runner's unique RNG seed) instead of a shared default.
         self._actors = list(actors)
         self._healthy = [True] * len(actors)
         self._restart_fn = restart_fn
+        self._restart_takes_index = bool(
+            restart_fn is not None
+            and inspect.signature(restart_fn).parameters)
         self._restarts = [0] * len(actors)
         self.max_restarts = max_restarts
         self._restarted_idxs: set = set()
@@ -89,7 +96,10 @@ class FaultTolerantActorManager:
                 ray_tpu.kill(self._actors[i])
             except Exception:
                 pass
-            self._actors[i] = self._restart_fn()
+            if self._restart_takes_index:
+                self._actors[i] = self._restart_fn(i)
+            else:
+                self._actors[i] = self._restart_fn()
             self._restarts[i] += 1
             self._healthy[i] = True
             self._restarted_idxs.add(i)
