@@ -11,6 +11,7 @@ from ray_tpu._private.core_worker import (
     ActorDiedError,
     GetTimeoutError,
     ObjectRefGenerator,
+    OutOfMemoryError,
     RayTaskError,
 )
 from ray_tpu._private.object_ref import ObjectRef
@@ -48,6 +49,7 @@ __all__ = [
     "NodeAffinitySchedulingStrategy",
     "ObjectRef",
     "ObjectRefGenerator",
+    "OutOfMemoryError",
     "PlacementGroup",
     "PlacementGroupSchedulingStrategy",
     "RayTaskError",

@@ -35,6 +35,12 @@ class Config:
     # Outstanding worker-lease requests per scheduling key (reference:
     # max_pending_lease_requests_per_scheduling_category).
     max_lease_requests_per_key: int = 8
+    # Tasks pushed to one leased worker before its first reply arrives
+    # (reference: max_tasks_in_flight_per_worker,
+    # direct_task_transport.h:75 lease pipelining). The worker queues
+    # them FIFO; pipelining amortizes the submit round trip for small
+    # tasks.
+    max_tasks_in_flight_per_worker: int = 16
     # Default per-node shared-memory store capacity.
     object_store_memory: int = 2 * 1024**3
     # Object-table slots in the shm store header.
@@ -53,6 +59,16 @@ class Config:
     # Worker pool: keep this many idle workers warm.
     num_prestart_workers: int = 0
     worker_register_timeout_s: float = 30.0
+
+    # --- host memory monitor (reference: memory_monitor.h:52 +
+    # worker_killing_policy_group_by_owner.h) ---
+    # Kill workers when host used/total crosses this fraction; <= 0
+    # disables the monitor.
+    memory_usage_threshold: float = 0.95
+    memory_monitor_refresh_ms: int = 250
+    # Test hook: a file containing "used_bytes total_bytes" read instead
+    # of /proc/meminfo (empty = real host memory).
+    memory_usage_path: str = ""
 
     # --- health / fault tolerance ---
     raylet_heartbeat_period_s: float = 0.5

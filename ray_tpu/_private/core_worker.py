@@ -62,6 +62,12 @@ class ActorDiedError(RayTaskError):
     pass
 
 
+class OutOfMemoryError(RayTaskError):
+    """The raylet's memory monitor killed the worker running this task
+    (reference: ray.exceptions.OutOfMemoryError); the message carries the
+    killing policy's reasoning."""
+
+
 class GetTimeoutError(Exception):
     pass
 
@@ -246,9 +252,17 @@ class CoreWorker:
         # task_event_buffer.h — batched, periodically flushed to the
         # GCS task table for `list tasks` observability).
         self._task_events: List[dict] = []
+        # Submission coalescing: caller threads append specs here and
+        # schedule ONE loop callback per burst instead of one per task —
+        # the flush groups actor tasks into batched push frames
+        # (reference: the submit queue in direct_task_transport.h).
+        self._submit_buffer: deque = deque()  # ("normal"|"actor", spec)
+        self._submit_flush_scheduled = False
 
-        # Executor state (worker mode).
-        self._exec_queue: queue_mod.Queue = queue_mod.Queue()
+        # Executor state (worker mode). SimpleQueue: C-implemented
+        # lock-free handoff — the per-task wakeup is measurably cheaper
+        # than queue.Queue's pure-Python condition variables.
+        self._exec_queue: queue_mod.SimpleQueue = queue_mod.SimpleQueue()
         self._actor_instance = None
         self._actor_threadpool: Optional[ThreadPoolExecutor] = None
         self._actor_async_loop: Optional[asyncio.AbstractEventLoop] = None
@@ -362,8 +376,10 @@ class CoreWorker:
             # the refcount after recording to cover the other order).
             if not self._shutdown:
                 try:
-                    self._loop.call_soon_threadsafe(self._on_ref_released,
-                                                    oid)
+                    # rides the submit buffer: a release between two
+                    # `.remote()` calls shares their loop wakeup instead
+                    # of paying its own
+                    self._submit_enqueue("release", oid)
                 except RuntimeError:
                     pass  # loop already closed at interpreter teardown
         else:
@@ -855,6 +871,21 @@ class CoreWorker:
             return ["r", oid, value.owner_addr or self.address]
         return ["v", serialization.dumps(value)]
 
+    @staticmethod
+    def _args_all_inline(spec: task_mod.TaskSpec) -> bool:
+        return (all(e[0] == "v" for e in spec.args)
+                and all(e[0] == "v" for e in spec.kwargs.values()))
+
+    @staticmethod
+    def _deserialize_inline_args(spec: task_mod.TaskSpec):
+        """Caller/executor-thread decode of all-inline args: pure CPU, no
+        event-loop round trip (the hot path — most tasks ship only
+        by-value args)."""
+        args = [serialization.loads(e[1]) for e in spec.args]
+        kwargs = {k: serialization.loads(e[1])
+                  for k, e in spec.kwargs.items()}
+        return args, kwargs
+
     async def _deserialize_args(self, spec: task_mod.TaskSpec):
         async def resolve(entry):
             if entry[0] == "v":
@@ -933,9 +964,9 @@ class CoreWorker:
         )
         if streaming:
             # plain dict insert; ordered before the task via the same
-            # call_soon_threadsafe queue the enqueue rides on
+            # submit-buffer flush the enqueue rides on
             self._make_stream(spec.task_id)
-            self._loop.call_soon_threadsafe(self._enqueue_task, spec)
+            self._submit_enqueue("normal", spec)
             return ObjectRefGenerator(self, spec.task_id)
         refs = [
             ObjectRef(ObjectID.for_task_return(task_id, i), self.address)
@@ -943,7 +974,7 @@ class CoreWorker:
         ]
         for r in refs:
             self.memory_store.register_thread_waiter(r.binary())
-        self._loop.call_soon_threadsafe(self._enqueue_task, spec)
+        self._submit_enqueue("normal", spec)
         return refs
 
     # ------------------------------------------------------------------
@@ -1186,36 +1217,86 @@ class CoreWorker:
         return {"granted": False, "error": "too many spillback hops"}
 
     async def _drain_with_lease(self, key, state: _KeyState, lease: dict):
+        """Drain the key's queue through one leased worker with a bounded
+        pipeline: up to `max_tasks_in_flight_per_worker` pushes ride the
+        connection before the first reply returns (reference: lease
+        pipelining in direct_task_transport.h:75). The worker executes
+        FIFO, so replies resolve in push order."""
         worker_addr = lease["worker_addr"]
         raylet_addr = lease["raylet_addr"]
         lease_id = lease["lease_id"]
         worker_dead = False
+        # SPREAD asks for per-task placement decisions: pipelining the
+        # queue through one cached lease would funnel every task onto the
+        # first node that answered. One task per lease; the caller loop
+        # re-requests for the rest. (The whole queue shares one strategy:
+        # it's part of the scheduling key.)
+        depth = (1 if state.queue
+                 and state.queue[0][0].strategy == task_mod.STRATEGY_SPREAD
+                 else self.config.max_tasks_in_flight_per_worker)
+        in_flight: deque = deque()  # (spec, retries_left, reply_future)
         try:
-            while state.queue:
-                entry = state.queue.popleft()
-                spec, retries_left = entry
+            try:
+                worker = await self._clients.get(worker_addr)
+            except (ConnectionLost, OSError):
+                # never connected: nothing sent, nothing to fail — the
+                # caller loop re-leases for the still-queued tasks
+                worker_dead = True
+                return
+            while state.queue or in_flight:
+                # Pipeline only the queue's fair share per outstanding
+                # lease: a short queue spread over several pending leases
+                # must not funnel onto the first worker that answers
+                # (that would serialize long tasks that could have run in
+                # parallel), while a long queue pipelines deep to
+                # amortize the push round trip.
+                share = max(1, len(state.queue)
+                            // max(1, state.requesting))
+                window = min(depth, share)
+                while state.queue and len(in_flight) < window:
+                    spec, retries_left = state.queue.popleft()
+                    try:
+                        fut = worker.call_nowait(
+                            "push_task", {"spec": spec.to_wire()})
+                    except (ConnectionLost, OSError):
+                        # not sent: requeue without burning a retry
+                        state.queue.appendleft([spec, retries_left])
+                        worker_dead = True
+                        break
+                    in_flight.append((spec, retries_left, fut))
+                if not in_flight:
+                    return
+                spec, retries_left, fut = in_flight.popleft()
                 try:
-                    worker = await self._clients.get(worker_addr)
-                    reply = await worker.call(
-                        "push_task", {"spec": spec.to_wire()}, timeout=None
-                    )
-                    self._process_task_reply(spec, reply)
+                    reply = await fut
                 except (ConnectionLost, RpcError, OSError) as e:
+                    # every pushed-but-unanswered task fails together; a
+                    # push MAY have executed before the connection died,
+                    # so each requeue burns one retry
                     worker_dead = True
-                    if retries_left > 0:
-                        state.queue.append([spec, retries_left - 1])
-                        state.requesting += 1
-                        asyncio.ensure_future(self._lease_and_run(key, state))
-                    else:
-                        self._store_task_error(
-                            spec, RayTaskError(f"worker died: {e}"))
+                    oom_reason = await self._worker_exit_reason(
+                        raylet_addr, worker_addr)
+                    failed = [(spec, retries_left)]
+                    failed += [(s, r) for s, r, _ in in_flight]
+                    for _s, _r, f in in_flight:
+                        # mark retrieved — abandoned reply futures would
+                        # otherwise log "exception was never retrieved"
+                        f.add_done_callback(
+                            lambda fut: fut.cancelled() or fut.exception())
+                    in_flight.clear()
+                    for s, r in failed:
+                        if r > 0:
+                            state.queue.append([s, r - 1])
+                        elif oom_reason:
+                            self._store_task_error(
+                                s, OutOfMemoryError(oom_reason))
+                        else:
+                            self._store_task_error(
+                                s, RayTaskError(f"worker died: {e}"))
                     return
-                # SPREAD asks for per-task placement decisions: draining the
-                # whole queue through one cached lease would funnel every
-                # task onto the first node that answered. One task per
-                # lease; the caller loop re-requests for the rest.
-                if spec.strategy == task_mod.STRATEGY_SPREAD:
-                    return
+                self._process_task_reply(spec, reply)
+                if depth == 1:
+                    return  # SPREAD: one task per lease
         finally:
             try:
                 raylet = await self._clients.get(raylet_addr)
@@ -1225,6 +1306,21 @@ class CoreWorker:
                 })
             except (ConnectionLost, RpcError, OSError):
                 pass
+
+    async def _worker_exit_reason(self, raylet_addr: str,
+                                  worker_addr: str) -> str | None:
+        """Ask the worker's raylet whether it killed the worker on
+        purpose (memory monitor) — turns a connection loss into an
+        actionable OutOfMemoryError."""
+        try:
+            raylet = await self._clients.get(raylet_addr)
+            reply = await raylet.call("get_worker_exit_reason",
+                                      {"worker_addr": worker_addr},
+                                      timeout=5.0)
+            return reply.get("reason")
+        except (ConnectionLost, RpcError, OSError,
+                asyncio.TimeoutError):
+            return None
 
     def _process_task_reply(self, spec: task_mod.TaskSpec, reply: dict):
         self._emit_task_event(
@@ -1382,7 +1478,7 @@ class CoreWorker:
         )
         if streaming:
             self._make_stream(spec.task_id)
-            self._loop.call_soon_threadsafe(self._actor_enqueue, spec)
+            self._submit_enqueue("actor", spec)
             return ObjectRefGenerator(self, spec.task_id)
         refs = [
             ObjectRef(ObjectID.for_task_return(task_id, i), self.address)
@@ -1390,7 +1486,7 @@ class CoreWorker:
         ]
         for r in refs:
             self.memory_store.register_thread_waiter(r.binary())
-        self._loop.call_soon_threadsafe(self._actor_enqueue, spec)
+        self._submit_enqueue("actor", spec)
         return refs
 
     def _actor_state(self, actor_id: bytes) -> dict:
@@ -1405,34 +1501,118 @@ class CoreWorker:
             }
         return st
 
-    def _actor_enqueue(self, spec: task_mod.TaskSpec):
+    def _submit_enqueue(self, kind: str, spec: task_mod.TaskSpec):
+        """Caller-thread side of submission: buffer the spec and make sure
+        ONE flush callback is scheduled. A burst of `.remote()` calls from
+        a tight loop lands in a single loop wakeup, and the flush batches
+        same-actor tasks into one RPC frame."""
+        self._submit_buffer.append((kind, spec))
+        if not self._submit_flush_scheduled:
+            self._submit_flush_scheduled = True
+            self._loop.call_soon_threadsafe(self._flush_submissions)
+
+    def _flush_submissions(self):
+        # clear-then-drain: a producer appending after the clear schedules
+        # a fresh flush, so no submission is ever stranded in the buffer
+        self._submit_flush_scheduled = False
+        batches: Dict[bytes, list] = {}  # actor_id -> [st,addr,restarts,client,[specs]]
+        while True:
+            try:
+                kind, spec = self._submit_buffer.popleft()
+            except IndexError:
+                break
+            if kind == "normal":
+                self._enqueue_task(spec)
+            elif kind == "actor":
+                self._actor_enqueue(spec, batches)
+            else:  # "release": spec is the released object id
+                self._on_ref_released(spec)
+        for entry in batches.values():
+            self._send_actor_batch(*entry)
+
+    def _actor_enqueue(self, spec: task_mod.TaskSpec,
+                       batches: Dict[bytes, list] | None = None):
         self._emit_task_event(spec.task_id, spec.name, spec.task_type,
                               "SUBMITTED")
         st = self._actor_state(spec.actor_id)
-        # Fast path: actor resolved, connection live, nothing queued — assign
-        # the sequence number and write the frame right now, skipping the
-        # sender/push coroutine hops. The executing side reorders by
-        # (epoch, seq) per caller, so this cannot race the slow path on
-        # ordering.
+        # A spec with by-reference args must NEVER ride a multi-task
+        # batch: the batch's single reply is withheld until every task
+        # finishes, but resolving this spec's ref args may need the
+        # in-band return of an EARLIER task in the same batch (whose
+        # value only arrives in that withheld reply) — deadlock. Send it
+        # as its own frame so upstream replies flow independently.
+        if batches is not None and not self._args_all_inline(spec):
+            # first send whatever batch already accumulated for this
+            # actor (its tasks precede this one in submission order)...
+            entry = batches.pop(spec.actor_id, None)
+            if entry is not None:
+                self._send_actor_batch(*entry)
+            # ...then fall through with batching disabled for this spec
+            batches = None
+        if batches is not None:
+            entry = batches.get(spec.actor_id)
+            if entry is not None:
+                # this flush already fast-paths this actor: ride the batch
+                entry[4].append(spec)
+                return
+        # Fast path: actor resolved, connection live, nothing queued — write
+        # the frame at the end of this flush, skipping the sender/push
+        # coroutine hops. The executing side reorders by (epoch, seq) per
+        # caller, so this cannot race the slow path on ordering.
         if not st["sending"] and not st["queue"] and st.get("instance"):
             addr, restarts = st["instance"]
             client = self._clients.get_cached(addr)
             if client is not None:
-                self._assign_seq(st, addr, restarts, spec)
-                try:
-                    fut = client.call_nowait("push_task",
-                                             {"spec": spec.to_wire()})
-                except (ConnectionLost, OSError) as e:
-                    self._actor_task_failed(st, spec, addr, e)
-                    return
-                fut.add_done_callback(
-                    lambda f, spec=spec, st=st, addr=addr:
-                    self._actor_fast_reply(f, spec, st, addr))
+                if batches is not None:
+                    batches[spec.actor_id] = [st, addr, restarts, client,
+                                              [spec]]
+                else:
+                    self._send_actor_batch(st, addr, restarts, client,
+                                           [spec])
                 return
         st["queue"].append(spec)
         if not st["sending"]:
             st["sending"] = True
             asyncio.ensure_future(self._actor_sender(spec.actor_id, st))
+
+    def _send_actor_batch(self, st: dict, addr: str, restarts: int,
+                          client, specs: list):
+        """Write one frame carrying every fast-path task this flush
+        collected for one actor. Sequence numbers are assigned here, in
+        buffer order."""
+        for spec in specs:
+            self._assign_seq(st, addr, restarts, spec)
+        try:
+            if len(specs) == 1:
+                fut = client.call_nowait("push_task",
+                                         {"spec": specs[0].to_wire()})
+            else:
+                fut = client.call_nowait(
+                    "push_task_batch",
+                    {"specs": [s.to_wire() for s in specs]})
+        except (ConnectionLost, OSError) as e:
+            for spec in specs:
+                self._actor_task_failed(st, spec, addr, e)
+            return
+        if len(specs) == 1:
+            fut.add_done_callback(
+                lambda f, spec=specs[0], st=st, addr=addr:
+                self._actor_fast_reply(f, spec, st, addr))
+        else:
+            fut.add_done_callback(
+                lambda f, specs=specs, st=st, addr=addr:
+                self._actor_batch_reply(f, specs, st, addr))
+
+    def _actor_batch_reply(self, fut: asyncio.Future, specs: list,
+                           st: dict, addr: str):
+        try:
+            replies = fut.result()
+        except (ConnectionLost, RpcError, OSError) as e:
+            for spec in specs:
+                self._actor_task_failed(st, spec, addr, e)
+            return
+        for spec, reply in zip(specs, replies):
+            self._process_task_reply(spec, reply)
 
     def _assign_seq(self, st: dict, addr: str, restarts: int,
                     spec: task_mod.TaskSpec):
@@ -1602,6 +1782,22 @@ class CoreWorker:
             self._exec_queue.put((spec, fut))
         return await fut
 
+    async def rpc_push_task_batch(self, req):
+        """Executor side of the coalesced submit: one frame, many tasks.
+        All are enqueued before the first reply is awaited, and the one
+        reply frame carries every result (submitter batches replies back
+        out to per-task processing)."""
+        futs = []
+        for wire in req["specs"]:
+            spec = task_mod.TaskSpec.from_wire(wire)
+            fut = self._loop.create_future()
+            if spec.task_type == task_mod.ACTOR_TASK:
+                await self._enqueue_ordered(spec, fut)
+            else:
+                self._exec_queue.put((spec, fut))
+            futs.append(fut)
+        return await asyncio.gather(*futs)
+
     async def _enqueue_ordered(self, spec: task_mod.TaskSpec, fut):
         """Per-caller (epoch, seq) ordering (reference: ActorSchedulingQueue).
 
@@ -1670,11 +1866,14 @@ class CoreWorker:
 
     async def _execute_task_async_inner(self, spec: task_mod.TaskSpec):
         try:
-            args, kwargs = await asyncio.wrap_future(
-                asyncio.run_coroutine_threadsafe(
-                    self._deserialize_args(spec), self._loop
+            if self._args_all_inline(spec):
+                args, kwargs = self._deserialize_inline_args(spec)
+            else:
+                args, kwargs = await asyncio.wrap_future(
+                    asyncio.run_coroutine_threadsafe(
+                        self._deserialize_args(spec), self._loop
+                    )
                 )
-            )
             method = getattr(self._actor_instance, spec.method_name)
             result = method(*args, **kwargs)
             if asyncio.iscoroutine(result):
@@ -1702,13 +1901,21 @@ class CoreWorker:
         prev_task = self.current_task_id
         self.current_task_id = TaskID(spec.task_id)
         try:
-            args, kwargs = asyncio.run_coroutine_threadsafe(
-                self._deserialize_args(spec), self._loop
-            ).result()
-            if spec.task_type == task_mod.NORMAL_TASK:
-                fn = asyncio.run_coroutine_threadsafe(
-                    self._load_function(spec.function_key), self._loop
+            # All-inline args decode right here; only by-reference args
+            # need the event loop's async resolution machinery (two
+            # thread hops per task — measurable on small tasks).
+            if self._args_all_inline(spec):
+                args, kwargs = self._deserialize_inline_args(spec)
+            else:
+                args, kwargs = asyncio.run_coroutine_threadsafe(
+                    self._deserialize_args(spec), self._loop
                 ).result()
+            if spec.task_type == task_mod.NORMAL_TASK:
+                fn = self._function_cache.get(spec.function_key)
+                if fn is None:
+                    fn = asyncio.run_coroutine_threadsafe(
+                        self._load_function(spec.function_key), self._loop
+                    ).result()
                 result = fn(*args, **kwargs)
             elif spec.task_type == task_mod.ACTOR_CREATION_TASK:
                 cls = asyncio.run_coroutine_threadsafe(

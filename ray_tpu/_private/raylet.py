@@ -157,6 +157,11 @@ class Raylet:
         self._transfer_handles: Dict[bytes, object] = {}
         self._freed_since_heartbeat = False
         self._actor_workers: Dict[bytes, bytes] = {}  # worker_id -> actor_id
+        # Memory-monitor kill records: owners query these to turn a
+        # generic "worker died" into an actionable OutOfMemoryError
+        # (reference: worker_killing_policy.h surfaces the policy's
+        # reasoning in the task error).
+        self._exit_reasons_by_addr: Dict[str, str] = {}
 
     # ------------------------------------------------------------------
 
@@ -205,6 +210,9 @@ class Raylet:
             asyncio.ensure_future(self._heartbeat_loop()),
             asyncio.ensure_future(self._reap_loop()),
         ]
+        if self.config.memory_usage_threshold > 0:
+            self._bg.append(
+                asyncio.ensure_future(self._memory_monitor_loop()))
         logger.info("raylet %s on %s", self.node_id.hex()[:8], self.server.address)
         return self
 
@@ -339,6 +347,138 @@ class Raylet:
                     self.unassigned_chips.extend(key[1])
                     self._dispatch()
 
+    # ------------------------------------------------------------------
+    # host memory monitor (reference: memory_monitor.h:52 polls host
+    # used/total; worker_killing_policy_group_by_owner.h picks victims)
+    # ------------------------------------------------------------------
+
+    def _host_memory_usage(self) -> tuple[int, int]:
+        """(used_bytes, total_bytes). Reads the test-override file when
+        configured ("used total"), else /proc/meminfo with used =
+        MemTotal - MemAvailable (matches the reference's calculation)."""
+        path = self.config.memory_usage_path
+        if path:
+            try:
+                with open(path) as f:
+                    used, total = f.read().split()
+                return int(used), int(total)
+            except (OSError, ValueError):
+                return 0, 1
+        try:
+            info = {}
+            with open("/proc/meminfo") as f:
+                for line in f:
+                    parts = line.split()
+                    if parts[0] in ("MemTotal:", "MemAvailable:"):
+                        info[parts[0]] = int(parts[1]) * 1024
+            total = info.get("MemTotal:", 0)
+            avail = info.get("MemAvailable:", total)
+            return max(0, total - avail), max(1, total)
+        except OSError:
+            return 0, 1
+
+    async def _memory_monitor_loop(self):
+        period = self.config.memory_monitor_refresh_ms / 1000.0
+        threshold = self.config.memory_usage_threshold
+        while True:
+            await asyncio.sleep(period)
+            used, total = self._host_memory_usage()
+            if used / total <= threshold:
+                continue
+            if self._relieve_memory_pressure(used, total):
+                # give the reap loop + OS a cycle to reclaim the victim
+                # before re-evaluating, or one spike kills every worker
+                await asyncio.sleep(max(period, 0.5))
+
+    def _relieve_memory_pressure(self, used: int, total: int) -> bool:
+        """Free host memory, least harm first: an idle pooled worker
+        (no task lost), else a leased task worker via group-by-owner
+        (the owner with most running tasks loses its newest — retriable
+        — one), else the newest actor worker. Returns True if a kill
+        was issued."""
+        from ray_tpu.util import events as export_events
+
+        pct = f"{used / total:.0%}"
+        header = (f"host memory {pct} ({used >> 20} MiB / "
+                  f"{total >> 20} MiB) over threshold "
+                  f"{self.config.memory_usage_threshold:.0%}")
+        # 1) idle workers: reclaim without failing anything
+        for pool in self._idle.values():
+            while pool:
+                worker = pool.pop()
+                if worker.proc is not None and \
+                        worker.proc.returncode is None:
+                    export_events.report(
+                        "RAYLET", "WARNING", "OOM_IDLE_WORKER_KILLED",
+                        f"{header}; killed idle worker {worker.pid}",
+                        node_id=self.node_id.hex())
+                    worker.proc.kill()
+                    return True
+        # 2) leased (running-task) workers, grouped by owner
+        running = [ls for ls in self._leases.values()
+                   if ls.worker is not None and ls.worker.alive
+                   and ls.worker.proc is not None
+                   and ls.worker.proc.returncode is None]
+        task_leases = [ls for ls in running
+                       if ls.spec.task_type == task_mod.NORMAL_TASK]
+        victim_lease = None
+        if task_leases:
+            groups: Dict[bytes, list] = {}
+            for ls in task_leases:
+                groups.setdefault(ls.spec.owner_worker_id, []).append(ls)
+            biggest = max(groups.values(), key=len)
+            # newest submission = highest lease id: the task that joined
+            # the pressure last dies first (reference group-by-owner
+            # kills the newest of the largest group)
+            victim_lease = max(biggest, key=lambda ls: ls.lease_id)
+            reason = (f"{header}; policy group-by-owner: owner "
+                      f"{victim_lease.spec.owner_worker_id.hex()[:8]} has "
+                      f"{len(biggest)} running task(s), killed the newest "
+                      f"(task {victim_lease.spec.name!r}); the task is "
+                      f"retriable and will be retried if retries remain")
+        elif running:
+            victim_lease = max(running, key=lambda ls: ls.lease_id)
+            reason = (f"{header}; no retriable task to kill, killed the "
+                      f"newest leased worker "
+                      f"(task {victim_lease.spec.name!r})")
+        if victim_lease is not None:
+            worker = victim_lease.worker
+            self._record_exit_reason(worker.addr, reason)
+            export_events.report(
+                "RAYLET", "WARNING", "OOM_WORKER_KILLED", reason,
+                node_id=self.node_id.hex(), pid=worker.pid)
+            worker.proc.kill()
+            return True
+        # 3) actor workers: newest registration dies first
+        for worker_id in reversed(list(self._actor_workers)):
+            worker = self._workers.get(worker_id)
+            if worker is not None and worker.proc is not None \
+                    and worker.proc.returncode is None:
+                reason = (f"{header}; no task workers left, killed the "
+                          f"newest actor worker (pid {worker.pid})")
+                self._record_exit_reason(worker.addr, reason)
+                export_events.report(
+                    "RAYLET", "WARNING", "OOM_ACTOR_KILLED", reason,
+                    node_id=self.node_id.hex(), pid=worker.pid)
+                worker.proc.kill()
+                return True
+        return False
+
+    def _record_exit_reason(self, addr: str, reason: str):
+        # bounded: drop oldest so a long-lived raylet under periodic
+        # pressure never grows this map without limit
+        while len(self._exit_reasons_by_addr) >= 256:
+            self._exit_reasons_by_addr.pop(
+                next(iter(self._exit_reasons_by_addr)))
+        self._exit_reasons_by_addr[addr] = reason
+
+    async def rpc_get_worker_exit_reason(self, req):
+        """Owner-side query: did the raylet kill this worker on purpose
+        (memory monitor)? Lets the submitter surface OutOfMemoryError
+        instead of a generic connection loss."""
+        return {"reason": self._exit_reasons_by_addr.get(
+            req["worker_addr"])}
+
     async def _on_worker_death(self, worker: WorkerHandle):
         from ray_tpu.util import events as export_events
 
@@ -359,10 +499,12 @@ class Raylet:
                 self._release_lease(lease, worker_dead=True)
         actor_id = self._actor_workers.pop(worker.worker_id, None)
         if actor_id is not None:
+            reason = self._exit_reasons_by_addr.get(
+                worker.addr, f"worker process {worker.pid} exited")
             try:
                 await self.gcs.call("report_actor_death", {
                     "actor_id": actor_id,
-                    "reason": f"worker process {worker.pid} exited",
+                    "reason": reason,
                 })
             except (ConnectionLost, RpcError, OSError):
                 pass
@@ -430,6 +572,9 @@ class Raylet:
         return proc
 
     async def rpc_register_worker(self, req):
+        # a fresh worker on a recycled host:port must not inherit a dead
+        # worker's OOM-kill record (its own crash would be misreported)
+        self._exit_reasons_by_addr.pop(req["addr"], None)
         worker = WorkerHandle(
             worker_id=req["worker_id"],
             addr=req["addr"],

@@ -71,42 +71,37 @@ class TaskSpec:
     # (reference: span context in task metadata, tracing_helper.py:326).
     trace_ctx: Optional[dict] = None
 
-    def to_wire(self) -> dict:
-        return {
-            "task_id": self.task_id,
-            "job_id": self.job_id,
-            "name": self.name,
-            "task_type": self.task_type,
-            "function_key": self.function_key,
-            "args": self.args,
-            "kwargs": self.kwargs,
-            "num_returns": self.num_returns,
-            "resources": self.resources,
-            "owner_addr": self.owner_addr,
-            "owner_worker_id": self.owner_worker_id,
-            "actor_id": self.actor_id,
-            "method_name": self.method_name,
-            "seq_no": self.seq_no,
-            "seq_epoch": self.seq_epoch,
-            "max_restarts": self.max_restarts,
-            "max_concurrency": self.max_concurrency,
-            "strategy": self.strategy,
-            "node_id": self.node_id,
-            "soft": self.soft,
-            "placement_group_id": self.placement_group_id,
-            "bundle_index": self.bundle_index,
-            "max_retries": self.max_retries,
-            "runtime_env": self.runtime_env,
-            "detached": self.detached,
-            "actor_name": self.actor_name,
-            "streaming": self.streaming,
-            "trace_ctx": self.trace_ctx,
-        }
+    # Positional wire encoding: a flat msgpack array in field order.
+    # Packing 29 values is ~3x cheaper than a 29-key string map (no key
+    # strings packed/hashed per message), and this is the hottest
+    # serialization in the system — every task submission ships one.
+    _WIRE_FIELDS = (
+        "task_id", "job_id", "name", "task_type", "function_key",
+        "args", "kwargs", "num_returns", "resources", "owner_addr",
+        "owner_worker_id", "actor_id", "method_name", "seq_no",
+        "seq_epoch", "max_restarts", "max_concurrency", "strategy",
+        "node_id", "soft", "placement_group_id", "bundle_index",
+        "max_retries", "runtime_env", "detached", "actor_name",
+        "streaming", "trace_ctx",
+    )
+
+    def to_wire(self) -> list:
+        return [
+            self.task_id, self.job_id, self.name, self.task_type,
+            self.function_key, self.args, self.kwargs, self.num_returns,
+            self.resources, self.owner_addr, self.owner_worker_id,
+            self.actor_id, self.method_name, self.seq_no, self.seq_epoch,
+            self.max_restarts, self.max_concurrency, self.strategy,
+            self.node_id, self.soft, self.placement_group_id,
+            self.bundle_index, self.max_retries, self.runtime_env,
+            self.detached, self.actor_name, self.streaming,
+            self.trace_ctx,
+        ]
 
     @classmethod
-    def from_wire(cls, wire: dict) -> "TaskSpec":
+    def from_wire(cls, wire) -> "TaskSpec":
         # msgpack round-trips lists as lists; args entries arrive as lists.
-        return cls(**wire)
+        return cls(*wire)
 
     def plasma_deps(self) -> List[tuple[bytes, str]]:
         """(object_id, owner_addr) for every by-reference arg."""
@@ -136,3 +131,10 @@ class TaskSpec:
             self.bundle_index,
             env_hash(self.runtime_env),
         )
+
+
+# from_wire unpacks positionally — the wire tuple and the dataclass field
+# order must stay in lockstep or every spec silently corrupts.
+assert TaskSpec._WIRE_FIELDS == tuple(
+    f.name for f in TaskSpec.__dataclass_fields__.values()), \
+    "TaskSpec._WIRE_FIELDS out of sync with field order"

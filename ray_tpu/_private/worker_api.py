@@ -8,6 +8,7 @@ Reference: `python/ray/_private/worker.py` (init/connect/get/put/wait),
 from __future__ import annotations
 
 import atexit
+import dataclasses
 import functools
 import inspect
 import os
@@ -30,6 +31,9 @@ from ray_tpu._private.object_store import ObjectStore
 
 _global_lock = threading.Lock()
 _global_state: Optional["GlobalState"] = None
+# env keys exported for _system_config (cleared on shutdown so one
+# test's overrides never leak into the next cluster)
+_exported_config_env: list = []
 
 
 class GlobalState:
@@ -75,9 +79,21 @@ def init(
             if ignore_reinit_error:
                 return _global_state
             raise RuntimeError("ray_tpu.init() already called")
-        cfg = global_config()
+        # copy — mutating the cached global would leak overrides into
+        # the next init() in this process after shutdown cleans the env
+        cfg = dataclasses.replace(global_config())
         if _system_config:
             cfg.update(_system_config)
+            # daemons (GCS/raylet/workers) are subprocesses reading
+            # Config.from_env() — export the overrides so the whole
+            # cluster, not just this driver, sees them
+            from ray_tpu._private.config import _ENV_PREFIX
+            global _exported_config_env
+            for k, v in _system_config.items():
+                key = _ENV_PREFIX + k.upper()
+                if key not in os.environ:
+                    _exported_config_env.append(key)
+                    os.environ[key] = str(v)
 
         if address is None:
             # CLI-submitted drivers find their cluster through the env
@@ -230,6 +246,10 @@ def shutdown():
         state.core_worker.shutdown()
         if state.owns_cluster and state.cluster is not None:
             state.cluster.shutdown()
+        global _exported_config_env
+        for key in _exported_config_env:
+            os.environ.pop(key, None)
+        _exported_config_env = []
 
 
 def put(value: Any) -> ObjectRef:

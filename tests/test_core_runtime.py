@@ -274,3 +274,32 @@ def test_runtime_context():
     assert info["actor_id"] is not None
     assert info["task_id"] is not None
     ray_tpu.kill(a)
+
+
+def test_actor_burst_with_intra_burst_ref_dependency():
+    """A burst where a later call's argument is an earlier call's ref —
+    submitted back-to-back so they land in ONE submit-buffer flush. The
+    batching fast path must not put both in one batched frame (the
+    batch's single reply would withhold the first result the second
+    task's argument resolution is waiting on — deadlock)."""
+
+    @ray_tpu.remote
+    class Chain:
+        def produce(self, x):
+            return x + 1
+
+        def consume(self, v):
+            return v * 10
+
+    c = Chain.remote()
+    ray_tpu.get(c.produce.remote(0))  # resolve actor (enable fast path)
+    r1 = c.produce.remote(41)
+    r2 = c.consume.remote(r1)  # same burst, depends on r1
+    assert ray_tpu.get(r2, timeout=30) == 420
+    # interleaved bursts keep working and stay ordered
+    refs = []
+    for i in range(20):
+        a = c.produce.remote(i)
+        refs.append(c.consume.remote(a))
+    assert ray_tpu.get(refs, timeout=60) == [(i + 1) * 10
+                                             for i in range(20)]
