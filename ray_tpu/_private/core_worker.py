@@ -854,13 +854,20 @@ class CoreWorker:
     # ------------------------------------------------------------------
 
     def _serialize_args(self, args, kwargs):
+        """Returns (wire_args, wire_kwargs, nested_refs) — nested_refs is
+        True when any by-value payload pickled an ObjectRef buried inside a
+        container. Such specs must not join multi-task actor batches (see
+        `_actor_enqueue`) even though their top-level entries are all
+        by-value."""
+        nested = [False]  # local, not self.<attr>: submits are multi-thread
         wire_args = []
         for a in args:
-            wire_args.append(self._serialize_arg(a))
-        wire_kwargs = {k: self._serialize_arg(v) for k, v in (kwargs or {}).items()}
-        return wire_args, wire_kwargs
+            wire_args.append(self._serialize_arg(a, nested))
+        wire_kwargs = {k: self._serialize_arg(v, nested)
+                       for k, v in (kwargs or {}).items()}
+        return wire_args, wire_kwargs, nested[0]
 
-    def _serialize_arg(self, value):
+    def _serialize_arg(self, value, nested=None):
         if isinstance(value, ObjectRef):
             oid = value.binary()
             mem = self.memory_store
@@ -869,12 +876,25 @@ class CoreWorker:
             if oid in mem.values:
                 return ["v", mem.values[oid]]
             return ["r", oid, value.owner_addr or self.address]
-        return ["v", serialization.dumps(value)]
+        payload, saw_ref = serialization.dumps_with_ref_flag(value)
+        if saw_ref and nested is not None:
+            nested[0] = True
+        return ["v", payload]
 
     @staticmethod
     def _args_all_inline(spec: task_mod.TaskSpec) -> bool:
         return (all(e[0] == "v" for e in spec.args)
                 and all(e[0] == "v" for e in spec.kwargs.values()))
+
+    @classmethod
+    def _batchable(cls, spec: task_mod.TaskSpec) -> bool:
+        """A spec may ride a multi-task actor batch only if it depends on
+        no other object: no top-level by-ref args AND no ObjectRef nested
+        inside a by-value container (the submit side stamps
+        `_nested_refs`; specs built elsewhere default to unbatchable only
+        when the stamp is absent and args are refs)."""
+        return (not getattr(spec, "_nested_refs", False)
+                and cls._args_all_inline(spec))
 
     @staticmethod
     def _deserialize_inline_args(spec: task_mod.TaskSpec):
@@ -935,7 +955,8 @@ class CoreWorker:
         num_returns, resources, max_retries, strategy, node_id, soft,
         placement_group_id, bundle_index, streaming, runtime_env,
     ):
-        wire_args, wire_kwargs = self._serialize_args(args, kwargs)
+        wire_args, wire_kwargs, nested_refs = \
+            self._serialize_args(args, kwargs)
         spec = task_mod.TaskSpec(
             task_id=task_id.binary(),
             job_id=self.job_id.binary(),
@@ -1270,29 +1291,33 @@ class CoreWorker:
                 try:
                     reply = await fut
                 except (ConnectionLost, RpcError, OSError) as e:
-                    # every pushed-but-unanswered task fails together; a
-                    # push MAY have executed before the connection died,
-                    # so each requeue burns one retry
+                    # The worker executes FIFO and replies resolve in push
+                    # order, so of everything in flight only the HEAD (the
+                    # task whose reply we were awaiting) can have started
+                    # executing — it burns a retry (it may have run) and
+                    # carries the OOM blame. Tasks pushed behind it never
+                    # started: requeue them without burning a retry, like
+                    # the never-sent case above. (A reply lost in transit
+                    # could in principle mean the next task also started —
+                    # same at-most-once race the reference accepts.)
                     worker_dead = True
                     oom_reason = await self._worker_exit_reason(
                         raylet_addr, worker_addr)
-                    failed = [(spec, retries_left)]
-                    failed += [(s, r) for s, r, _ in in_flight]
-                    for _s, _r, f in in_flight:
+                    for s, r, f in in_flight:
                         # mark retrieved — abandoned reply futures would
                         # otherwise log "exception was never retrieved"
                         f.add_done_callback(
                             lambda fut: fut.cancelled() or fut.exception())
+                        state.queue.append([s, r])
                     in_flight.clear()
-                    for s, r in failed:
-                        if r > 0:
-                            state.queue.append([s, r - 1])
-                        elif oom_reason:
-                            self._store_task_error(
-                                s, OutOfMemoryError(oom_reason))
-                        else:
-                            self._store_task_error(
-                                s, RayTaskError(f"worker died: {e}"))
+                    if retries_left > 0:
+                        state.queue.append([spec, retries_left - 1])
+                    elif oom_reason:
+                        self._store_task_error(
+                            spec, OutOfMemoryError(oom_reason))
+                    else:
+                        self._store_task_error(
+                            spec, RayTaskError(f"worker died: {e}"))
                     return
                 self._process_task_reply(spec, reply)
                 if depth == 1:
@@ -1408,7 +1433,7 @@ class CoreWorker:
                               next(self._task_counter))
         task_id = TaskID.of(self.job_id, self.current_task_id,
                             next(self._task_counter), actor_id)
-        wire_args, wire_kwargs = self._serialize_args(args, kwargs)
+        wire_args, wire_kwargs, _ = self._serialize_args(args, kwargs)
         spec = task_mod.TaskSpec(
             task_id=task_id.binary(),
             job_id=self.job_id.binary(),
@@ -1460,7 +1485,8 @@ class CoreWorker:
     def _submit_actor_task_traced(self, actor_id, task_id, trace_ctx,
                                   method_name, args, kwargs, num_returns,
                                   streaming):
-        wire_args, wire_kwargs = self._serialize_args(args, kwargs)
+        wire_args, wire_kwargs, nested_refs = \
+            self._serialize_args(args, kwargs)
         spec = task_mod.TaskSpec(
             task_id=task_id.binary(),
             job_id=self.job_id.binary(),
@@ -1476,6 +1502,7 @@ class CoreWorker:
             method_name=method_name,
             streaming=streaming,
         )
+        spec._nested_refs = nested_refs
         if streaming:
             self._make_stream(spec.task_id)
             self._submit_enqueue("actor", spec)
@@ -1535,13 +1562,15 @@ class CoreWorker:
         self._emit_task_event(spec.task_id, spec.name, spec.task_type,
                               "SUBMITTED")
         st = self._actor_state(spec.actor_id)
-        # A spec with by-reference args must NEVER ride a multi-task
-        # batch: the batch's single reply is withheld until every task
-        # finishes, but resolving this spec's ref args may need the
-        # in-band return of an EARLIER task in the same batch (whose
-        # value only arrives in that withheld reply) — deadlock. Send it
-        # as its own frame so upstream replies flow independently.
-        if batches is not None and not self._args_all_inline(spec):
+        # A spec with by-reference args — top-level OR nested inside a
+        # by-value container — must NEVER ride a multi-task batch: the
+        # batch's single reply is withheld until every task finishes, but
+        # resolving this spec's ref args (via get() in the task body for
+        # nested ones) may need the in-band return of an EARLIER task in
+        # the same batch (whose value only arrives in that withheld
+        # reply) — deadlock. Send it as its own frame so upstream replies
+        # flow independently.
+        if batches is not None and not self._batchable(spec):
             # first send whatever batch already accumulated for this
             # actor (its tasks precede this one in submission order)...
             entry = batches.pop(spec.actor_id, None)

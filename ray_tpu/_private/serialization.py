@@ -23,6 +23,8 @@ from typing import Any, Callable, List, Sequence
 
 import cloudpickle
 
+from ray_tpu._private.object_ref import ObjectRef
+
 MAGIC = 0x52415931  # "RAY1"
 _ALIGN = 64
 
@@ -43,8 +45,16 @@ class _Pickler(cloudpickle.CloudPickler):
         super().__init__(
             file, protocol=5, buffer_callback=lambda b: buffers.append(b.raw())
         )
+        # Whether an ObjectRef was pickled anywhere inside the value.
+        # The submit path uses this to keep specs whose args *contain*
+        # refs (even nested in containers) out of multi-task actor
+        # batches — resolving such a ref may need an earlier in-batch
+        # task's withheld reply (deadlock).
+        self.saw_object_ref = False
 
     def reducer_override(self, obj):
+        if type(obj) is ObjectRef:
+            self.saw_object_ref = True
         ser = _custom_serializers.get(type(obj))
         if ser is not None:
             serializer, deserializer = ser
@@ -66,6 +76,16 @@ def serialize(value: Any) -> tuple[bytes, List[memoryview]]:
     f = io.BytesIO()
     _Pickler(f, buffers).dump(value)
     return f.getvalue(), buffers
+
+
+def dumps_with_ref_flag(value: Any) -> tuple[bytes, bool]:
+    """Like `dumps`, additionally reporting whether any ObjectRef was
+    pickled anywhere inside `value` (nested in containers included)."""
+    buffers: List[memoryview] = []
+    f = io.BytesIO()
+    p = _Pickler(f, buffers)
+    p.dump(value)
+    return pack(f.getvalue(), buffers), p.saw_object_ref
 
 
 def serialized_size(pickled: bytes, buffers: Sequence[memoryview]) -> int:

@@ -91,9 +91,13 @@ def init(
             global _exported_config_env
             for k, v in _system_config.items():
                 key = _ENV_PREFIX + k.upper()
-                if key not in os.environ:
-                    _exported_config_env.append(key)
-                    os.environ[key] = str(v)
+                # always export: an explicit _system_config override beats
+                # a pre-existing shell var (which the driver's own Config
+                # already ignored via cfg.update) — otherwise driver and
+                # daemons would run with different values. The prior value
+                # is restored on shutdown.
+                _exported_config_env.append((key, os.environ.get(key)))
+                os.environ[key] = str(v)
 
         if address is None:
             # CLI-submitted drivers find their cluster through the env
@@ -247,8 +251,11 @@ def shutdown():
         if state.owns_cluster and state.cluster is not None:
             state.cluster.shutdown()
         global _exported_config_env
-        for key in _exported_config_env:
-            os.environ.pop(key, None)
+        for key, prior in _exported_config_env:
+            if prior is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = prior
         _exported_config_env = []
 
 

@@ -4,6 +4,13 @@ Reference: `python/ray/tune/execution/tune_controller.py:68` — one
 Trainable actor per trial; the controller pumps `step()` calls, feeds
 results to searcher/scheduler/stopper/loggers, restarts failed trials from
 their last checkpoint, and serves PBT's exploit hook.
+
+Experiment-level persistence (reference
+`python/ray/tune/execution/experiment_state.py`): after every state
+transition the controller atomically writes `experiment_state.pkl` into
+the experiment dir — the trial table plus the live searcher/scheduler/
+stopper objects — so `Tuner.restore(path, trainable)` can resume a sweep
+whose driver died.
 """
 
 from __future__ import annotations
@@ -38,6 +45,9 @@ class TuneController:
         metric: Optional[str] = None,
         mode: str = "max",
         max_trials: Optional[int] = None,
+        restored_trials: Optional[List[Trial]] = None,
+        searcher_done: bool = False,
+        time_budget_s: Optional[float] = None,
     ):
         self.trainable_cls = trainable_cls
         self.searcher = searcher
@@ -53,11 +63,82 @@ class TuneController:
         if metric:
             self.scheduler.set_metric(metric, mode)
         self.max_trials = max_trials
-        self.trials: List[Trial] = []
+        self.time_budget_s = time_budget_s
+        self.trials: List[Trial] = list(restored_trials or [])
         self._actors: Dict[str, Any] = {}
         self._pending_step: Dict[Any, str] = {}  # step ref -> trial_id
         self._actor_cls = ray_tpu.remote(_TrialActor)
-        self._searcher_done = False
+        self._searcher_done = searcher_done
+        self.state_path = os.path.join(experiment_dir,
+                                       "experiment_state.pkl")
+        self._save_failed_warned = False
+        self._in_abort = False
+        self._last_save = 0.0
+        # min seconds between periodic snapshots (the full state —
+        # searcher + every trial's metrics_history — is re-pickled each
+        # save, so per-result saves would cost O(results^2) over a long
+        # sweep; reference Tune throttles experiment checkpointing the
+        # same way). Terminal transitions always save immediately.
+        self.save_period_s = 2.0
+
+    # -- experiment-state persistence --------------------------------------
+
+    def _save_state(self, periodic: bool = False) -> None:
+        """Write-ahead experiment snapshot. The searcher/scheduler/stopper
+        are pickled live so their internal state (TPE history, ASHA rungs,
+        RNG positions) survives a driver death; trials are plain
+        dataclasses. Atomic replace so a crash mid-write never corrupts a
+        resumable state file. Suppressed during abort cleanup: an
+        in-process crash must not overwrite the last healthy snapshot with
+        trials force-marked ERROR (a Python exception should resume no
+        worse than a SIGKILL)."""
+        if self._in_abort:
+            return
+        if periodic and time.monotonic() - self._last_save < \
+                self.save_period_s:
+            return
+        self._last_save = time.monotonic()
+        import cloudpickle
+        state = {
+            "trials": self.trials,
+            "searcher": self.searcher,
+            "scheduler": self.scheduler,
+            "stopper": self.stopper,
+            "metric": self.metric,
+            "mode": self.mode,
+            "max_trials": self.max_trials,
+            "trial_resources": self.trial_resources,
+            "max_failures": self.max_failures,
+            "max_concurrent": self.max_concurrent,
+            "searcher_done": self._searcher_done,
+            "time_budget_s": self.time_budget_s,
+        }
+        tmp = self.state_path + ".tmp"
+        try:
+            with open(tmp, "wb") as f:
+                cloudpickle.dump(state, f)
+            os.replace(tmp, self.state_path)
+        except Exception as e:
+            # persistence is best-effort; never take down a live sweep —
+            # but say so once, or Tuner.restore will fail mysteriously
+            if not self._save_failed_warned:
+                self._save_failed_warned = True
+                import logging
+                logging.getLogger(__name__).warning(
+                    "could not persist experiment state to %s (%s); "
+                    "Tuner.restore will not work for this sweep",
+                    self.state_path, e)
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+    @staticmethod
+    def load_state(experiment_dir: str) -> Dict[str, Any]:
+        import pickle
+        path = os.path.join(experiment_dir, "experiment_state.pkl")
+        with open(path, "rb") as f:
+            return pickle.load(f)
 
     # -- public hooks used by schedulers (PBT) -----------------------------
 
@@ -144,6 +225,7 @@ class TuneController:
         self.scheduler.on_trial_complete(self, trial, trial.last_result or {})
         for lg in self.loggers:
             lg.on_trial_complete(trial)
+        self._save_state()
 
     # -- main loop ---------------------------------------------------------
 
@@ -167,19 +249,25 @@ class TuneController:
         self.scheduler.on_trial_add(self, t)
         return t
 
-    def _fill_slots(self) -> None:
+    def _fill_slots(self) -> bool:
+        """Start pending/new trials up to the concurrency cap. Returns
+        whether anything changed (so the caller persists state only on
+        real transitions, not every poll tick)."""
         running = sum(1 for t in self.trials if t.status == exp.RUNNING)
+        changed = False
         while not self.max_concurrent or running < self.max_concurrent:
             trial = next((t for t in self.trials
                           if t.status == exp.PENDING), None)
             if trial is None:
                 trial = self._suggest_next()
             if trial is None:
-                return
+                return changed
             self._start_actor(trial, restore_from=trial.checkpoint_path)
             for lg in self.loggers:
                 lg.on_trial_start(trial)
             running += 1
+            changed = True
+        return changed
 
     def run(self, timeout: Optional[float] = None) -> List[Trial]:
         # scheduler/searcher hooks may raise (e.g. PB2 validating its
@@ -188,6 +276,10 @@ class TuneController:
         try:
             return self._run(timeout)
         except Exception:
+            # kill actors but keep the last healthy on-disk snapshot:
+            # trials stay RUNNING/PENDING there, so Tuner.restore resumes
+            # them exactly as it would after a driver SIGKILL
+            self._in_abort = True
             for t in self.trials:
                 if not t.is_finished:
                     try:
@@ -198,11 +290,12 @@ class TuneController:
             raise
 
     def _run(self, timeout: Optional[float] = None) -> List[Trial]:
+        timeout = timeout if timeout is not None else self.time_budget_s
         deadline = time.monotonic() + timeout if timeout else None
         stop_all = False
         while True:
-            if not stop_all:
-                self._fill_slots()
+            if not stop_all and self._fill_slots():
+                self._save_state()
             if not self._pending_step:
                 break
             if deadline and time.monotonic() > deadline:
@@ -229,11 +322,13 @@ class TuneController:
                     trial.status = exp.PENDING  # restart from last ckpt
                 else:
                     self._terminate(trial, exp.ERROR, error=str(e))
+                self._save_state()
                 continue
             if result.get("_trial_finished"):
                 self._terminate(trial, exp.TERMINATED)
                 continue
             self._on_result(trial, result)
+            self._save_state(periodic=True)
             # A PBT exploit inside _on_result restarts the actor and
             # enqueues its own first step — don't double-pump.
             if trial.status == exp.RUNNING and \

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import dataclasses
 import inspect
+import os
 import uuid
 from typing import Any, Callable, Dict, List, Optional, Union
 
@@ -95,6 +96,70 @@ class Tuner:
         self.param_space = param_space or {}
         self.tune_config = tune_config or TuneConfig()
         self.run_config = run_config or RunConfig()
+        self._restore_path: Optional[str] = None
+        self._resume_errored = False
+
+    @classmethod
+    def restore(cls, path: str, trainable: Union[Callable, type, "Any"],
+                *, resume_errored: bool = False,
+                run_config: Optional[RunConfig] = None) -> "Tuner":
+        """Resume a sweep whose driver died (reference
+        `python/ray/tune/tuner.py` Tuner.restore +
+        `tune/execution/experiment_state.py`).
+
+        `path` is the experiment dir (RunConfig.storage_path/name). Trials
+        that were RUNNING or PENDING at death resume (from their last
+        reported checkpoint when one exists); finished trials keep their
+        results; ERROR trials re-run only with `resume_errored=True` (note:
+        the searcher already recorded those trials as errored, so adaptive
+        searchers won't incorporate their eventual scores — same caveat as
+        the reference). Pass `run_config` to re-attach callbacks; the
+        searcher/scheduler resume from their pickled mid-sweep state, so
+        adaptive searchers do not re-suggest completed points.
+        """
+        if not os.path.exists(
+                os.path.join(path, "experiment_state.pkl")):
+            raise FileNotFoundError(
+                f"no experiment_state.pkl under {path!r} — was this "
+                "experiment run with this version?")
+        tuner = cls(trainable, run_config=run_config)
+        tuner._restore_path = path
+        tuner._resume_errored = resume_errored
+        return tuner
+
+    def _fit_restored(self) -> ResultGrid:
+        from ray_tpu.tune import experiment as exp
+        state = TuneController.load_state(self._restore_path)
+        trainable_cls = self._resolve_trainable()
+        for t in state["trials"]:
+            if t.status == exp.RUNNING:
+                t.status = exp.PENDING
+            elif t.status == exp.ERROR and self._resume_errored:
+                t.status = exp.PENDING
+                t.error = None
+                t.num_failures = 0
+        loggers = [cls_() for cls_ in DEFAULT_LOGGERS]
+        if self.run_config.callbacks:
+            loggers.extend(self.run_config.callbacks)
+        controller = TuneController(
+            trainable_cls,
+            searcher=state["searcher"],
+            scheduler=state["scheduler"],
+            stopper=state["stopper"],
+            loggers=loggers,
+            experiment_dir=self._restore_path,
+            max_concurrent=state["max_concurrent"],
+            max_failures=state["max_failures"],
+            trial_resources=state["trial_resources"],
+            metric=state["metric"],
+            mode=state["mode"],
+            max_trials=state["max_trials"],
+            restored_trials=state["trials"],
+            searcher_done=state["searcher_done"],
+            time_budget_s=state.get("time_budget_s"),
+        )
+        trials = controller.run()
+        return self._result_grid(trials, state["metric"], state["mode"])
 
     def _resolve_trainable(self) -> type:
         t = self.trainable
@@ -105,8 +170,8 @@ class Tuner:
         raise TypeError(f"invalid trainable: {t!r}")
 
     def fit(self) -> ResultGrid:
-        import os
-
+        if self._restore_path is not None:
+            return self._fit_restored()
         trainable_cls = self._resolve_trainable()
         tc = self.tune_config
         if tc.search_alg is not None:
@@ -146,8 +211,14 @@ class Tuner:
             metric=tc.metric,
             mode=tc.mode,
             max_trials=max_trials,
+            time_budget_s=tc.time_budget_s,
         )
-        trials = controller.run(timeout=tc.time_budget_s)
+        trials = controller.run()
+        return self._result_grid(trials, tc.metric, tc.mode)
+
+    def _result_grid(self, trials: List[Trial],
+                     metric: Optional[str], mode: Optional[str]) \
+            -> ResultGrid:
         results = []
         for t in trials:
             metrics = dict(t.last_result) if t.last_result else None
@@ -164,5 +235,5 @@ class Tuner:
                 path=t.trial_dir,
                 metrics_history=t.metrics_history,
             ))
-        return ResultGrid(results, trials, default_metric=tc.metric,
-                          default_mode=tc.mode)
+        return ResultGrid(results, trials, default_metric=metric,
+                          default_mode=mode)

@@ -303,3 +303,32 @@ def test_actor_burst_with_intra_burst_ref_dependency():
         refs.append(c.consume.remote(a))
     assert ray_tpu.get(refs, timeout=60) == [(i + 1) * 10
                                              for i in range(20)]
+
+
+def test_actor_burst_with_nested_ref_dependency():
+    """Same deadlock shape, but the dependency ref is buried inside a
+    container arg (a supported pattern — nested refs arrive as refs and
+    the body get()s them). Top-level entries are all by-value then, so
+    the batch guard must detect the ref during pickling, not by wire
+    tag."""
+
+    @ray_tpu.remote
+    class Chain:
+        def produce(self, x):
+            return x + 1
+
+        def consume_nested(self, lst):
+            return ray_tpu.get(lst[0], timeout=20) * 10
+
+    c = Chain.remote()
+    ray_tpu.get(c.produce.remote(0))  # resolve actor (enable fast path)
+    r1 = c.produce.remote(41)
+    r2 = c.consume_nested.remote([r1])  # same burst, nested dependency
+    assert ray_tpu.get(r2, timeout=30) == 420
+    # dict-nested too, in a burst loop
+    refs = []
+    for i in range(5):
+        a = c.produce.remote(i)
+        refs.append(c.consume_nested.remote({0: a}))
+    assert ray_tpu.get(refs, timeout=60) == [(i + 1) * 10
+                                             for i in range(5)]

@@ -1,0 +1,175 @@
+"""Experiment-level Tuner.restore: a dead driver's sweep resumes.
+
+Reference ground: `python/ray/tune/tuner.py` (Tuner.restore),
+`python/ray/tune/execution/experiment_state.py`,
+`python/ray/tune/tests/test_tuner_restore.py` — the driver process is
+SIGKILLed mid-sweep (taking its whole mini-cluster with it), then the
+experiment is restored from `experiment_state.pkl` and finished.
+"""
+
+import os
+import pickle
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.air import Checkpoint, RunConfig, FailureConfig
+
+
+def _make_train_fn():
+    # defined as a closure so cloudpickle ships it by value (a module-level
+    # fn would pickle as a reference to this test module, which workers
+    # can't import)
+    def _train_fn(config):
+        ckpt = tune.get_checkpoint()
+        start = ckpt.to_dict()["i"] + 1 if ckpt else 0
+        for i in range(start, 6):
+            time.sleep(0.25)
+            tune.report({"score": config["x"] * (i + 1), "i": i},
+                        checkpoint=Checkpoint.from_dict({"i": i}))
+    return _train_fn
+
+
+DRIVER = """
+import sys, time
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.air import RunConfig, Checkpoint
+
+storage = sys.argv[1]
+
+def _train_fn(config):
+    ckpt = tune.get_checkpoint()
+    start = ckpt.to_dict()["i"] + 1 if ckpt else 0
+    for i in range(start, 6):
+        time.sleep(0.25)
+        tune.report({"score": config["x"] * (i + 1), "i": i},
+                    checkpoint=Checkpoint.from_dict({"i": i}))
+
+ray_tpu.init(num_cpus=2, object_store_memory=64 * 1024 * 1024)
+tune.Tuner(
+    _train_fn,
+    param_space={"x": tune.grid_search([1.0, 2.0, 3.0, 4.0])},
+    tune_config=tune.TuneConfig(metric="score", mode="max",
+                                max_concurrent_trials=2),
+    run_config=RunConfig(storage_path=storage, name="restore_exp"),
+).fit()
+print("DRIVER_DONE", flush=True)
+"""
+
+
+def _load_state(exp_dir):
+    with open(os.path.join(exp_dir, "experiment_state.pkl"), "rb") as f:
+        return pickle.load(f)
+
+
+def test_restore_after_driver_sigkill(tmp_path):
+    storage = str(tmp_path / "tune_out")
+    exp_dir = os.path.join(storage, "restore_exp")
+    proc = subprocess.Popen(
+        [sys.executable, "-c", DRIVER, storage],
+        cwd="/root/repo", start_new_session=True,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    try:
+        # wait until the sweep is provably mid-flight: some trial has
+        # reported at least twice, and not every trial has finished
+        deadline = time.monotonic() + 90
+        while True:
+            assert time.monotonic() < deadline, "driver never made progress"
+            assert proc.poll() is None, \
+                f"driver exited early: {proc.stdout.read()!r}"
+            try:
+                state = _load_state(exp_dir)
+            except (FileNotFoundError, pickle.UnpicklingError, EOFError):
+                time.sleep(0.1)
+                continue
+            trials = state["trials"]
+            progressed = [t for t in trials
+                          if t.last_result and t.last_result.get("i", 0) >= 1]
+            done = [t for t in trials if t.status == "TERMINATED"]
+            if progressed and len(done) < 4:
+                break
+            time.sleep(0.1)
+    finally:
+        # SIGKILL the whole process group: driver + GCS + raylet + workers
+        os.killpg(proc.pid, signal.SIGKILL)
+        proc.wait(timeout=30)
+
+    pre = _load_state(exp_dir)
+    unfinished_pre = [t for t in pre["trials"] if t.status != "TERMINATED"]
+    assert unfinished_pre, "kill landed after the sweep finished"
+
+    ray_tpu.init(num_cpus=4, object_store_memory=64 * 1024 * 1024)
+    try:
+        grid = tune.Tuner.restore(exp_dir, _make_train_fn()).fit()
+        assert len(grid.errors) == 0
+        assert len(grid) == 4  # all grid points present, none re-suggested
+        assert sorted(r.metrics["config"]["x"] for r in grid) == \
+            [1.0, 2.0, 3.0, 4.0]
+        # every trial ran to completion after restore
+        assert all(r.metrics["i"] == 5 for r in grid)
+        best = grid.get_best_result()
+        assert best.metrics["score"] == pytest.approx(4.0 * 6)
+        # trials that had checkpoints resumed from them instead of
+        # restarting: their post-restore history must not re-report i=0
+        resumed = [t for t in unfinished_pre
+                   if t.checkpoint_path and t.last_result]
+        if resumed:
+            post = {t.trial_id: t
+                    for t in _load_state(exp_dir)["trials"]}
+            for t in resumed:
+                pre_i = t.last_result["i"]
+                new_is = [r["i"] for r in post[t.trial_id].metrics_history
+                          if r["i"] > pre_i]
+                # a trial killed after its final report resumes and
+                # finishes immediately — no new history is correct then
+                assert new_is or pre_i == 5, \
+                    f"trial {t.trial_id} made no post-kill progress"
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_restore_resume_errored(tmp_path):
+    storage = str(tmp_path / "tune_err")
+    marker = str(tmp_path / "healed")
+
+    def sometimes(config):
+        if config["x"] == 2.0 and not os.path.exists(marker):
+            raise RuntimeError("transient env failure")
+        tune.report({"score": config["x"]})
+
+    ray_tpu.init(num_cpus=4, object_store_memory=64 * 1024 * 1024)
+    try:
+        run_cfg = RunConfig(storage_path=storage, name="err_exp",
+                            failure_config=FailureConfig(max_failures=0))
+        grid = tune.Tuner(
+            sometimes,
+            param_space={"x": tune.grid_search([1.0, 2.0])},
+            tune_config=tune.TuneConfig(metric="score", mode="max"),
+            run_config=run_cfg,
+        ).fit()
+        assert len(grid.errors) == 1
+        exp_dir = os.path.join(storage, "err_exp")
+
+        # without resume_errored, the errored trial stays errored
+        grid2 = tune.Tuner.restore(exp_dir, sometimes).fit()
+        assert len(grid2.errors) == 1
+
+        open(marker, "w").close()
+        grid3 = tune.Tuner.restore(exp_dir, sometimes,
+                                   resume_errored=True).fit()
+        assert len(grid3.errors) == 0
+        assert sorted(r.metrics["score"] for r in grid3 if r.metrics) == \
+            [1.0, 2.0]
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_restore_missing_state(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        tune.Tuner.restore(str(tmp_path), _make_train_fn())
