@@ -11,6 +11,7 @@ import numpy as np
 
 from ray_tpu.data import logical as _L
 from ray_tpu.data.block import Block, BlockAccessor
+from ray_tpu.data.logical import ActorPoolStrategy
 from ray_tpu.data.context import DataContext
 from ray_tpu.data.dataset import Dataset, GroupedData
 from ray_tpu.data.datasource import (
@@ -107,6 +108,7 @@ def read_images(paths, *, size: Optional[tuple] = None, mode: str = "RGB",
 
 
 __all__ = [
+    "ActorPoolStrategy",
     "Block",
     "BlockAccessor",
     "DataContext",

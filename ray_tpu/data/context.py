@@ -12,9 +12,13 @@ from typing import Optional
 class DataContext:
     target_max_block_size: int = 128 * 1024 * 1024
     target_min_block_size: int = 1 * 1024 * 1024
-    # concurrency cap for the streaming executor — the default
-    # backpressure policy (reference ConcurrencyCapBackpressurePolicy)
-    max_concurrent_tasks: int = 8
+    # explicit concurrency cap for the streaming executor; None (default)
+    # derives the in-flight window from cluster CPU count, and submission
+    # additionally stalls while the object store is above
+    # `store_backpressure_fraction` (reference ResourceManager budgets +
+    # ConcurrencyCapBackpressurePolicy)
+    max_concurrent_tasks: Optional[int] = None
+    store_backpressure_fraction: float = 0.8
     default_batch_size: int = 1024
     read_parallelism: int = 8
     shuffle_partitions: Optional[int] = None

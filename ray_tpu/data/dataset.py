@@ -43,9 +43,20 @@ class Dataset:
 
     def map_batches(self, fn: Callable, *, batch_size: Optional[int] = None,
                     fn_args: tuple = (),
-                    fn_kwargs: Optional[dict] = None) -> "Dataset":
-        return self._derive(L.MapBatches(self._op, fn, batch_size,
-                                         fn_args, fn_kwargs))
+                    fn_kwargs: Optional[dict] = None,
+                    compute: Optional["L.ActorPoolStrategy"] = None,
+                    fn_constructor_args: tuple = (),
+                    fn_constructor_kwargs: Optional[dict] = None,
+                    ) -> "Dataset":
+        """Batch transform. `fn` may be a callable class when
+        `compute=ActorPoolStrategy(...)`: each pool actor instantiates it
+        once (with `fn_constructor_args/kwargs`) and reuses it across
+        blocks — warm stateful UDFs (reference
+        `actor_pool_map_operator.py`)."""
+        return self._derive(L.MapBatches(
+            self._op, fn, batch_size, fn_args, fn_kwargs,
+            compute=compute, fn_constructor_args=fn_constructor_args,
+            fn_constructor_kwargs=fn_constructor_kwargs))
 
     def filter(self, fn: Callable[[Dict], bool]) -> "Dataset":
         return self._derive(L.Filter(self._op, fn))

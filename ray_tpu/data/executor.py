@@ -161,20 +161,95 @@ class _RemoteCache:
         return self._cache[key]
 
 
+class _MapWorker:
+    """Pool actor hosting one warm UDF instance (reference
+    `_internal/execution/operators/actor_pool_map_operator.py` _MapWorker).
+    The transform factory runs in __init__, so a class UDF's state
+    (tokenizer, decoder, model) is built once and reused per block."""
+
+    def __init__(self, transform_factory):
+        self._transform = transform_factory()
+
+    def ready(self) -> bool:
+        return True
+
+    def apply(self, block: Block, idx: int) -> Block:
+        return self._transform(block, idx)
+
+
+class _ResourceBudget:
+    """Admission budget for task submission (reference
+    `_internal/execution/resource_manager.py:29` ResourceManager +
+    backpressure policies): the in-flight task window is derived from the
+    cluster's CPU count instead of a fixed constant, and submission
+    additionally stalls while the local object store is above an
+    occupancy threshold (completed-but-unconsumed blocks are filling it —
+    producing more would only force spilling). At least one task may
+    always run, so progress is guaranteed and consumption drains the
+    store."""
+
+    def __init__(self, ctx: DataContext):
+        self.ctx = ctx
+        self._cap: Optional[int] = None
+        self._occ_checked = 0.0
+        self._occ_high = False
+
+    def task_cap(self) -> int:
+        if self._cap is None:
+            if self.ctx.max_concurrent_tasks is not None:
+                # explicit user cap wins (and is the test knob)
+                self._cap = max(1, self.ctx.max_concurrent_tasks)
+            else:
+                try:
+                    cpus = ray_tpu.cluster_resources().get("CPU", 1.0)
+                except Exception:
+                    cpus = 1.0
+                # modest oversubscription hides push/reply latency
+                self._cap = max(2, int(cpus * 1.5))
+        return self._cap
+
+    def store_pressure(self) -> bool:
+        """True when the local shm arena is above the high-water mark.
+        Rechecked at most every 0.25s (a stats() syscall per wait tick is
+        wasteful)."""
+        import time as _time
+        now = _time.monotonic()
+        if now - self._occ_checked < 0.25:
+            return self._occ_high
+        self._occ_checked = now
+        self._occ_high = False
+        try:
+            from ray_tpu._private.object_ref import get_core_worker
+            cw = get_core_worker()
+            if cw is not None and cw.store is not None:
+                st = cw.store.stats()
+                if st["capacity"]:
+                    frac = st["allocated"] / st["capacity"]
+                    self._occ_high = \
+                        frac > self.ctx.store_backpressure_fraction
+        except Exception:
+            pass
+        return self._occ_high
+
+
 class StreamingExecutor:
     def __init__(self, ctx: Optional[DataContext] = None):
         self.ctx = ctx or DataContext.get_current()
         self._remote = _RemoteCache()
+        self._budget = _ResourceBudget(self.ctx)
 
-    # -- bounded-window submission (the backpressure policy) ---------------
+    # -- budgeted-window submission (the backpressure policy) --------------
 
     def _windowed(self, submit_fns: List[Callable[[], Any]]) -> List[Any]:
-        cap = max(1, self.ctx.max_concurrent_tasks)
+        budget = self._budget
+        cap = budget.task_cap()
         out: List[Any] = [None] * len(submit_fns)
         in_flight: Dict[Any, int] = {}
         next_i = 0
         while next_i < len(submit_fns) or in_flight:
             while next_i < len(submit_fns) and len(in_flight) < cap:
+                if in_flight and budget.store_pressure():
+                    break  # drain before producing more blocks
                 ref = submit_fns[next_i]()
                 out[next_i] = ref
                 # multi-return tasks yield a list; any one ref tracks
@@ -205,6 +280,8 @@ class StreamingExecutor:
                 (lambda t=t: rf.remote(t)) for t in tasks])
         if isinstance(op, L.AbstractMap):
             inputs = self._exec(op.input_op)
+            if op.compute is not None:
+                return self._exec_actor_map(op, inputs)
             transform = op.make_transform()
             rf = self._remote.get(_run_transform)
             return self._windowed([
@@ -244,6 +321,71 @@ class StreamingExecutor:
                 (lambda l=l, r=r: rf.remote(l, r))
                 for l, r in zip(left, right)])
         raise TypeError(f"unknown logical op {op!r}")
+
+    # -- actor-compute map stage -------------------------------------------
+
+    def _exec_actor_map(self, op: L.AbstractMap,
+                        inputs: List[Any]) -> List[Any]:
+        """Run one map stage on a pool of warm UDF actors with autoscaling
+        (reference `actor_pool_map_operator.py` + `_ActorPool`): blocks go
+        to the least-loaded actor, each actor runs at most
+        `max_tasks_in_flight_per_actor` blocks, and while there is a
+        backlog with every actor saturated the pool grows up to
+        `max_size`. The pool is torn down when the stage drains."""
+        if not inputs:
+            return []
+        strategy = op.compute
+        factory = op.make_transform_factory()
+        actor_cls = ray_tpu.remote(_MapWorker)
+        min_size = strategy.min_size
+        max_size = strategy.max_size or min_size
+        per_actor = max(1, strategy.max_tasks_in_flight_per_actor)
+        budget = self._budget
+
+        actors: List[Any] = []
+        out: List[Any] = [None] * len(inputs)
+        load: Dict[int, int] = {}
+        ref_actor: Dict[Any, int] = {}
+        next_i = 0
+        try:
+            actors.extend(actor_cls.remote(factory)
+                          for _ in range(min(min_size, len(inputs))))
+            load.update({j: 0 for j in range(len(actors))})
+            # block until at least one worker built its UDF state — a
+            # broken constructor should fail the stage here, not
+            # per-block (and the finally reaps the spawned pool)
+            ray_tpu.get(actors[0].ready.remote(), timeout=300)
+            while next_i < len(inputs) or ref_actor:
+                while next_i < len(inputs):
+                    if ref_actor and budget.store_pressure():
+                        break  # drain output blocks before producing more
+                    j = min(load, key=load.get)
+                    if load[j] >= per_actor:
+                        if len(actors) < max_size:
+                            # backlog with every actor saturated: scale up
+                            actors.append(actor_cls.remote(factory))
+                            load[len(actors) - 1] = 0
+                            continue
+                        break
+                    ref = actors[j].apply.remote(inputs[next_i], next_i)
+                    out[next_i] = ref
+                    ref_actor[ref] = j
+                    load[j] += 1
+                    next_i += 1
+                if ref_actor:
+                    ready, _ = ray_tpu.wait(list(ref_actor),
+                                            num_returns=1, timeout=30.0)
+                    for r in ready:
+                        j = ref_actor.pop(r, None)
+                        if j is not None:
+                            load[j] -= 1
+        finally:
+            for a in actors:
+                try:
+                    ray_tpu.kill(a)
+                except Exception:
+                    pass
+        return out
 
     # -- all-to-all exchange (map: split into p, reduce: combine) ----------
 

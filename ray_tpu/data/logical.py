@@ -22,6 +22,31 @@ from ray_tpu.data.block import (
 from ray_tpu.data.datasource import Datasource
 
 
+@dataclasses.dataclass
+class ActorPoolStrategy:
+    """Run a map stage's UDF inside a pool of warm, stateful actors
+    (reference `python/ray/data/_internal/compute.py` ActorPoolStrategy +
+    `_internal/execution/operators/actor_pool_map_operator.py`).
+
+    The pool starts at `min_size` and autoscales up to `max_size` while
+    the stage has a backlog; each actor executes at most
+    `max_tasks_in_flight_per_actor` blocks concurrently (pipelining the
+    object transfer behind the running task). With a class UDF the class
+    is instantiated ONCE per actor — expensive state (tokenizers, model
+    weights, decoders) is paid per worker, not per block.
+    """
+
+    min_size: int = 1
+    max_size: Optional[int] = None  # None: fixed pool of min_size
+    max_tasks_in_flight_per_actor: int = 2
+
+    def __post_init__(self):
+        if self.min_size < 1:
+            raise ValueError("min_size must be >= 1")
+        if self.max_size is not None and self.max_size < self.min_size:
+            raise ValueError("max_size must be >= min_size")
+
+
 class LogicalOp:
     def __init__(self, input_op: Optional["LogicalOp"] = None):
         self.input_op = input_op
@@ -47,33 +72,55 @@ class InputBlocks(LogicalOp):
 
 
 class AbstractMap(LogicalOp):
-    """One-to-one block transform; fusable.
+    """One-to-one block transform; fusable (task-compute stages only).
 
     Transforms take ``(block, block_index)`` — the index is the block's
     position in the stage's input list, giving deterministic per-block
     identity to transforms that need it (e.g. ``random_sample``'s RNG).
     """
 
+    #: ActorPoolStrategy for actor-compute stages; None = stateless tasks
+    compute: Optional[ActorPoolStrategy] = None
+
     def make_transform(self) -> Callable[[Block, int], Block]:
         raise NotImplementedError
+
+    def make_transform_factory(self) -> Callable[[], Callable]:
+        """Picklable zero-arg factory producing the transform ON the
+        executing actor (where class UDFs instantiate their state)."""
+        t = self.make_transform()
+        return lambda: t
 
 
 class MapBatches(AbstractMap):
     def __init__(self, input_op, fn: Callable, batch_size: Optional[int],
                  fn_args: tuple = (), fn_kwargs: Optional[dict] = None,
-                 with_block_index: bool = False):
+                 with_block_index: bool = False,
+                 compute: Optional[ActorPoolStrategy] = None,
+                 fn_constructor_args: tuple = (),
+                 fn_constructor_kwargs: Optional[dict] = None):
         super().__init__(input_op)
         self.fn = fn
         self.batch_size = batch_size
         self.fn_args = fn_args
         self.fn_kwargs = fn_kwargs or {}
         self.with_block_index = with_block_index
+        self.compute = compute
+        self.fn_constructor_args = fn_constructor_args
+        self.fn_constructor_kwargs = fn_constructor_kwargs or {}
+        if isinstance(fn, type) and compute is None:
+            raise ValueError(
+                "map_batches with a class UDF requires "
+                "compute=ActorPoolStrategy(...) — the class is stateful "
+                "and must live in pooled actors (reference semantics)")
+        if not isinstance(fn, type) and (fn_constructor_args
+                                         or fn_constructor_kwargs):
+            raise ValueError(
+                "fn_constructor_args/kwargs require a callable-class fn "
+                "(they are passed to its __init__, once per pool actor)")
 
-    def make_transform(self):
-        fn, bs = self.fn, self.batch_size
-        args, kwargs = self.fn_args, self.fn_kwargs
-        with_idx = self.with_block_index
-
+    @staticmethod
+    def _batch_loop(fn, bs, args, kwargs, with_idx):
         def transform(block: Block, idx: int) -> Block:
             acc = BlockAccessor(block)
             n = acc.num_rows()
@@ -89,6 +136,29 @@ class MapBatches(AbstractMap):
             return BlockAccessor.concat(outs)
 
         return transform
+
+    def make_transform(self):
+        if isinstance(self.fn, type):
+            raise TypeError("class UDFs run via make_transform_factory "
+                            "on actor compute")
+        return self._batch_loop(self.fn, self.batch_size, self.fn_args,
+                                self.fn_kwargs, self.with_block_index)
+
+    def make_transform_factory(self):
+        fn, bs = self.fn, self.batch_size
+        args, kwargs = self.fn_args, self.fn_kwargs
+        with_idx = self.with_block_index
+        ctor_args, ctor_kwargs = (self.fn_constructor_args,
+                                  self.fn_constructor_kwargs)
+        batch_loop = MapBatches._batch_loop
+
+        def factory():
+            # class UDFs instantiate HERE — once per pool actor
+            call = fn(*ctor_args, **ctor_kwargs) if isinstance(fn, type) \
+                else fn
+            return batch_loop(call, bs, args, kwargs, with_idx)
+
+        return factory
 
 
 class MapRows(AbstractMap):
@@ -261,7 +331,12 @@ def optimize(op: LogicalOp) -> LogicalOp:
         return op
     if op.input_op is not None:
         op.input_op = optimize(op.input_op)
-    if isinstance(op, AbstractMap) and isinstance(op.input_op, AbstractMap):
+    if isinstance(op, AbstractMap) and isinstance(op.input_op, AbstractMap) \
+            and op.compute is None and op.input_op.compute is None:
+        # actor-compute stages never fuse: their UDF state lives in a
+        # dedicated pool, and fusing a task-compute neighbor into it
+        # would drag that neighbor's work onto the pool's actors
+        # (reference fuses only compatible compute strategies)
         child = op.input_op
         child_transforms = (child.transforms
                             if isinstance(child, FusedMap)

@@ -351,3 +351,136 @@ def test_train_test_split():
                  + [r["id"] for r in test.take_all()])
     assert ids == list(range(100))
     assert test.count() == 25
+
+
+# -- actor-compute map stages (reference actor_pool_map_operator.py) --------
+
+
+def test_map_batches_actor_pool_class_udf():
+    class AddTag:
+        def __init__(self, tag):
+            # expensive state: built once per pool actor
+            import os
+            self.tag = tag
+            self.instance = f"{os.getpid()}-{id(self)}"
+
+        def __call__(self, batch):
+            n = len(batch["id"])
+            return {"id": batch["id"],
+                    "tag": np.asarray([self.tag] * n),
+                    "who": np.asarray([self.instance] * n)}
+
+    ds = rd.range(64, parallelism=8).map_batches(
+        AddTag, compute=rd.ActorPoolStrategy(min_size=2, max_size=2),
+        fn_constructor_args=("t",))
+    rows = ds.take_all()
+    assert len(rows) == 64
+    assert all(r["tag"] == "t" for r in rows)
+    # 8 blocks ran on at most 2 warm instances (one per pool actor) —
+    # the class was NOT instantiated per block
+    assert 1 <= len({r["who"] for r in rows}) <= 2
+
+
+def test_map_batches_actor_pool_autoscales():
+    import time as _t
+
+    class Slow:
+        def __init__(self):
+            self.instance = id(self)
+
+        def __call__(self, batch):
+            _t.sleep(0.2)
+            return {"id": batch["id"],
+                    "who": np.asarray([self.instance] * len(batch["id"]))}
+
+    ds = rd.range(64, parallelism=8).map_batches(
+        Slow, compute=rd.ActorPoolStrategy(
+            min_size=1, max_size=3, max_tasks_in_flight_per_actor=1))
+    rows = ds.take_all()
+    # a saturated 1-actor pool with backlog must have grown
+    assert len({r["who"] for r in rows}) > 1
+
+
+def test_map_batches_class_udf_requires_actor_compute():
+    class F:
+        def __call__(self, b):
+            return b
+
+    with pytest.raises(ValueError, match="ActorPoolStrategy"):
+        rd.range(8).map_batches(F)
+
+
+def test_actor_map_does_not_fuse_with_task_maps():
+    from ray_tpu.data import logical as L
+
+    class Id:
+        def __call__(self, b):
+            return b
+
+    ds = (rd.range(32, parallelism=4)
+          .map(lambda r: {"x": r["id"]})
+          .map_batches(Id, compute=rd.ActorPoolStrategy(min_size=1))
+          .map(lambda r: {"x": r["x"] + 1}))
+    optimized = L.optimize(ds._op)
+    # the actor stage stays a lone MapBatches between two task stages
+    assert isinstance(optimized, L.MapRows)
+    assert isinstance(optimized.input_op, L.MapBatches)
+    assert optimized.input_op.compute is not None
+    assert [r["x"] for r in sorted(ds.take_all(),
+                                   key=lambda r: r["x"])] == \
+        list(range(1, 33))
+
+
+def test_streaming_ingest_actor_pool_to_train_worker():
+    """VERDICT round-2 item 3 'done' criterion: a stateful actor pool
+    tokenizes and feeds iter_batches into a train worker without
+    materializing the dataset on the driver."""
+
+    class Tokenizer:
+        def __init__(self, vocab_base):
+            self.vocab_base = vocab_base  # stands in for a real vocab load
+
+        def __call__(self, batch):
+            return {"tokens": batch["id"] + self.vocab_base}
+
+    ds = rd.range(256, parallelism=8).map_batches(
+        Tokenizer, compute=rd.ActorPoolStrategy(min_size=2, max_size=2),
+        fn_constructor_args=(1000,))
+
+    @ray_tpu.remote
+    def train_worker(it):
+        total, nbatches = 0, 0
+        for b in it.iter_batches(batch_size=32):
+            total += int(b["tokens"].sum())
+            nbatches += 1
+        return total, nbatches
+
+    [shard] = ds.streaming_split(1)
+    total, nbatches = ray_tpu.get(train_worker.remote(shard), timeout=180)
+    assert nbatches == 8
+    assert total == sum(i + 1000 for i in range(256))
+
+
+def test_resource_budget_backpressure():
+    from ray_tpu.data.context import DataContext
+    from ray_tpu.data.executor import _ResourceBudget
+
+    # default: window derives from cluster CPUs, not a constant
+    ctx = DataContext(max_concurrent_tasks=None)
+    b = _ResourceBudget(ctx)
+    assert b.task_cap() == max(2, int(8 * 1.5))  # fixture cluster: 8 CPUs
+    ctx2 = DataContext(max_concurrent_tasks=3)
+    assert _ResourceBudget(ctx2).task_cap() == 3
+
+    # with the high-water mark forced to 0 every allocated byte counts as
+    # pressure; submission serializes but the stage still completes
+    ctx3 = DataContext(store_backpressure_fraction=0.0)
+    from ray_tpu.data import executor as ex
+    old = rd.DataContext.get_current().store_backpressure_fraction
+    rd.DataContext.get_current().store_backpressure_fraction = 0.0
+    try:
+        big = rd.range_tensor(64, shape=(1024,), parallelism=8) \
+            .map_batches(lambda b: {"data": b["data"] * 2})
+        assert big.count() == 64
+    finally:
+        rd.DataContext.get_current().store_backpressure_fraction = old
