@@ -529,6 +529,14 @@ class Raylet:
 
     async def _spawn_worker(self, job_id: bytes, tpu_chips: tuple,
                             runtime_env: dict | None = None):
+        python_exe = sys.executable
+        if runtime_env and runtime_env.get("pip"):
+            # venv build takes seconds — keep it off the raylet loop
+            # (heartbeats must not stall). Cached by requirements hash,
+            # so only the first worker of an env pays it.
+            from ray_tpu._private import runtime_env as renv_mod
+            python_exe = await asyncio.get_running_loop().run_in_executor(
+                None, renv_mod.ensure_pip_env, runtime_env["pip"])
         env = dict(os.environ)
         env["RAY_TPU_NODE_ID"] = self.node_id.hex()
         if runtime_env and runtime_env.get("env_vars"):
@@ -554,7 +562,7 @@ class Raylet:
         )
         logfile = open(log_path, "ab")
         proc = await asyncio.create_subprocess_exec(
-            sys.executable, "-m", "ray_tpu._private.worker_main",
+            python_exe, "-m", "ray_tpu._private.worker_main",
             "--raylet-addr", self.server.address,
             "--gcs-addr", self.gcs_addr,
             "--store-name", self.store_name,
@@ -844,11 +852,27 @@ class Raylet:
         starting_key = starting_key or key
         try:
             proc = await self._spawn_worker(job_id, chips, runtime_env)
-        except Exception:
+        except Exception as e:
             logger.exception("worker spawn failed")
             self._starting[starting_key] = max(
                 0, self._starting.get(starting_key, 0) - 1)
             self.unassigned_chips.extend(chips)
+            from ray_tpu._private.runtime_env import (
+                RuntimeEnvSetupError, env_hash as _env_hash)
+            if isinstance(e, RuntimeEnvSetupError):
+                # a broken env spec fails deterministically: error out the
+                # leases waiting on this env instead of respawning forever
+                ehash = _env_hash(runtime_env)
+                for lease in list(self._pending):
+                    if _env_hash(lease.spec.runtime_env) != ehash:
+                        continue
+                    self._pending.remove(lease)
+                    self._release_resources(lease)
+                    self._leases.pop(lease.lease_id, None)
+                    if not lease.reply_fut.done():
+                        lease.reply_fut.set_result(
+                            {"granted": False,
+                             "error": f"runtime_env setup failed: {e}"})
             return
         self._spawned_procs.append((proc, key, starting_key))
 

@@ -1,16 +1,19 @@
-"""Runtime environments: per-task/actor env vars + code shipping.
+"""Runtime environments: per-task/actor env vars + code shipping + pip.
 
 Reference: `python/ray/runtime_env/runtime_env.py:152` (the RuntimeEnv
-spec) and `python/ray/_private/runtime_env/{working_dir,py_modules}.py`
-(URI-addressed packages installed by the per-node agent). Here the
-packages live in the GCS KV (content-addressed zips) and the WORKER
+spec) and `python/ray/_private/runtime_env/{working_dir,py_modules,
+pip}.py` (URI-addressed packages installed by the per-node agent). Here
+the packages live in the GCS KV (content-addressed zips) and the WORKER
 materializes them at startup — no separate agent process; the raylet
 pools workers per runtime-env hash exactly like the reference's
 per-runtime-env worker pools (worker_pool.h:159).
 
 Supported fields: `env_vars` (dict), `working_dir` (local dir, shipped
 and chdir'd), `py_modules` (list of local dirs, shipped and put on
-sys.path).
+sys.path), `pip` (requirements list / requirements.txt path / dict with
+`packages` + `install_options`) — the raylet builds a content-addressed
+cached venv per requirements set (reference `pip.py` URI caching) and
+launches the pool's workers from the venv interpreter.
 """
 
 from __future__ import annotations
@@ -19,12 +22,176 @@ import hashlib
 import io
 import json
 import os
+import shutil
+import subprocess
 import sys
 import zipfile
 from typing import Dict, List, Optional
 
 _KV_NS = "runtime_env"
 _MAX_PACKAGE_BYTES = 100 * 1024 * 1024
+
+
+# ---------------------------------------------------------------------------
+# pip venv isolation (reference python/ray/_private/runtime_env/pip.py)
+# ---------------------------------------------------------------------------
+
+
+def normalize_pip(pip) -> Dict:
+    """Driver-side normalization of the `pip` field to its wire form:
+    {"packages": [...], "install_options": [...]}. Accepts a requirements
+    list, a requirements.txt path, or the dict form."""
+    if isinstance(pip, str):
+        with open(pip) as f:
+            pkgs = [ln.strip() for ln in f
+                    if ln.strip() and not ln.strip().startswith("#")]
+        bad = [p for p in pkgs if p.startswith("-")]
+        if bad:
+            # directive lines reference driver-local files / global pip
+            # state that won't exist on the node building the venv
+            raise ValueError(
+                f"requirements directives are not supported: {bad}; "
+                "pass plain requirement specs, with pip flags in "
+                '{"packages": [...], "install_options": [...]} form')
+        return {"packages": pkgs, "install_options": []}
+    if isinstance(pip, (list, tuple)):
+        return {"packages": [str(p) for p in pip], "install_options": []}
+    if isinstance(pip, dict):
+        unknown = set(pip) - {"packages", "install_options"}
+        if unknown:
+            raise ValueError(f"unsupported pip fields: {unknown}")
+        return {"packages": [str(p) for p in pip.get("packages", [])],
+                "install_options": [str(o) for o in
+                                    pip.get("install_options", [])]}
+    raise TypeError(f"runtime_env pip must be list/str/dict, got {pip!r}")
+
+
+def pip_env_cache_root() -> str:
+    return os.environ.get("RAY_TPU_PIP_ENV_CACHE",
+                          "/tmp/ray_tpu/pip_envs")
+
+
+class RuntimeEnvSetupError(RuntimeError):
+    pass
+
+
+# Per-process build coordination: one thread builds a given env while
+# others wait, and a deterministic failure is remembered so a queue of
+# tasks with a broken spec doesn't re-run the failing install per lease.
+import threading as _threading
+
+_pip_build_lock = _threading.Lock()
+_pip_key_locks: Dict[str, _threading.Lock] = {}
+_pip_failed: Dict[str, str] = {}
+
+_PIP_CACHE_MAX_ENVS = int(os.environ.get("RAY_TPU_PIP_ENV_CACHE_MAX", "10"))
+
+
+def _evict_pip_cache(root: str, keep: str) -> None:
+    """Bound the venv cache: beyond the cap, drop the least-recently-used
+    entries (.ready mtime is touched on reuse). The reference refcounts
+    URIs and deletes on release; an LRU cap is the agentless equivalent."""
+    try:
+        entries = [d for d in os.listdir(root)
+                   if d != keep and ".tmp." not in d
+                   and os.path.exists(os.path.join(root, d, ".ready"))]
+        if len(entries) + 1 <= _PIP_CACHE_MAX_ENVS:
+            return
+        entries.sort(key=lambda d: os.path.getmtime(
+            os.path.join(root, d, ".ready")))
+        for d in entries[:len(entries) + 1 - _PIP_CACHE_MAX_ENVS]:
+            shutil.rmtree(os.path.join(root, d), ignore_errors=True)
+    except OSError:
+        pass
+
+
+def ensure_pip_env(pip_wire: Dict) -> str:
+    """Build (or reuse) the cached venv for a requirements set; returns
+    the venv interpreter path. Content-addressed by the normalized pip
+    spec, so every job/worker with the same requirements shares one venv
+    (reference pip.py URI caching). Safe under concurrent builders: each
+    builds in a private tmp dir and the first atomic rename wins.
+
+    The venv inherits the base interpreter's site-packages
+    (--system-site-packages) so ray_tpu and its deps stay importable;
+    pip runs from the base install targeting the venv."""
+    key = hashlib.sha1(json.dumps(
+        pip_wire, sort_keys=True).encode()).hexdigest()[:20]
+    root = pip_env_cache_root()
+    dest = os.path.join(root, key)
+    py = os.path.join(dest, "bin", "python")
+    ready = os.path.join(dest, ".ready")
+    with _pip_build_lock:
+        key_lock = _pip_key_locks.setdefault(key, _threading.Lock())
+    with key_lock:  # one builder per env per process; others wait here
+        if key in _pip_failed:
+            raise RuntimeEnvSetupError(_pip_failed[key])
+        if os.path.exists(ready):
+            try:
+                os.utime(ready)  # LRU touch
+            except OSError:
+                pass
+            return py
+        try:
+            return _build_pip_env(pip_wire, root, dest, py, ready)
+        except RuntimeEnvSetupError as e:
+            _pip_failed[key] = str(e)
+            raise
+
+
+def _build_pip_env(pip_wire: Dict, root: str, dest: str, py: str,
+                   ready: str) -> str:
+    os.makedirs(root, exist_ok=True)
+    # uuid component: unique across threads AND processes (pid alone
+    # collides for two executor threads of one raylet)
+    tmp = f"{dest}.tmp.{os.getpid()}.{os.urandom(4).hex()}"
+    try:
+        subprocess.run(
+            [sys.executable, "-m", "venv", "--system-site-packages",
+             "--without-pip", tmp],
+            check=True, capture_output=True, timeout=120)
+        # --system-site-packages exposes the ROOT interpreter's site dirs;
+        # when the building interpreter is itself a venv (common: /opt
+        # installs), its packages — ray_tpu's own deps — would be lost.
+        # A .pth appends the builder's site dirs AFTER the new venv's own
+        # site-packages, so pip-installed packages still shadow them.
+        import glob as _glob
+        import site as _site
+        venv_sites = _glob.glob(
+            os.path.join(tmp, "lib", "python*", "site-packages"))
+        if venv_sites:
+            with open(os.path.join(venv_sites[0], "_ray_tpu_base.pth"),
+                      "w") as f:
+                for p in _site.getsitepackages():
+                    f.write(p + "\n")
+        pkgs = pip_wire.get("packages", [])
+        if pkgs:
+            cmd = [os.path.join(tmp, "bin", "python"), "-m", "pip",
+                   "install", "--quiet", "--disable-pip-version-check",
+                   *pip_wire.get("install_options", []), *pkgs]
+            res = subprocess.run(cmd, capture_output=True, timeout=600)
+            if res.returncode != 0:
+                raise RuntimeEnvSetupError(
+                    "pip install failed for runtime_env "
+                    f"{pkgs}: {res.stderr.decode(errors='replace')[-2000:]}")
+        open(os.path.join(tmp, ".ready"), "w").close()
+        try:
+            os.rename(tmp, dest)
+        except OSError:
+            # a concurrent builder won the rename — same content, fine
+            if not os.path.exists(ready):
+                raise
+        _evict_pip_cache(root, keep=os.path.basename(dest))
+    except subprocess.CalledProcessError as e:
+        raise RuntimeEnvSetupError(
+            f"venv creation failed: {e.stderr.decode(errors='replace')}")
+    except subprocess.TimeoutExpired as e:
+        # a deterministic-enough failure: surface it instead of letting
+        # the raylet treat it as transient and loop the full install
+        raise RuntimeEnvSetupError(f"pip env build timed out: {e.cmd}")
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return py
 
 
 def _zip_dir(path: str) -> bytes:
@@ -66,7 +233,10 @@ def prepare(cw, runtime_env: Dict) -> Dict:
             {"key": upload(p), "name": os.path.basename(p.rstrip("/"))}
             for p in runtime_env["py_modules"]
         ]
-    unknown = set(runtime_env) - {"env_vars", "working_dir", "py_modules"}
+    if runtime_env.get("pip"):
+        wire["pip"] = normalize_pip(runtime_env["pip"])
+    unknown = set(runtime_env) - {"env_vars", "working_dir", "py_modules",
+                                  "pip"}
     if unknown:
         raise ValueError(f"unsupported runtime_env fields: {unknown}")
     # precompute the pooling identity once: scheduling_key() reads it on

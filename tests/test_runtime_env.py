@@ -100,3 +100,73 @@ def test_unsupported_field_rejected():
 
     with pytest.raises(ValueError):
         nope.remote()
+
+
+# -- pip isolation (reference python/ray/_private/runtime_env/pip.py) -------
+
+
+def _build_wheel(tmpdir: str, name: str, version: str = "0.1") -> str:
+    """Hand-roll a minimal wheel (a zip with dist-info metadata) so the
+    pip-env test needs no network: pip installs it with --no-index."""
+    import zipfile
+    whl = os.path.join(tmpdir, f"{name}-{version}-py3-none-any.whl")
+    di = f"{name}-{version}.dist-info"
+    with zipfile.ZipFile(whl, "w") as zf:
+        zf.writestr(f"{name}/__init__.py",
+                    f"MAGIC = 'wheel-{name}-{version}'\n")
+        zf.writestr(f"{di}/METADATA",
+                    f"Metadata-Version: 2.1\nName: {name}\n"
+                    f"Version: {version}\n")
+        zf.writestr(f"{di}/WHEEL",
+                    "Wheel-Version: 1.0\nGenerator: test\nRoot-Is-Purelib:"
+                    " true\nTag: py3-none-any\n")
+        zf.writestr(f"{di}/RECORD", "")
+    return whl
+
+
+def test_pip_env_isolates_package(tmp_path):
+    whl = _build_wheel(str(tmp_path), "rt_pip_probe")
+    env = {"pip": {"packages": [whl],
+                   "install_options": ["--no-index", "--no-deps"]}}
+
+    @ray_tpu.remote(runtime_env=env)
+    def use_pkg():
+        import rt_pip_probe
+        return rt_pip_probe.MAGIC
+
+    @ray_tpu.remote
+    def base_env_has_it():
+        import importlib.util
+        return importlib.util.find_spec("rt_pip_probe") is not None
+
+    assert ray_tpu.get(use_pkg.remote(), timeout=180) == \
+        "wheel-rt_pip_probe-0.1"
+    # the package exists ONLY inside the env's venv
+    assert ray_tpu.get(base_env_has_it.remote(), timeout=60) is False
+
+
+def test_pip_env_venv_is_cached(tmp_path):
+    from ray_tpu._private.runtime_env import ensure_pip_env, normalize_pip
+    whl = _build_wheel(str(tmp_path), "rt_pip_cache")
+    wire = normalize_pip({"packages": [whl],
+                          "install_options": ["--no-index", "--no-deps"]})
+    t0 = time.monotonic()
+    py1 = ensure_pip_env(wire)
+    first = time.monotonic() - t0
+    t1 = time.monotonic()
+    py2 = ensure_pip_env(wire)
+    second = time.monotonic() - t1
+    assert py1 == py2 and os.path.exists(py1)
+    assert second < first / 5  # cache hit skips venv+install entirely
+
+
+def test_pip_env_install_failure_fails_task(tmp_path):
+    env = {"pip": {"packages": ["definitely-not-a-real-pkg-xyz"],
+                   "install_options": ["--no-index", "--no-deps"]}}
+
+    @ray_tpu.remote(runtime_env=env)
+    def f():
+        return 1
+
+    with pytest.raises(Exception, match="runtime_env setup failed"):
+        ray_tpu.get(f.remote(), timeout=180)
