@@ -230,6 +230,20 @@ def cmd_serve(args):
         ray_tpu.shutdown()
 
 
+def cmd_serve_deploy(args):
+    """Deploy applications from a YAML config (reference `serve deploy`
+    + `serve/schema.py`). Unlike cmd_serve this may START the
+    controller: deploying a config is a mutating operation."""
+    ray_tpu = _connect(args)
+    from ray_tpu import serve
+
+    try:
+        handles = serve.deploy_config(args.config_file)
+        print(f"deployed applications: {', '.join(handles)}")
+    finally:
+        ray_tpu.shutdown()
+
+
 def cmd_summary(args):
     ray_tpu = _connect(args)
     from ray_tpu.util import state as state_api
@@ -390,6 +404,12 @@ def main(argv=None):
     p.add_argument("action", choices=["status", "shutdown"])
     p.add_argument("--address")
     p.set_defaults(fn=cmd_serve)
+
+    p = sub.add_parser("serve-deploy",
+                       help="deploy applications from a YAML config")
+    p.add_argument("config_file")
+    p.add_argument("--address")
+    p.set_defaults(fn=cmd_serve_deploy)
 
     p = sub.add_parser("summary", help="task summary by name/state")
     p.add_argument("--address")

@@ -10,7 +10,9 @@ from __future__ import annotations
 from typing import Any, Dict, Optional
 
 import ray_tpu
+from ray_tpu.serve.asgi import ingress
 from ray_tpu.serve.batching import batch
+from ray_tpu.serve.schema import build, build_yaml, deploy_config
 from ray_tpu.serve.controller import CONTROLLER_NAME, ServeController
 from ray_tpu.serve.deployment import (
     Application,
@@ -75,12 +77,24 @@ def run(app: Application, *, name: str = "default",
     return handles[id(ingress)]
 
 
+HTTP_PROXY_NAME = "SERVE_HTTP_PROXY"
+
+
 def _start_proxy(port: int):
     from ray_tpu.serve.proxy import HTTPProxy
     if _state["proxy"] is not None:
         return
+    # detached + named, like the controller: the serve instance (and the
+    # `serve-deploy` CLI's ingress in particular) must outlive the driver
+    # job that started it
+    try:
+        _state["proxy"] = ray_tpu.get_actor(HTTP_PROXY_NAME)
+        return
+    except ValueError:
+        pass
     cls = ray_tpu.remote(HTTPProxy)
-    proxy = cls.options(max_concurrency=16, num_cpus=0).remote(
+    proxy = cls.options(name=HTTP_PROXY_NAME, lifetime="detached",
+                        max_concurrency=16, num_cpus=0).remote(
         _state["controller"], "127.0.0.1", port)
     ray_tpu.get(proxy.ready.remote(), timeout=60)
     ray_tpu.get(_state["controller"].register_proxy.remote(proxy),
@@ -139,6 +153,12 @@ def shutdown() -> None:
                 ray_tpu.kill(_state[key])
             except Exception:
                 pass
+        elif key == "proxy":
+            # detached proxy from another driver (e.g. serve-deploy CLI)
+            try:
+                ray_tpu.kill(ray_tpu.get_actor(HTTP_PROXY_NAME))
+            except Exception:
+                pass
     _state["controller"] = None
     _state["proxy"] = None
     _state["grpc_proxy"] = None
@@ -154,9 +174,13 @@ __all__ = [
     "DeploymentHandle",
     "DeploymentResponse",
     "batch",
+    "build",
+    "build_yaml",
     "delete",
+    "deploy_config",
     "deployment",
     "get_deployment_handle",
+    "ingress",
     "run",
     "shutdown",
     "status",

@@ -223,6 +223,24 @@ class DeploymentHandle:
             ref, self._router, idx,
             resubmit=lambda: self._submit(args, kwargs))
 
+    def _submit_asgi(self, scope: dict, body: bytes
+                     ) -> "DeploymentResponseGenerator":
+        """Forward a raw ASGI scope to a replica; the returned generator
+        yields the app's send-events as they are produced."""
+        idx, replica = self._router.choose()
+        gen = replica.handle_asgi.options(
+            num_returns="streaming").remote(scope, body)
+        return DeploymentResponseGenerator(gen, self._router, idx)
+
+    def _is_asgi(self) -> bool:
+        """Whether the deployment is an ASGI ingress (proxy-side routing
+        decision)."""
+        idx, replica = self._router.choose()
+        try:
+            return bool(ray_tpu.get(replica.is_asgi.remote(), timeout=30))
+        finally:
+            self._router.done(idx)
+
     def _is_streaming_method(self) -> bool:
         """Ask a live replica whether the target method is a generator
         (proxy-side auto-detection for HTTP streaming)."""

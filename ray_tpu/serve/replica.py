@@ -22,6 +22,12 @@ class Replica:
             if user_config is not None and \
                     hasattr(self._callable, "reconfigure"):
                 self._callable.reconfigure(user_config)
+        self._asgi_app = None
+        self._asgi_loop = None
+        marker = getattr(func_or_class, "__serve_asgi__", None)
+        if marker is not None:
+            from ray_tpu.serve.asgi import resolve_app
+            self._asgi_app = resolve_app(marker, self._callable)
         self._ongoing = 0
         self._total = 0
         self._lock = threading.Lock()
@@ -57,6 +63,72 @@ class Replica:
                 yield from result
             else:
                 yield result
+        finally:
+            with self._lock:
+                self._ongoing -= 1
+
+    # -- ASGI ingress (reference _private/replica.py ASGI path) ----------
+
+    def is_asgi(self) -> bool:
+        return self._asgi_app is not None
+
+    def _ensure_asgi_loop(self):
+        import asyncio
+
+        with self._lock:  # replicas serve concurrent requests: one loop
+            if self._asgi_loop is None:
+                loop = asyncio.new_event_loop()
+                t = threading.Thread(target=loop.run_forever, daemon=True,
+                                     name="replica_asgi_loop")
+                t.start()
+                self._asgi_loop = loop
+            return self._asgi_loop
+
+    def handle_asgi(self, scope: dict, body: bytes):
+        """Run the ASGI app for one request, yielding its `send` events
+        as a streaming generator — the proxy writes status/headers/chunks
+        to the HTTP client as they arrive (streaming preserved). Called
+        with num_returns="streaming"."""
+        import asyncio
+        import queue as queue_mod
+
+        if self._asgi_app is None:
+            raise RuntimeError("deployment is not an ASGI ingress")
+        with self._lock:
+            self._ongoing += 1
+            self._total += 1
+        q: "queue_mod.Queue" = queue_mod.Queue()
+        loop = self._ensure_asgi_loop()
+        app = self._asgi_app
+
+        async def run():
+            got_body = False
+
+            async def receive():
+                nonlocal got_body
+                if not got_body:
+                    got_body = True
+                    return {"type": "http.request", "body": body or b"",
+                            "more_body": False}
+                return {"type": "http.disconnect"}
+
+            async def send(event):
+                q.put(event)
+
+            try:
+                await app(scope, receive, send)
+            except BaseException as e:  # noqa: BLE001 — shipped to proxy
+                q.put({"type": "serve.error", "error": repr(e)})
+            finally:
+                q.put(None)
+
+        asyncio.run_coroutine_threadsafe(run(), loop)
+        try:
+            while True:
+                ev = q.get()
+                if ev is None:
+                    break
+                yield ev
         finally:
             with self._lock:
                 self._ongoing -= 1
