@@ -213,6 +213,82 @@ def bench_llama_tokens_per_sec(steps: int = 20):
 # Control-plane microbenchmarks (reference ray_perf.py shapes).
 # --------------------------------------------------------------------------
 
+def bench_pipeline_bubble():
+    """Measured pipeline-schedule overhead on the 4-stage host mesh
+    (VERDICT r2 item 9): times the fused-loss pipeline train step at two
+    microbatch counts and checks the per-microbatch cost against the
+    structural model t(M) ∝ M + S - 1 (bubble = (S-1)/(M+S-1); identical
+    for GPipe and 1F1B in the single-jit formulation — see
+    ray_tpu/parallel/pipeline.py). Runs in a forced-CPU subprocess so it
+    never competes with the TPU phases for the chip."""
+    import subprocess
+    import sys
+
+    code = r"""
+import json, time
+import jax
+# a sitecustomize may import jax before this code runs; force the
+# platform on the live config (mirrors __graft_entry__.dryrun_multichip)
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import numpy as np
+from ray_tpu.parallel.mesh import build_mesh
+from ray_tpu.parallel.pipeline import (
+    bubble_fraction, pipeline_train_step, stack_stage_params)
+
+S, DIM, MB_ROWS = 4, 256, 8
+mesh = build_mesh({"pp": S}, devices=jax.devices()[:S])
+rng = np.random.RandomState(0)
+params = stack_stage_params([
+    {"w": jnp.asarray(rng.randn(DIM, DIM) * 0.05, jnp.float32)}
+    for _ in range(S)])
+
+def stage_fn(p, h):
+    for _ in range(4):
+        h = jnp.tanh(h @ p["w"])
+    return h
+
+def loss_fn(o, t):
+    return jnp.mean(jnp.square(o - t))
+
+def timed(M):
+    x = jnp.asarray(rng.randn(MB_ROWS * M, DIM), jnp.float32)
+    y = jnp.asarray(rng.randn(MB_ROWS * M, DIM), jnp.float32)
+    f = jax.jit(lambda ps: pipeline_train_step(
+        stage_fn, loss_fn, ps, x, y, mesh, num_microbatches=M))
+    jax.block_until_ready(f(params))  # compile
+    n, t0 = 0, time.perf_counter()
+    while time.perf_counter() - t0 < 2.0:
+        jax.block_until_ready(f(params)[0])
+        n += 1
+    return (time.perf_counter() - t0) / n
+
+M1, M2 = 4, 32
+t1, t2 = timed(M1), timed(M2)
+# structural model: t(M) = c * (M + S - 1)  =>  per-microbatch ratio
+pred = ((M1 + S - 1) / M1) / ((M2 + S - 1) / M2)
+meas = (t1 / M1) / (t2 / M2)
+print(json.dumps({
+    "bubble_m4": round(bubble_fraction(S, M1), 4),
+    "bubble_m32": round(bubble_fraction(S, M2), 4),
+    "step_s_m4": round(t1, 4), "step_s_m32": round(t2, 4),
+    "per_microbatch_ratio_measured": round(meas, 3),
+    "per_microbatch_ratio_predicted": round(pred, 3),
+}))
+"""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=4")
+    proc = subprocess.run([sys.executable, "-c", code],
+                          capture_output=True, text=True, timeout=420,
+                          cwd=os.path.dirname(os.path.abspath(__file__)),
+                          env=env)
+    if proc.returncode != 0:
+        return {"error": proc.stderr[-300:]}
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
 def bench_control_plane():
     """Each phase gets an isolated cluster sized to the machine: worker
     processes beyond the core count thrash instead of pipelining, and a
@@ -366,6 +442,14 @@ def main():
             suite["gpt2_long_context_4096"] = {"error": repr(e)[:300]}
     else:
         suite["gpt2_long_context_4096"] = {"skipped": "budget"}
+
+    if remaining() > 120:
+        try:
+            suite["pipeline_bubble"] = bench_pipeline_bubble()
+        except Exception as e:  # noqa: BLE001
+            suite["pipeline_bubble"] = {"error": repr(e)[:300]}
+    else:
+        suite["pipeline_bubble"] = {"skipped": "budget"}
 
     # off-TPU the control-plane phase IS the headline — never gate it
     if remaining() > 120 or not on_tpu:

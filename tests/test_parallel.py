@@ -15,7 +15,13 @@ from ray_tpu.parallel import (
 )
 from ray_tpu.parallel import collectives
 from ray_tpu.parallel.moe import apply_moe
-from ray_tpu.parallel.pipeline import pipeline_apply, stack_stage_params
+from ray_tpu.parallel.pipeline import (
+    bubble_fraction,
+    pipeline_apply,
+    pipeline_loss,
+    pipeline_train_step,
+    stack_stage_params,
+)
 from ray_tpu.parallel.ring_attention import (
     full_attention,
     ring_attention,
@@ -145,6 +151,72 @@ def test_pipeline_grad():
 
     g = jax.jit(loss)(params), jax.grad(loss)(params)
     assert float(jnp.abs(g[1]["w"]).sum()) > 0
+
+
+def test_pipeline_fused_loss_and_grads_match_single_device():
+    """VERDICT r2 item 9 'done' criterion: the fused-loss pipeline's
+    loss AND per-stage grads equal a plain single-device forward/backward
+    of the same stack — with remat on (the 1F1B-equivalent memory mode)
+    and gradient accumulation over microbatches built in."""
+    n_stages, batch, dim, n_mb = 4, 16, 8, 8
+    mesh = build_mesh({"pp": n_stages}, devices=jax.devices()[:n_stages])
+    rng = np.random.RandomState(7)
+    stage_ws = [jnp.asarray(rng.randn(dim, dim) * 0.3, jnp.float32)
+                for _ in range(n_stages)]
+    params = stack_stage_params([{"w": w} for w in stage_ws])
+    x = jnp.asarray(rng.randn(batch, dim), jnp.float32)
+    y = jnp.asarray(rng.randn(batch, dim), jnp.float32)
+
+    def stage_fn(p, h):
+        return jnp.tanh(h @ p["w"])
+
+    def loss_fn(out, tgt):
+        return jnp.mean(jnp.square(out - tgt))
+
+    loss, grads = jax.jit(
+        lambda ps: pipeline_train_step(
+            stage_fn, loss_fn, ps, x, y, mesh,
+            num_microbatches=n_mb))(params)
+
+    # single-device reference: same microbatch averaging (mean of
+    # per-microbatch MSE == global MSE here since equal sizes)
+    def ref_loss(ps):
+        h = x
+        for i in range(n_stages):
+            h = jnp.tanh(h @ ps["w"][i])
+        return jnp.mean(jnp.square(h - y))
+
+    ref_l, ref_g = jax.value_and_grad(ref_loss)(params)
+    np.testing.assert_allclose(float(loss), float(ref_l), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(grads["w"]),
+                               np.asarray(ref_g["w"]),
+                               atol=1e-5, rtol=1e-4)
+    # remat (the 1F1B-equivalent memory mode) is bit-stable vs no-remat
+    loss2, grads2 = jax.jit(
+        lambda ps: pipeline_train_step(
+            stage_fn, loss_fn, ps, x, y, mesh,
+            num_microbatches=n_mb, remat=False))(params)
+    np.testing.assert_allclose(float(loss2), float(loss), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(grads2["w"]),
+                               np.asarray(grads["w"]), rtol=1e-5)
+
+
+def test_pipeline_loss_scalar_only_psum():
+    """pipeline_loss returns a replicated scalar; raising microbatches
+    shrinks the structural bubble."""
+    mesh = build_mesh({"pp": 2}, devices=jax.devices()[:2])
+    rng = np.random.RandomState(8)
+    params = stack_stage_params([
+        {"w": jnp.asarray(rng.randn(4, 4) * 0.1, jnp.float32)}
+        for _ in range(2)])
+    x = jnp.asarray(rng.randn(8, 4), jnp.float32)
+    y = jnp.asarray(rng.randn(8, 4), jnp.float32)
+    l = pipeline_loss(
+        lambda p, h: h @ p["w"], lambda o, t: jnp.mean((o - t) ** 2),
+        params, x, y, mesh, num_microbatches=4)
+    assert l.shape == ()
+    assert bubble_fraction(2, 4) == pytest.approx(1 / 5)
+    assert bubble_fraction(4, 16) < bubble_fraction(4, 4)
 
 
 def test_moe_dispatch_combines():
