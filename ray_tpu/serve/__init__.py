@@ -87,11 +87,20 @@ def _start_proxy(port: int):
     # detached + named, like the controller: the serve instance (and the
     # `serve-deploy` CLI's ingress in particular) must outlive the driver
     # job that started it
+    proxy = None
     try:
-        _state["proxy"] = ray_tpu.get_actor(HTTP_PROXY_NAME)
+        proxy = ray_tpu.get_actor(HTTP_PROXY_NAME)
+    except Exception:
+        pass  # no live proxy actor: start one
+    if proxy is not None:
+        info = ray_tpu.get(proxy.ready.remote(), timeout=30)
+        if info.get("port") != port:
+            raise ValueError(
+                f"a Serve HTTP proxy already listens on port "
+                f"{info.get('port')}; cannot start another on {port} "
+                "(serve.shutdown() first, or reuse the existing port)")
+        _state["proxy"] = proxy
         return
-    except ValueError:
-        pass
     cls = ray_tpu.remote(HTTPProxy)
     proxy = cls.options(name=HTTP_PROXY_NAME, lifetime="detached",
                         max_concurrency=16, num_cpus=0).remote(

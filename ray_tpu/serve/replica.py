@@ -24,13 +24,14 @@ class Replica:
                 self._callable.reconfigure(user_config)
         self._asgi_app = None
         self._asgi_loop = None
+        self._ongoing = 0
+        self._total = 0
+        self._lock = threading.Lock()
         marker = getattr(func_or_class, "__serve_asgi__", None)
         if marker is not None:
             from ray_tpu.serve.asgi import resolve_app
             self._asgi_app = resolve_app(marker, self._callable)
-        self._ongoing = 0
-        self._total = 0
-        self._lock = threading.Lock()
+            self._run_lifespan_startup()
 
     def handle_request(self, method: str, args: tuple, kwargs: dict) -> Any:
         with self._lock:
@@ -84,6 +85,55 @@ class Replica:
                 self._asgi_loop = loop
             return self._asgi_loop
 
+    def _run_lifespan_startup(self, timeout: float = 60.0):
+        """Replay the ASGI lifespan protocol once per replica (reference:
+        the replica wraps the app in a LifespanOn and awaits startup):
+        frameworks build their state (DB pools, model handles,
+        @app.on_event('startup')) here. Apps that don't speak lifespan
+        (raise on the scope) are fine per the ASGI spec — the server
+        continues without it. A lifespan `startup.failed` fails replica
+        construction, matching the reference."""
+        import asyncio
+        import queue as queue_mod
+
+        loop = self._ensure_asgi_loop()
+        app = self._asgi_app
+        started: "queue_mod.Queue" = queue_mod.Queue()
+
+        async def run():
+            in_q: asyncio.Queue = asyncio.Queue()
+            await in_q.put({"type": "lifespan.startup"})
+            self._lifespan_shutdown = (loop, in_q)
+
+            async def receive():
+                return await in_q.get()
+
+            async def send(ev):
+                if ev["type"] == "lifespan.startup.complete":
+                    started.put(None)
+                elif ev["type"] == "lifespan.startup.failed":
+                    started.put(RuntimeError(
+                        "ASGI lifespan startup failed: "
+                        + ev.get("message", "")))
+
+            try:
+                await app({"type": "lifespan",
+                           "asgi": {"version": "3.0",
+                                    "spec_version": "2.0"}},
+                          receive, send)
+            except BaseException:  # noqa: BLE001 — app has no lifespan
+                started.put(None)
+
+        asyncio.run_coroutine_threadsafe(run(), loop)
+        err = started.get(timeout=timeout)
+        if err is not None:
+            raise err
+
+    #: hard cap on one ASGI request's lifetime (the unary path's analog
+    #: is DeploymentResponse.result(timeout=60)); a hung app must not
+    #: wedge the replica stream (and the proxy's executor thread) forever
+    ASGI_REQUEST_TIMEOUT_S = 300.0
+
     def handle_asgi(self, scope: dict, body: bytes):
         """Run the ASGI app for one request, yielding its `send` events
         as a streaming generator — the proxy writes status/headers/chunks
@@ -103,6 +153,13 @@ class Replica:
 
         async def run():
             got_body = False
+            # after the body, receive() BLOCKS (per the ASGI contract —
+            # the next event would be a real client disconnect, which
+            # this server reports only by cancelling the app when the
+            # request ends). Returning http.disconnect eagerly would
+            # make frameworks' listen_for_disconnect cancel live
+            # streaming responses.
+            hang = asyncio.Event()
 
             async def receive():
                 nonlocal got_body
@@ -110,6 +167,7 @@ class Replica:
                     got_body = True
                     return {"type": "http.request", "body": body or b"",
                             "more_body": False}
+                await hang.wait()
                 return {"type": "http.disconnect"}
 
             async def send(event):
@@ -117,19 +175,43 @@ class Replica:
 
             try:
                 await app(scope, receive, send)
+            except asyncio.CancelledError:
+                pass
             except BaseException as e:  # noqa: BLE001 — shipped to proxy
                 q.put({"type": "serve.error", "error": repr(e)})
             finally:
                 q.put(None)
 
-        asyncio.run_coroutine_threadsafe(run(), loop)
+        task_box: dict = {}
+
+        def _start():
+            task_box["task"] = loop.create_task(run())
+
+        def _cancel():
+            t = task_box.get("task")
+            if t is not None and not t.done():
+                t.cancel()
+
+        loop.call_soon_threadsafe(_start)
+        import time as time_mod
+        deadline = time_mod.monotonic() + self.ASGI_REQUEST_TIMEOUT_S
         try:
             while True:
-                ev = q.get()
+                try:
+                    ev = q.get(timeout=max(
+                        0.0, deadline - time_mod.monotonic()))
+                except queue_mod.Empty:
+                    yield {"type": "serve.error",
+                           "error": "ASGI request timed out after "
+                                    f"{self.ASGI_REQUEST_TIMEOUT_S}s"}
+                    return
                 if ev is None:
                     break
                 yield ev
         finally:
+            # request over (done, timed out, or client gone): a
+            # still-running app gets a real cancellation
+            loop.call_soon_threadsafe(_cancel)
             with self._lock:
                 self._ongoing -= 1
 

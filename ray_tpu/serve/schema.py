@@ -67,6 +67,28 @@ def build_yaml(app: Application, **kwargs) -> str:
     return yaml.safe_dump(build(app, **kwargs), sort_keys=False)
 
 
+def _clone_app(app: Application,
+               memo: Optional[Dict[int, Application]] = None
+               ) -> Application:
+    """Structure-preserving copy of an Application graph. deploy_config
+    applies overrides onto the clone, never onto the module-cached app
+    object — otherwise a second deploy in the same process would see the
+    previous config's overrides baked in."""
+    if memo is None:
+        memo = {}
+    if id(app) in memo:
+        return memo[id(app)]
+
+    def conv(v):
+        return _clone_app(v, memo) if isinstance(v, Application) else v
+
+    clone = Application(app.deployment,
+                        tuple(conv(a) for a in app.init_args),
+                        {k: conv(v) for k, v in app.init_kwargs.items()})
+    memo[id(app)] = clone
+    return clone
+
+
 def _import_app(import_path: str) -> Application:
     if ":" not in import_path:
         raise ValueError(
@@ -81,7 +103,7 @@ def _import_app(import_path: str) -> Application:
         raise TypeError(
             f"{import_path} is {type(obj).__name__}, expected a bound "
             "Application (call .bind()) or a Deployment")
-    return obj
+    return _clone_app(obj)
 
 
 def _apply_overrides(app: Application, overrides: List[Dict]) -> None:
@@ -123,7 +145,10 @@ def deploy_config(config: Any) -> Dict[str, Any]:
             config = yaml.safe_load(config)
     if not isinstance(config, dict) or "applications" not in config:
         raise ValueError("config must contain an 'applications' list")
-    http_port = int((config.get("http_options") or {}).get("port", 0) or 0)
+    # default 8000 (the reference's serve default): a config deploy with
+    # no http_options must still be reachable over HTTP
+    http_port = int((config.get("http_options") or {}).get("port", 8000)
+                    or 8000)
     handles: Dict[str, Any] = {}
     for app_cfg in config["applications"]:
         app = _import_app(app_cfg["import_path"])

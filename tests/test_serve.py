@@ -432,6 +432,9 @@ def test_declarative_config_build_and_deploy(tmp_path):
         assert cfg["applications"][0]["deployments"][0]["name"] == "pinger"
         # operator edit: bump replicas in the YAML
         cfg["applications"][0]["deployments"][0]["num_replicas"] = 2
+        # the module proxy (other tests) owns 8123; a mismatched port
+        # must be rejected loudly, so point the config at the same one
+        cfg["http_options"] = {"port": 8123}
         yml = yaml.safe_dump(cfg)
         path = tmp_path / "serve.yaml"
         path.write_text(yml)
@@ -441,8 +444,18 @@ def test_declarative_config_build_and_deploy(tmp_path):
         st = serve.status()
         assert st["pinger"]["target_replicas"] == 2, st
 
+        # overrides land on a CLONE of the module-cached app: a second
+        # deploy without the override reverts to the code default
+        cfg2 = serve.build(cfg_app_mod.app, name="cfgapp",
+                           import_path="cfg_app_mod:app",
+                           route_prefix="/cfg")
+        cfg2["http_options"] = {"port": 8123}
+        serve.deploy_config(cfg2)
+        assert serve.status()["pinger"]["target_replicas"] == 1
+
         # unknown override fields fail loudly
-        bad = {"applications": [{"name": "b", "import_path":
+        bad = {"http_options": {"port": 8123},
+               "applications": [{"name": "b", "import_path":
                                  "cfg_app_mod:app",
                                  "deployments": [{"name": "pinger",
                                                   "nope": 1}]}]}
@@ -450,3 +463,69 @@ def test_declarative_config_build_and_deploy(tmp_path):
             serve.deploy_config(bad)
     finally:
         sys.path.remove(str(tmp_path))
+
+
+def test_asgi_lifespan_and_blocking_receive():
+    """Framework-compat contract points: (a) the lifespan protocol runs
+    once per replica (startup state visible to requests); (b) after the
+    body, receive() BLOCKS instead of returning http.disconnect — a
+    concurrent disconnect-listener (Starlette's listen_for_disconnect
+    pattern) must not cancel a live streaming response."""
+    import urllib.request
+
+    def make_app():
+        state = {}
+
+        async def app(scope, receive, send):
+            import asyncio
+            if scope["type"] == "lifespan":
+                while True:
+                    ev = await receive()
+                    if ev["type"] == "lifespan.startup":
+                        state["ready"] = "yes"
+                        await send({"type":
+                                    "lifespan.startup.complete"})
+                    elif ev["type"] == "lifespan.shutdown":
+                        await send({"type":
+                                    "lifespan.shutdown.complete"})
+                        return
+                return
+            await receive()  # body
+
+            async def listen_for_disconnect():
+                # Starlette-style: second receive must BLOCK while the
+                # response streams; an eager http.disconnect here would
+                # cancel the stream below
+                ev = await receive()
+                return ev
+
+            listener = asyncio.ensure_future(listen_for_disconnect())
+            try:
+                await send({"type": "http.response.start", "status": 200,
+                            "headers": [(b"x-ready",
+                                         state.get("ready",
+                                                   "no").encode())]})
+                for i in range(3):
+                    await asyncio.sleep(0.05)
+                    if listener.done():
+                        return  # disconnected mid-stream: abort
+                    await send({"type": "http.response.body",
+                                "body": f"s{i};".encode(),
+                                "more_body": True})
+                await send({"type": "http.response.body", "body": b"",
+                            "more_body": False})
+            finally:
+                listener.cancel()
+
+        return app
+
+    @serve.deployment
+    @serve.ingress(make_app)
+    class LifespanApp:
+        pass
+
+    serve.run(LifespanApp.bind(), route_prefix="/ls", http_port=8123)
+    with urllib.request.urlopen("http://127.0.0.1:8123/ls", timeout=60) \
+            as r:
+        assert r.headers["x-ready"] == "yes"  # lifespan startup ran
+        assert r.read() == b"s0;s1;s2;"  # stream survived the listener
