@@ -20,13 +20,13 @@ def _qkv(b=2, t=256, h=4, d=64, seed=0):
     return mk(), mk(), mk()
 
 
-def _flash_bthd(q, k, v, causal, block_q=128):
+def _flash_bthd(q, k, v, causal, block_q=128, block_k=128):
     # test through the raw kernel with interpret=True (public wrapper
     # only engages the kernel on real TPU)
     qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
     group = q.shape[2] // k.shape[2]
     out = _flash(qt, kt, vt, q.shape[-1] ** -0.5, causal, block_q,
-                 group, True)
+                 block_k, group, True)
     return out.transpose(0, 2, 1, 3)
 
 
@@ -193,3 +193,62 @@ def test_fused_ce_in_train_step():
     for _ in range(20):
         params, opt_state, loss = step(params, opt_state)
     assert float(loss) < float(first)
+
+
+@pytest.mark.parametrize("bq,bk", [(128, 256), (256, 128)])
+def test_flash_asymmetric_blocks(bq, bk):
+    """Chunked-KV online softmax with block_q != block_k (the causal
+    chunk-skip predicate must be right for partial diagonal overlaps)."""
+    q, k, v = _qkv(t=512, seed=9)
+
+    ref = full_attention(q, k, v, causal=True)
+    got = _flash_bthd(q, k, v, causal=True, block_q=bq, block_k=bk)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(full_attention(q, k, v, causal=True) ** 2)
+
+    def loss_fl(q, k, v):
+        return jnp.sum(_flash_bthd(q, k, v, causal=True,
+                                   block_q=bq, block_k=bk) ** 2)
+
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    gf = jax.grad(loss_fl, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(gr, gf):
+        scale = float(jnp.abs(a).max())
+        np.testing.assert_allclose(np.asarray(b_) / scale,
+                                   np.asarray(a) / scale, atol=1e-5)
+
+
+def test_flash_gqa_with_asymmetric_blocks():
+    """The riskiest composition: GQA head-group folding in the dK/dV
+    kernel (hk*group + jj//nq index arithmetic) together with
+    block_q != block_k causal skipping."""
+    rng = np.random.default_rng(11)
+    b, t, h, h_kv, d = 2, 512, 4, 2, 64
+    q = jnp.asarray(rng.standard_normal((b, t, h, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, t, h_kv, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, t, h_kv, d)), jnp.float32)
+
+    def expand(x):
+        return jnp.repeat(x, h // h_kv, axis=2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(full_attention(q, expand(k), expand(v),
+                                      causal=True) ** 2)
+
+    def loss_fl(q, k, v):
+        return jnp.sum(_flash_bthd(q, k, v, causal=True,
+                                   block_q=128, block_k=256) ** 2)
+
+    ref = full_attention(q, expand(k), expand(v), causal=True)
+    got = _flash_bthd(q, k, v, causal=True, block_q=128, block_k=256)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    gf = jax.grad(loss_fl, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(gr, gf):
+        scale = float(jnp.abs(a).max())
+        np.testing.assert_allclose(np.asarray(b_) / scale,
+                                   np.asarray(a) / scale, atol=1e-5)
