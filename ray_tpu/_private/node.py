@@ -158,7 +158,9 @@ class Cluster:
 
     def add_slice(self, slice_type: str, num_hosts: int,
                   chips_per_host: int = 4, cpus_per_host: float = 4.0,
-                  name: str | None = None) -> List[NodeHandle]:
+                  name: str | None = None,
+                  extra_labels: Dict[str, str] | None = None
+                  ) -> List[NodeHandle]:
         """Simulate one TPU pod slice: `num_hosts` raylets sharing a slice
         name, each owning its host-local chips (the reference's TPU-VM
         topology, accelerators/tpu.py:341-369, as local processes — the
@@ -173,6 +175,7 @@ class Cluster:
                 acc.LABEL_SLICE_TYPE: slice_type,
                 acc.LABEL_SLICE_HOST_ID: str(host_id),
                 acc.LABEL_SLICE_NUM_HOSTS: str(num_hosts),
+                **(extra_labels or {}),
             }
             handles.append(self.add_node(
                 {"CPU": cpus_per_host, "TPU": float(chips_per_host)},

