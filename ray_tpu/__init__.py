@@ -29,6 +29,7 @@ from ray_tpu._private.worker_api import (
     is_initialized,
     kill,
     list_actors,
+    method,
     nodes,
     placement_group,
     put,
@@ -63,6 +64,7 @@ __all__ = [
     "is_initialized",
     "kill",
     "list_actors",
+    "method",
     "nodes",
     "placement_group",
     "put",

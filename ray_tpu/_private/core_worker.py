@@ -265,6 +265,8 @@ class CoreWorker:
         self._exec_queue: queue_mod.SimpleQueue = queue_mod.SimpleQueue()
         self._actor_instance = None
         self._actor_threadpool: Optional[ThreadPoolExecutor] = None
+        self._actor_group_pools: Optional[Dict[str, ThreadPoolExecutor]] = None
+        self._actor_group_sems: Dict[str, Any] = {}
         self._actor_async_loop: Optional[asyncio.AbstractEventLoop] = None
         self._actor_seq_state: Dict[bytes, dict] = {}
         self._function_cache: Dict[bytes, Any] = {}
@@ -1428,6 +1430,7 @@ class CoreWorker:
         placement_group_id: bytes | None = None,
         bundle_index: int = -1,
         runtime_env: dict | None = None,
+        concurrency_groups: Dict[str, int] | None = None,
     ) -> ActorID:
         actor_id = ActorID.of(self.job_id, self.current_task_id,
                               next(self._task_counter))
@@ -1457,6 +1460,7 @@ class CoreWorker:
             detached=detached,
             actor_name=actor_name,
             runtime_env=runtime_env,
+            concurrency_groups=concurrency_groups,
         )
         reply = self._run_sync(
             self.gcs.call("register_actor", {"spec": spec.to_wire()})
@@ -1473,6 +1477,7 @@ class CoreWorker:
         kwargs: dict,
         num_returns: int = 1,
         streaming: bool = False,
+        concurrency_group: str = "",
     ):
         task_id = TaskID.of(self.job_id, self.current_task_id,
                             next(self._task_counter), actor_id)
@@ -1480,11 +1485,11 @@ class CoreWorker:
                                  task_mod.ACTOR_TASK) as trace_ctx:
             return self._submit_actor_task_traced(
                 actor_id, task_id, trace_ctx, method_name, args, kwargs,
-                num_returns, streaming)
+                num_returns, streaming, concurrency_group)
 
     def _submit_actor_task_traced(self, actor_id, task_id, trace_ctx,
                                   method_name, args, kwargs, num_returns,
-                                  streaming):
+                                  streaming, concurrency_group=""):
         wire_args, wire_kwargs, nested_refs = \
             self._serialize_args(args, kwargs)
         spec = task_mod.TaskSpec(
@@ -1501,6 +1506,7 @@ class CoreWorker:
             actor_id=actor_id.binary(),
             method_name=method_name,
             streaming=streaming,
+            concurrency_group=concurrency_group,
         )
         spec._nested_refs = nested_refs
         if streaming:
@@ -1862,7 +1868,24 @@ class CoreWorker:
             asyncio.run_coroutine_threadsafe(
                 self._run_async_actor_task(spec, fut), self._actor_async_loop
             )
-        elif self._actor_threadpool is not None:
+            return
+        if spec.task_type == task_mod.ACTOR_TASK:
+            group = self._resolve_group(spec)
+            pools = self._actor_group_pools or {}
+            pool = pools.get(group)
+            if group and pool is None:
+                # an explicitly-requested group must exist — silently
+                # running in the default executor would void the
+                # caller's isolation assumption (same contract as the
+                # async path)
+                reply = self._group_error(spec, group)
+                self._loop.call_soon_threadsafe(
+                    lambda: fut.done() or fut.set_result(reply))
+                return
+            if pool is not None:
+                pool.submit(self._execute_to_future, spec, fut)
+                return
+        if self._actor_threadpool is not None:
             self._actor_threadpool.submit(self._execute_to_future, spec, fut)
         else:
             self._exec_queue.put((spec, fut))
@@ -1883,8 +1906,15 @@ class CoreWorker:
         )
 
     async def _run_async_actor_task(self, spec, fut):
-        async with self._actor_async_sem:
-            reply = await self._execute_task_async(spec)
+        group = self._resolve_group(spec) \
+            if spec.task_type == task_mod.ACTOR_TASK else ""
+        sems = self._actor_group_sems
+        if group and group not in sems:
+            reply = self._group_error(spec, group)
+        else:
+            sem = sems.get(group, self._actor_async_sem)
+            async with sem:
+                reply = await self._execute_task_async(spec)
         self._loop.call_soon_threadsafe(
             lambda: fut.done() or fut.set_result(reply)
         )
@@ -1953,15 +1983,30 @@ class CoreWorker:
                 instance = cls(*args, **kwargs)
                 self._actor_instance = instance
                 self.current_actor_id = ActorID(spec.actor_id)
-                if spec.max_concurrency > 1:
-                    if self._has_async_methods(cls):
-                        self._start_actor_async_loop(spec.max_concurrency)
+                groups = spec.concurrency_groups
+                if self._has_async_methods(cls):
+                    if spec.max_concurrency > 1 or groups:
+                        self._start_actor_async_loop(
+                            max(1, spec.max_concurrency), groups)
                     else:
-                        self._actor_threadpool = ThreadPoolExecutor(
-                            spec.max_concurrency
-                        )
-                elif self._has_async_methods(cls):
-                    self._start_actor_async_loop(1)
+                        self._start_actor_async_loop(1)
+                elif groups:
+                    # named concurrency groups, threaded actor
+                    # (reference: concurrency_group_manager.h — one
+                    # executor per group + the default group)
+                    self._actor_group_pools = {
+                        name: ThreadPoolExecutor(
+                            max(1, int(n)),
+                            thread_name_prefix=f"group-{name}")
+                        for name, n in groups.items()
+                    }
+                    self._actor_threadpool = ThreadPoolExecutor(
+                        max(1, spec.max_concurrency),
+                        thread_name_prefix="group-default")
+                elif spec.max_concurrency > 1:
+                    self._actor_threadpool = ThreadPoolExecutor(
+                        spec.max_concurrency
+                    )
                 return {"returns": []}
             elif spec.task_type == task_mod.ACTOR_TASK:
                 method = getattr(self._actor_instance, spec.method_name)
@@ -1992,16 +2037,43 @@ class CoreWorker:
             if not n.startswith("__")
         )
 
-    def _start_actor_async_loop(self, max_concurrency: int):
+    def _start_actor_async_loop(self, max_concurrency: int,
+                                groups: Dict[str, int] | None = None):
         loop = asyncio.new_event_loop()
         self._actor_async_loop = loop
         self._actor_async_sem = asyncio.Semaphore(max_concurrency)
+        # async actors: a named group is a semaphore on the shared loop
+        # (the reference's fiber groups) — per-group admission, one loop
+        self._actor_group_sems = {
+            name: asyncio.Semaphore(max(1, int(n)))
+            for name, n in (groups or {}).items()
+        }
 
         def run():
             asyncio.set_event_loop(loop)
             loop.run_forever()
 
         threading.Thread(target=run, name="actor-async", daemon=True).start()
+
+    def _group_error(self, spec: task_mod.TaskSpec, group: str) -> dict:
+        declared = sorted((self._actor_group_pools
+                           or self._actor_group_sems or {}).keys())
+        # raise-and-catch: _package_error formats the ACTIVE exception
+        try:
+            raise ValueError(f"unknown concurrency group {group!r} "
+                             f"(declared: {declared})")
+        except ValueError as e:
+            return self._package_error(spec, e)
+
+    def _resolve_group(self, spec: task_mod.TaskSpec) -> str:
+        """Task's group: explicit call-site override, else the method's
+        declared group (@ray_tpu.method(concurrency_group=...)), else
+        the default group ('')."""
+        if spec.concurrency_group:
+            return spec.concurrency_group
+        m = getattr(type(self._actor_instance), spec.method_name or "",
+                    None)
+        return getattr(m, "__ray_tpu_concurrency_group__", "") or ""
 
     # -- executor-side streaming ------------------------------------------
 

@@ -93,10 +93,16 @@ class Raylet:
         self.clients = ClientPool()
         self.session_dir = session_dir
 
-        # Slice membership: explicit labels win, else detect from the
-        # TPU-VM environment (reference tpu.py metadata polling).
-        self.labels = dict(labels) if labels is not None else \
-            (accelerators.slice_env() or {})
+        # Slice membership: detect from the TPU-VM environment
+        # (reference tpu.py metadata polling), with explicit labels
+        # MERGED on top (per-key override). Replacing wholesale would
+        # strip slice_type/host_id from autoscaled hosts — their
+        # bootstrap passes only the autoscaler_instance label, and a
+        # slice that registers without membership can never place the
+        # topology gang that launched it.
+        self.labels = dict(accelerators.slice_env() or {})
+        if labels:
+            self.labels.update(labels)
         if resources is not None:
             self.total = dict(resources)
         else:

@@ -70,6 +70,13 @@ class TaskSpec:
     # Tracing context {trace_id, span_id} propagated submitter → executor
     # (reference: span context in task metadata, tracing_helper.py:326).
     trace_ctx: Optional[dict] = None
+    # Named concurrency groups (reference:
+    # src/ray/core_worker/transport/concurrency_group_manager.h):
+    # creation carries {group_name: max_concurrency}; an actor task may
+    # name the group it runs in ('' = the method's declared group, or
+    # the default group).
+    concurrency_groups: Optional[Dict[str, int]] = None
+    concurrency_group: str = ""
 
     # Positional wire encoding: a flat msgpack array in field order.
     # Packing 29 values is ~3x cheaper than a 29-key string map (no key
@@ -82,7 +89,8 @@ class TaskSpec:
         "seq_epoch", "max_restarts", "max_concurrency", "strategy",
         "node_id", "soft", "placement_group_id", "bundle_index",
         "max_retries", "runtime_env", "detached", "actor_name",
-        "streaming", "trace_ctx",
+        "streaming", "trace_ctx", "concurrency_groups",
+        "concurrency_group",
     )
 
     def to_wire(self) -> list:
@@ -95,7 +103,8 @@ class TaskSpec:
             self.node_id, self.soft, self.placement_group_id,
             self.bundle_index, self.max_retries, self.runtime_env,
             self.detached, self.actor_name, self.streaming,
-            self.trace_ctx,
+            self.trace_ctx, self.concurrency_groups,
+            self.concurrency_group,
         ]
 
     @classmethod

@@ -302,6 +302,7 @@ _OPTION_DEFAULTS = dict(
     max_retries=None,
     max_restarts=0,
     max_concurrency=1,
+    concurrency_groups=None,
     name=None,
     lifetime=None,
     scheduling_strategy=None,
@@ -453,13 +454,21 @@ class RemoteFunction:
 
 
 class ActorMethod:
-    def __init__(self, handle: "ActorHandle", name: str, num_returns=1):
+    def __init__(self, handle: "ActorHandle", name: str, num_returns=1,
+                 concurrency_group: str = ""):
         self._handle = handle
         self._name = name
         self._num_returns = num_returns
+        self._concurrency_group = concurrency_group
 
-    def options(self, num_returns=1) -> "ActorMethod":
-        return ActorMethod(self._handle, self._name, num_returns)
+    def options(self, num_returns=None,
+                concurrency_group: str = "") -> "ActorMethod":
+        # None/"" mean "keep": chained .options calls must compose, not
+        # silently reset each other's fields
+        return ActorMethod(
+            self._handle, self._name,
+            self._num_returns if num_returns is None else num_returns,
+            concurrency_group or self._concurrency_group)
 
     def remote(self, *args, **kwargs):
         cw = _require_state().core_worker
@@ -468,6 +477,7 @@ class ActorMethod:
             self._handle._actor_id, self._name, args, kwargs,
             num_returns=1 if streaming else self._num_returns,
             streaming=streaming,
+            concurrency_group=self._concurrency_group,
         )
         if streaming:
             return refs  # an ObjectRefGenerator
@@ -529,6 +539,7 @@ class ActorClass:
             resources=_resource_dict(opts, default_cpu=1.0),
             max_restarts=opts["max_restarts"],
             max_concurrency=opts["max_concurrency"],
+            concurrency_groups=opts["concurrency_groups"],
             detached=(opts["lifetime"] == "detached"),
             strategy=strategy,
             node_id=node_id,
@@ -544,6 +555,23 @@ class ActorClass:
             f"Actor class '{self._cls.__name__}' cannot be instantiated "
             f"directly; use .remote()."
         )
+
+
+def method(*, concurrency_group: str = ""):
+    """`@ray_tpu.method` on an actor method (reference `ray.method` +
+    `concurrency_group_manager.h`): declares the named concurrency group
+    the method runs in by default (callers can still override per call
+    with `actor.m.options(concurrency_group=...)`). Multiple returns /
+    streaming stay call-site options (`m.options(num_returns=...)`) —
+    handles reconstruct from the actor id alone and carry no class
+    metadata to read a declared default from."""
+
+    def wrap(fn):
+        if concurrency_group:
+            fn.__ray_tpu_concurrency_group__ = concurrency_group
+        return fn
+
+    return wrap
 
 
 def remote(*args, **kwargs):

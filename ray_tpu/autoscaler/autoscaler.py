@@ -42,13 +42,15 @@ class Autoscaler:
                  node_types: List[NodeType],
                  max_workers: int = 8,
                  idle_timeout_s: float = 60.0,
-                 update_interval_s: float = 2.0):
+                 update_interval_s: float = 2.0,
+                 boot_timeout_s: float = 900.0):
         self.gcs_addr = gcs_addr
         self.provider = provider
         self.node_types = {t.name: t for t in node_types}
         self.max_workers = max_workers
         self.idle_timeout_s = idle_timeout_s
         self.update_interval_s = update_interval_s
+        self.boot_timeout_s = boot_timeout_s
         self._clients = ClientPool()
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._stop = threading.Event()
@@ -57,6 +59,10 @@ class Autoscaler:
         #: below it means a host DIED (vs never booted) — the slice is
         #: broken, not booting
         self._seen_up: Dict[str, int] = {}
+        #: instance_id -> first time this reconciler saw it; an instance
+        #: that never fully registers within boot_timeout_s is broken
+        #: (failed bootstrap) and must be replaced, not credited forever
+        self._first_seen: Dict[str, float] = {}
 
     # -- one reconcile round (directly callable from tests) ------------
 
@@ -114,6 +120,23 @@ class Autoscaler:
                 continue
             up = sum(1 for nid in hosts if nid in registered)
             seen = self._seen_up.get(inst.instance_id, 0)
+            first = self._first_seen.setdefault(inst.instance_id,
+                                                time.monotonic())
+            if up < expected and \
+                    time.monotonic() - first > self.boot_timeout_s:
+                # bootstrap never (fully) joined within the timeout: a
+                # failed startup script would otherwise absorb its
+                # demand as "booting" credit forever
+                logger.warning(
+                    "instance %s never fully booted (%d/%d hosts after "
+                    "%.0fs); terminating", inst.instance_id, up,
+                    expected, time.monotonic() - first)
+                self.provider.terminate_node(inst)
+                self._seen_up.pop(inst.instance_id, None)
+                self._first_seen.pop(inst.instance_id, None)
+                instances.remove(inst)
+                inst_hosts.pop(inst.instance_id, None)
+                continue
             if up < seen:
                 # a previously-registered host died: the slice is
                 # BROKEN, not booting. Terminate it so the gang's demand
@@ -139,11 +162,12 @@ class Autoscaler:
             if ntype.slice_type and up < expected:
                 booting_slices[ntype.slice_type] = \
                     booting_slices.get(ntype.slice_type, 0) + 1
-        # prune terminated instances from the seen-up memory
+        # prune terminated instances from the tracking memories
         live = {i.instance_id for i in instances}
-        for iid in list(self._seen_up):
-            if iid not in live:
-                del self._seen_up[iid]
+        for d in (self._seen_up, self._first_seen):
+            for iid in list(d):
+                if iid not in live:
+                    del d[iid]
 
         demands: List[Dict[str, float]] = list(load["pending"])
         slice_demands: List[str] = []

@@ -301,3 +301,29 @@ def test_tpu_pod_provider_replaces_broken_slice(scaling_cluster):
             break
     assert provider.non_terminated_nodes() == []
     assert api._qrs[name]["state"] == "DELETED"
+
+
+def test_boot_timeout_replaces_wedged_slice(scaling_cluster):
+    """An instance whose bootstrap never registers any raylet is
+    terminated after boot_timeout_s instead of absorbing its pending
+    demand as 'booting' credit forever."""
+    from ray_tpu.autoscaler import TPUQueuedResourceProvider
+
+    cluster, _ = scaling_cluster
+    api = FakeQueuedResourceAPI(cluster)
+    provider = TPUQueuedResourceProvider(
+        "proj", "z", cluster.gcs_addr, transport=api)
+    autoscaler = Autoscaler(
+        cluster.gcs_addr, provider,
+        [NodeType("v5e16", {"CPU": 4.0, "TPU": 4.0}, slice_type="v5e-16",
+                  num_hosts=4)],
+        max_workers=16, idle_timeout_s=9999, boot_timeout_s=1.0)
+
+    inst = provider.create_node(autoscaler.node_types["v5e16"])
+    # never api.tick(): the startup script "fails" on every host
+    _drain_heartbeat()
+    autoscaler.update()  # records first_seen
+    time.sleep(1.2)
+    autoscaler.update()  # past boot_timeout_s: terminated
+    assert provider.non_terminated_nodes() == []
+    assert api._qrs[inst.instance_id]["state"] == "DELETED"

@@ -157,6 +157,9 @@ def test_async_actor_concurrency():
             return x
 
     aw = AsyncWorker.options(max_concurrency=8).remote()
+    # warm: actor creation spawns a worker (~2s JAX import) that must
+    # not land inside the timed window
+    ray_tpu.get(aw.work.remote(-1), timeout=120)
     start = time.time()
     out = ray_tpu.get([aw.work.remote(i) for i in range(8)], timeout=120)
     elapsed = time.time() - start
@@ -332,3 +335,84 @@ def test_actor_burst_with_nested_ref_dependency():
         refs.append(c.consume_nested.remote({0: a}))
     assert ray_tpu.get(refs, timeout=60) == [(i + 1) * 10
                                              for i in range(5)]
+
+
+def test_threaded_actor_concurrency_groups():
+    """Named concurrency groups (reference
+    concurrency_group_manager.h): per-group thread pools — 'io' (2) runs
+    its methods concurrently while 'compute' (1) serializes, without
+    either stealing the other's threads."""
+    import time as time_mod
+
+    @ray_tpu.remote(concurrency_groups={"io": 2, "compute": 1})
+    class Worker:
+        @ray_tpu.method(concurrency_group="io")
+        def fetch(self):
+            time_mod.sleep(0.5)
+            return time_mod.monotonic()
+
+        @ray_tpu.method(concurrency_group="compute")
+        def crunch(self):
+            time_mod.sleep(0.5)
+            return time_mod.monotonic()
+
+        def plain(self):
+            return "default"
+
+    w = Worker.remote()
+    ray_tpu.get(w.plain.remote(), timeout=60)  # actor up
+
+    t0 = time_mod.monotonic()
+    ray_tpu.get([w.fetch.remote(), w.fetch.remote()], timeout=60)
+    io_elapsed = time_mod.monotonic() - t0
+    assert io_elapsed < 0.95, f"io group did not run concurrently: {io_elapsed}"
+
+    t0 = time_mod.monotonic()
+    ray_tpu.get([w.crunch.remote(), w.crunch.remote()], timeout=60)
+    compute_elapsed = time_mod.monotonic() - t0
+    assert compute_elapsed > 0.95, \
+        f"compute group (size 1) overlapped: {compute_elapsed}"
+
+    # per-call override routes a method into another group
+    t0 = time_mod.monotonic()
+    ray_tpu.get([w.crunch.options(concurrency_group="io").remote(),
+                 w.crunch.options(concurrency_group="io").remote()],
+                timeout=60)
+    assert time_mod.monotonic() - t0 < 0.95
+
+    # unknown group fails loudly, not silently-default
+    with pytest.raises(Exception, match="unknown concurrency group"):
+        ray_tpu.get(w.plain.options(concurrency_group="nope").remote(),
+                    timeout=60)
+    ray_tpu.kill(w)
+
+
+def test_async_actor_concurrency_groups():
+    """Async actors get per-group semaphores on one event loop."""
+    import time as time_mod
+
+    @ray_tpu.remote(concurrency_groups={"io": 4}, max_concurrency=1)
+    class AsyncWorker:
+        @ray_tpu.method(concurrency_group="io")
+        async def fetch(self):
+            import asyncio
+            await asyncio.sleep(0.4)
+            return 1
+
+        async def slow_default(self):
+            import asyncio
+            await asyncio.sleep(0.4)
+            return 2
+
+    w = AsyncWorker.remote()
+    ray_tpu.get(w.slow_default.remote(), timeout=60)
+
+    t0 = time_mod.monotonic()
+    ray_tpu.get([w.fetch.remote() for _ in range(4)], timeout=60)
+    assert time_mod.monotonic() - t0 < 1.1  # 4-deep io group overlaps
+
+    t0 = time_mod.monotonic()
+    ray_tpu.get([w.slow_default.remote(), w.slow_default.remote()],
+                timeout=60)
+    assert time_mod.monotonic() - t0 > 0.75  # default group is 1-deep
+    ray_tpu.kill(w)
