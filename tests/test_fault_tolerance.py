@@ -136,3 +136,147 @@ def test_chaos_worker_kills_during_tune():
         thread.join(timeout=10)
         ray_tpu.shutdown()
         cluster.shutdown()
+
+
+@pytest.mark.slow
+def test_chaos_node_kill_during_tune_with_autoscaler():
+    """VERDICT r2 item 10: SIGKILL a whole raylet (its workers die via
+    their watchdog) mid-run while three recovery paths race — lineage
+    reconstruction of the objects it held, Tune trial restart/
+    rescheduling, and autoscaler replacement of the dead node. The run
+    must complete correctly and reconstruction must provably fire.
+
+    Reference ground: NodeKillerActor
+    (`python/ray/_private/test_utils.py:1497`) +
+    `python/ray/tests/test_chaos.py`.
+    """
+    import numpy as np
+
+    from ray_tpu import tune
+    from ray_tpu.air.config import FailureConfig, RunConfig
+    from ray_tpu.autoscaler import (
+        Autoscaler, FakeMultiNodeProvider, NodeType)
+
+    # 0-CPU head: every task/trial must land on autoscaled nodes
+    cluster = Cluster(head_resources={"CPU": 0.0})
+    ray_tpu.init(address=cluster.gcs_addr)
+    provider = FakeMultiNodeProvider(cluster)
+    autoscaler = Autoscaler(
+        cluster.gcs_addr, provider,
+        [NodeType("cpu4", {"CPU": 4.0})],
+        max_workers=3, idle_timeout_s=9999,
+        update_interval_s=1.0).start()
+    marker = f"/tmp/ray_tpu_nodechaos_{os.getpid()}_{int(time.time())}"
+    try:
+        # a plasma object whose only copy will live on the doomed node
+        @ray_tpu.remote(num_cpus=1)
+        def produce(marker_path):
+            with open(marker_path, "a") as f:
+                f.write("run\n")
+            return np.full(500_000, 7, np.uint8)
+
+        ref = produce.remote(marker)  # infeasible on the 0-CPU head:
+        ready, _ = ray_tpu.wait([ref], timeout=90)  # forces a scale-up
+        assert ready, "autoscaler never provided capacity"
+        assert len(open(marker).readlines()) == 1
+        doomed = provider.non_terminated_nodes()[0]
+
+        # Train-on-Tune style sweep riding the scaled nodes
+        def trainable(config):
+            for i in range(12):
+                time.sleep(0.4)
+                tune.report({"step": i, "value": config["x"] * i})
+
+        results = {}
+
+        exp_name = f"nodechaos_{int(time.time())}"
+        exp_dir = f"/tmp/ray_tpu_nodechaos/{exp_name}"
+
+        def run_tune():
+            tuner = tune.Tuner(
+                trainable,
+                param_space={"x": tune.grid_search([1, 2])},
+                tune_config=tune.TuneConfig(metric="value", mode="max"),
+                run_config=RunConfig(
+                    storage_path="/tmp/ray_tpu_nodechaos",
+                    name=exp_name,
+                    failure_config=FailureConfig(max_failures=16),
+                ),
+            )
+            try:
+                results["grid"] = tuner.fit()
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                results["error"] = e
+
+        t = threading.Thread(target=run_tune, daemon=True)
+        t.start()
+        # the kill must land on RUNNING trials (mid-flight evidence):
+        # wait until the persisted experiment state shows a reported
+        # result, not a fixed sleep
+        import pickle
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline:
+            try:
+                with open(f"{exp_dir}/experiment_state.pkl", "rb") as f:
+                    st = pickle.load(f)
+                if any(tr.last_result for tr in st["trials"]):
+                    break
+            except Exception:
+                pass
+            time.sleep(0.25)
+        else:
+            raise AssertionError("trials never started reporting")
+
+        # SIGKILL the whole node: raylet AND its workers, like the
+        # reference NodeKillerActor (killing only the raylet leaves its
+        # workers up to a watchdog interval in which short trials could
+        # finish on orphaned owner connections).
+        handle = provider._handles[doomed.instance_id][0]
+        handle.process.proc.send_signal(signal.SIGKILL)
+        for pid in _find_worker_pids(handle.store_name):
+            try:
+                os.kill(pid, signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+
+        t.join(timeout=240)
+        assert not t.is_alive(), "tune run wedged after node kill"
+        if "error" in results:
+            raise results["error"]
+        grid = results["grid"]
+        assert len(grid) == 2
+        for res in grid:
+            assert res.error is None, f"trial failed: {res.error}"
+            assert res.metrics["step"] == 11
+
+        # the kill provably disrupted the sweep: at least one trial
+        # burned a failure/retry
+        assert any(tr.num_failures > 0 for tr in grid._trials), \
+            "node kill never hit a running trial"
+
+        # lineage reconstruction FIRED: the object's only copy died with
+        # the node, so this get re-executes produce (marker line 2)
+        out = ray_tpu.get(ref, timeout=120)
+        assert out[0] == 7 and out.shape == (500_000,)
+        assert len(open(marker).readlines()) == 2, \
+            "reconstruction never re-executed the producer"
+
+        # the autoscaler detected the host drop, terminated the broken
+        # instance, and the cluster still has live provider capacity
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            live = provider.non_terminated_nodes()
+            if all(i.instance_id != doomed.instance_id for i in live):
+                break
+            time.sleep(1.0)
+        live = provider.non_terminated_nodes()
+        assert all(i.instance_id != doomed.instance_id for i in live), \
+            "dead node's instance never reaped"
+    finally:
+        autoscaler.stop()
+        ray_tpu.shutdown()
+        cluster.shutdown()
+        try:
+            os.unlink(marker)
+        except OSError:
+            pass
