@@ -88,13 +88,17 @@ class HostGroup:
     """Barrier/broadcast/allreduce among N ray_tpu actors or drivers,
     coordinated through a named rendezvous actor."""
 
-    def __init__(self, group_name: str, world_size: int, rank: int):
+    def __init__(self, group_name: str, world_size: int, rank: int,
+                 timeout_s: float = 300.0):
         import collections
 
         import ray_tpu
 
         self.world_size = world_size
         self.rank = rank
+        # every collective's completion deadline: a dead/absent rank
+        # surfaces as GetTimeoutError here instead of a silent hang
+        self.timeout_s = timeout_s
         # Per-tag round counters: every rank calls collectives in the same
         # order (SPMD), so suffixing the round number lets tags be reused.
         self._rounds = collections.defaultdict(int)
@@ -129,23 +133,23 @@ class HostGroup:
         import ray_tpu
 
         ray_tpu.get(self._actor.barrier.remote(self._round_tag(tag), self.rank),
-                    timeout=300)
+                    timeout=self.timeout_s)
 
     def broadcast(self, value=None, root: int = 0, tag: str = "bcast"):
         import ray_tpu
 
         tag = self._round_tag(tag)
         if self.rank == root:
-            ray_tpu.get(self._actor.put.remote(tag, value), timeout=300)
+            ray_tpu.get(self._actor.put.remote(tag, value), timeout=self.timeout_s)
             return value
-        return ray_tpu.get(self._actor.take.remote(tag), timeout=300)
+        return ray_tpu.get(self._actor.take.remote(tag), timeout=self.timeout_s)
 
     def allreduce_sum(self, value, tag: str = "sum"):
         import ray_tpu
 
         return ray_tpu.get(
             self._actor.reduce.remote(self._round_tag(tag), self.rank, value),
-            timeout=300,
+            timeout=self.timeout_s,
         )
 
     def allgather(self, value, tag: str = "gather"):
@@ -156,7 +160,7 @@ class HostGroup:
         return ray_tpu.get(
             self._actor.gather.remote(self._round_tag(tag), self.rank,
                                       value),
-            timeout=300,
+            timeout=self.timeout_s,
         )
 
     def reducescatter_sum(self, value, tag: str = "rs"):
@@ -195,7 +199,7 @@ class HostGroup:
         ray_tpu.get(
             self._actor.put.remote(self._p2p_tag(self.rank, dst, tag),
                                    value),
-            timeout=300)
+            timeout=self.timeout_s)
 
     def recv(self, src: int, tag: str = "p2p"):
         """Block until the matching send from rank `src` arrives."""
@@ -211,7 +215,7 @@ class HostGroup:
 
         return ray_tpu.get(
             self._actor.take_pop.remote(self._p2p_tag(src, self.rank, tag)),
-            timeout=300)
+            timeout=self.timeout_s)
 
 
 try:

@@ -64,3 +64,73 @@ def test_host_group_collectives(ray_start):
     # the detached rendezvous actor must be cleaned up
     rdv = ray_tpu.get_actor("collective:test-hg")
     ray_tpu.kill(rdv)
+
+
+def test_host_groups_concurrent_no_crosstalk(ray_start):
+    """Two groups with different names run interleaved collectives in
+    parallel; tags/rounds never leak across groups."""
+    import ray_tpu
+
+    @ray_tpu.remote
+    class Member:
+        def __init__(self, group, rank, world, base):
+            from ray_tpu.parallel.collectives import HostGroup
+
+            self.g = HostGroup(group, world, rank)
+            self.base = base
+            self.rank = rank
+
+        def run(self):
+            out = []
+            for i in range(3):
+                out.append(self.g.allreduce_sum(self.base + i))
+                self.g.barrier()
+            return out
+
+    world = 2
+    a = [Member.remote("grp-a", r, world, 100) for r in range(world)]
+    b = [Member.remote("grp-b", r, world, 1000) for r in range(world)]
+    results = ray_tpu.get([m.run.remote() for m in a + b], timeout=120)
+    for res in results[:world]:
+        assert res == [200 + 2 * i for i in range(3)]
+    for res in results[world:]:
+        assert res == [2000 + 2 * i for i in range(3)]
+    for name in ("grp-a", "grp-b"):
+        ray_tpu.kill(ray_tpu.get_actor(f"collective:{name}"))
+
+
+def test_host_group_rank_failure_times_out(ray_start):
+    """A collective with a dead/absent rank fails with a timeout after
+    the group's timeout_s instead of hanging forever (reference: GLOO
+    group timeouts)."""
+    import time as time_mod
+
+    import ray_tpu
+
+    @ray_tpu.remote
+    class Flaky:
+        def __init__(self, rank, world):
+            from ray_tpu.parallel.collectives import HostGroup
+
+            self.g = HostGroup("grp-fail", world, rank, timeout_s=3.0)
+            self.rank = rank
+
+        def run(self):
+            if self.rank == 1:
+                import os
+                os._exit(1)  # dies before joining the barrier
+            t0 = time_mod.monotonic()
+            try:
+                self.g.barrier()
+                return ("ok", time_mod.monotonic() - t0)
+            except Exception as e:
+                return (type(e).__name__, time_mod.monotonic() - t0)
+
+    world = 2
+    members = [Flaky.remote(r, world) for r in range(world)]
+    ref0 = members[0].run.remote()
+    members[1].run.remote()  # rank 1 kills itself
+    kind, elapsed = ray_tpu.get(ref0, timeout=60)
+    assert kind == "GetTimeoutError"
+    assert 2.0 < elapsed < 30.0  # bounded by timeout_s, not 300s
+    ray_tpu.kill(ray_tpu.get_actor("collective:grp-fail"))
