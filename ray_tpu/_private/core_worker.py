@@ -81,10 +81,20 @@ class _MemoryStore:
         self.errors: Dict[bytes, bytes] = {}       # oid -> pickled-exc frame
         self.locations: Dict[bytes, List[str]] = {}  # oid -> raylet addrs
         self._events: Dict[bytes, asyncio.Event] = {}
-        # Caller-thread waiters: registered at submit time so `get` can block
-        # on a concurrent Future resolved directly by the reply handler,
-        # without a loop round-trip (signalled on the loop thread).
-        self.thread_waiters: Dict[bytes, SyncFuture] = {}
+        # Global completion pulse: set on every _signal. `wait` scans +
+        # blocks on this instead of growing a watcher future per pending
+        # ref per call (which is O(n^2) across a drain loop).
+        self._any_event = asyncio.Event()
+        # Serializes sentinel→Future upgrades across getter threads
+        # (cold path: taken only when a thread is about to block).
+        self._arm_lock = threading.Lock()
+        # Caller-thread waiters. At submit time each pending return is
+        # registered with a None sentinel (a dict store — creating a
+        # concurrent Future with its Condition per call would dominate
+        # the submit path); `_get_fast` swaps in a real SyncFuture only
+        # when a thread actually blocks. The reply handler (loop thread)
+        # pops the entry and resolves it if it grew a Future.
+        self.thread_waiters: Dict[bytes, Optional[SyncFuture]] = {}
 
     def _event(self, oid: bytes) -> asyncio.Event:
         ev = self._events.get(oid)
@@ -96,16 +106,51 @@ class _MemoryStore:
     def ready(self, oid: bytes) -> bool:
         return oid in self.values or oid in self.errors or oid in self.locations
 
-    def register_thread_waiter(self, oid: bytes) -> SyncFuture:
-        fut = SyncFuture()
-        self.thread_waiters[oid] = fut
+    def register_thread_waiter(self, oid: bytes) -> None:
+        """Mark oid as a pending owned result (cheap sentinel form)."""
+        self.thread_waiters[oid] = None
+
+    def arm_thread_waiter(self, oid: bytes) -> Optional[SyncFuture]:
+        """Caller-thread: upgrade the sentinel to a blockable Future.
+        Returns None if the result is no longer pending (the caller must
+        re-check the value dicts)."""
+        with self._arm_lock:  # two getter threads must SHARE one future
+            if oid not in self.thread_waiters:
+                return None
+            existing = self.thread_waiters[oid]
+            if existing is not None:
+                # already armed by another thread — replacing it would
+                # strand that thread forever (_signal resolves only the
+                # stored one). If the reply just landed and resolved it,
+                # result() returns immediately anyway.
+                return existing
+            fut = SyncFuture()
+            self.thread_waiters[oid] = fut
+        # Re-check AFTER publishing: if the reply landed between the
+        # membership test and the store (the loop thread pops without
+        # the lock), the value dicts are already populated and the
+        # orphaned entry must not linger.
+        if self.ready(oid):
+            self.thread_waiters.pop(oid, None)
+            return None
         return fut
 
     def _signal(self, oid: bytes):
-        self._event(oid).set()
+        ev = self._events.pop(oid, None)
+        if ev is not None:
+            ev.set()
         waiter = self.thread_waiters.pop(oid, None)
         if waiter is not None and not waiter.done():
             waiter.set_result(True)
+        self._any_event.set()
+
+    async def wait_any(self, timeout: float | None):
+        """Loop-thread: block until ANY object completes (or timeout).
+        The caller must scan for readiness BEFORE calling (same loop
+        iteration — signals only fire on the loop thread, so no signal
+        can slip between the scan and the clear here)."""
+        self._any_event.clear()
+        await asyncio.wait_for(self._any_event.wait(), timeout)
 
     def put_value(self, oid: bytes, frame: bytes):
         self.values[oid] = frame
@@ -300,10 +345,10 @@ class CoreWorker:
 
     def _emit_task_event(self, task_id: bytes, name: str,
                          task_type: str, state: str):
-        self._task_events.append({
-            "task_id": task_id, "name": name, "type": task_type,
-            "state": state, "ts": time.time(),
-        })
+        # tuple form: 2 emits per task ride the submit/reply hot paths,
+        # and a 5-tuple packs ~3x cheaper than a 5-key string map
+        self._task_events.append((task_id, name, task_type, state,
+                                  time.time()))
 
     async def _event_flush_loop(self):
         """Ship buffered task events to the GCS task table ~1/s
@@ -686,8 +731,12 @@ class CoreWorker:
                 return serialization.loads(mem.values[oid])
             if oid in mem.locations:
                 return CoreWorker._FAST_MISS  # plasma: needs the pull path
-            waiter = mem.thread_waiters.get(oid)
+            waiter = mem.arm_thread_waiter(oid)
             if waiter is None:
+                # not a pending owned result (or it just resolved):
+                # loop back to re-check the value dicts once
+                if mem.ready(oid):
+                    continue
                 return CoreWorker._FAST_MISS
             t = None if deadline is None else max(0.0, deadline - time.monotonic())
             try:
@@ -772,35 +821,76 @@ class CoreWorker:
 
     def wait(self, refs: Sequence[ObjectRef], num_returns: int = 1,
              timeout: float | None = None):
+        # Caller-thread fast path: enough refs already visible in the
+        # memory store resolves the wait with no loop round-trip — the
+        # drain-a-big-batch pattern (`while not_ready: ready, not_ready =
+        # wait(not_ready)`) calls wait ~len(refs) times on mostly-ready
+        # sets, and a loop hop per call would dominate it.
+        mem = self.memory_store
+        ready = []
+        for ref in refs:
+            if mem.ready(ref.binary()):
+                ready.append(ref)
+                if len(ready) >= num_returns:
+                    ready_set = set(ready)
+                    return ready, [r for r in refs if r not in ready_set]
         return self._run_sync(self._wait_async(refs, num_returns, timeout))
 
     async def _wait_async(self, refs, num_returns, timeout):
-        pending = {ref: asyncio.ensure_future(self._ready_one(ref))
-                   for ref in refs}
+        """Scan-and-pulse wait: poll readiness synchronously, block on the
+        memory store's global completion event between scans. Remote
+        (borrowed) refs additionally get a status-driver coroutine whose
+        result lands in the memory store — waking the same pulse. The
+        API contract (reference ray.wait) caps ready at num_returns."""
+        mem = self.memory_store
+        deadline = None if timeout is None else self._loop.time() + timeout
+        # a driver that FAILS (owner unreachable) counts its ref as
+        # ready — the error surfaces at get(), and the wait must not
+        # spin forever on a ref that can never resolve
+        failed: set = set()
+
+        async def drive(r):
+            try:
+                await self._ready_one(r)
+            except Exception:  # noqa: BLE001 — recorded, surfaced at get
+                failed.add(r.binary())
+                mem._any_event.set()
+
+        drivers = [asyncio.ensure_future(drive(r))
+                   for r in refs if r.owner_addr not in ("", self.address)]
+        # plasma membership can change without a memory-store signal
+        # (e.g. a local put from another thread): include it in the
+        # first scan and in periodic rescans
+        scan_plasma = True
         ready: List[ObjectRef] = []
         try:
-            deadline = None if timeout is None else self._loop.time() + timeout
-            while len(ready) < num_returns and pending:
-                waits = list(pending.values())
-                t = None if deadline is None else max(0, deadline - self._loop.time())
-                done, _ = await asyncio.wait(
-                    waits, timeout=t, return_when=asyncio.FIRST_COMPLETED
-                )
-                if not done:
+            while True:
+                ready = []
+                for r in refs:
+                    oid = r.binary()
+                    if mem.ready(oid) or oid in failed or (
+                            scan_plasma and self.store is not None
+                            and self.store.contains(ObjectID(oid))):
+                        ready.append(r)
+                        if len(ready) >= num_returns:
+                            break
+                scan_plasma = False
+                if len(ready) >= num_returns or len(ready) == len(refs):
+                    break  # enough ready, or nothing left to wait on
+                if deadline is not None and self._loop.time() >= deadline:
                     break
-                for ref, fut in list(pending.items()):
-                    if fut in done:
-                        ready.append(ref)
-                        del pending[ref]
+                t = 0.25
+                if deadline is not None:
+                    t = min(t, max(0.0, deadline - self._loop.time()))
+                try:
+                    await mem.wait_any(t)
+                except asyncio.TimeoutError:
+                    scan_plasma = True  # periodic plasma rescan
         finally:
-            for fut in pending.values():
-                fut.cancel()
-        # one asyncio.wait pass can complete several futures at once;
-        # the API contract (reference ray.wait) caps ready at
-        # num_returns — the surplus stays claimable in not_ready
-        ready = ready[:num_returns]
-        not_ready = [r for r in refs if r not in ready]
-        return ready, not_ready
+            for f in drivers:
+                f.cancel()
+        ready_set = set(ready)
+        return ready, [r for r in refs if r not in ready_set]
 
     async def _ready_one(self, ref: ObjectRef):
         oid = ref.binary()
@@ -945,6 +1035,12 @@ class CoreWorker:
     ):
         task_id = TaskID.of(self.job_id, self.current_task_id,
                             next(self._task_counter))
+        if not tracing.enabled():  # contextmanager costs ~2us/call
+            return self._submit_task_traced(
+                task_id, None, function_key, args, kwargs, name,
+                num_returns, resources, max_retries, strategy, node_id,
+                soft, placement_group_id, bundle_index, streaming,
+                runtime_env)
         with tracing.submit_span(name, task_mod.NORMAL_TASK) as trace_ctx:
             return self._submit_task_traced(
                 task_id, trace_ctx, function_key, args, kwargs, name,
@@ -964,6 +1060,7 @@ class CoreWorker:
             job_id=self.job_id.binary(),
             name=name,
             trace_ctx=trace_ctx,
+            _nested_refs=nested_refs,
             task_type=task_mod.NORMAL_TASK,
             function_key=function_key,
             args=wire_args,
@@ -1257,7 +1354,8 @@ class CoreWorker:
         depth = (1 if state.queue
                  and state.queue[0][0].strategy == task_mod.STRATEGY_SPREAD
                  else self.config.max_tasks_in_flight_per_worker)
-        in_flight: deque = deque()  # (spec, retries_left, reply_future)
+        in_flight: deque = deque()  # ([(spec, retries_left), ...], fut)
+        n_inflight = 0
         try:
             try:
                 worker = await self._clients.get(worker_addr)
@@ -1272,56 +1370,88 @@ class CoreWorker:
                 # must not funnel onto the first worker that answers
                 # (that would serialize long tasks that could have run in
                 # parallel), while a long queue pipelines deep to
-                # amortize the push round trip.
+                # amortize the push round trip. Everything the window
+                # admits in one go rides ONE batch frame (the executor
+                # enqueues the whole batch before replying) — per-task
+                # frames would pay a syscall each way per task.
                 share = max(1, len(state.queue)
                             // max(1, state.requesting))
                 window = min(depth, share)
-                while state.queue and len(in_flight) < window:
-                    spec, retries_left = state.queue.popleft()
+                while state.queue and n_inflight < window:
+                    take = min(window - n_inflight, len(state.queue))
+                    # Only dependency-free specs may share a frame: the
+                    # batch's single reply is withheld until every task
+                    # in it finishes, so a spec whose ref args resolve
+                    # via THIS owner could deadlock on an earlier
+                    # batchmate's in-band return (same rule as the actor
+                    # fast path — see _actor_enqueue). A spec with deps
+                    # rides alone.
+                    if not self._batchable(state.queue[0][0]):
+                        batch = [state.queue.popleft()]
+                    else:
+                        batch = []
+                        while (state.queue and len(batch) < take
+                               and self._batchable(state.queue[0][0])):
+                            batch.append(state.queue.popleft())
                     try:
-                        fut = worker.call_nowait(
-                            "push_task", {"spec": spec.to_wire()})
+                        if len(batch) == 1:
+                            fut = worker.call_nowait(
+                                "push_task",
+                                {"spec": batch[0][0].to_wire()})
+                        else:
+                            fut = worker.call_nowait(
+                                "push_task_batch",
+                                {"specs": [b[0].to_wire()
+                                           for b in batch]})
                     except (ConnectionLost, OSError):
                         # not sent: requeue without burning a retry
-                        state.queue.appendleft([spec, retries_left])
+                        for b in reversed(batch):
+                            state.queue.appendleft(b)
                         worker_dead = True
                         break
-                    in_flight.append((spec, retries_left, fut))
+                    in_flight.append((batch, fut))
+                    n_inflight += len(batch)
                 if not in_flight:
                     return
-                spec, retries_left, fut = in_flight.popleft()
+                batch, fut = in_flight.popleft()
+                n_inflight -= len(batch)
                 try:
-                    reply = await fut
+                    replies = await fut
                 except (ConnectionLost, RpcError, OSError) as e:
-                    # The worker executes FIFO and replies resolve in push
-                    # order, so of everything in flight only the HEAD (the
-                    # task whose reply we were awaiting) can have started
-                    # executing — it burns a retry (it may have run) and
-                    # carries the OOM blame. Tasks pushed behind it never
-                    # started: requeue them without burning a retry, like
-                    # the never-sent case above. (A reply lost in transit
-                    # could in principle mean the next task also started —
-                    # same at-most-once race the reference accepts.)
+                    # The worker executes FIFO, so only the batch whose
+                    # reply we were awaiting can contain tasks that
+                    # started executing — each burns a retry (it may
+                    # have run) and carries the OOM blame. Batches
+                    # pushed behind it never started: requeue without
+                    # burning a retry, like the never-sent case above.
+                    # (A reply lost in transit could in principle mean
+                    # the next batch also started — same at-most-once
+                    # race the reference accepts.)
                     worker_dead = True
                     oom_reason = await self._worker_exit_reason(
                         raylet_addr, worker_addr)
-                    for s, r, f in in_flight:
+                    for later_batch, f in in_flight:
                         # mark retrieved — abandoned reply futures would
                         # otherwise log "exception was never retrieved"
                         f.add_done_callback(
                             lambda fut: fut.cancelled() or fut.exception())
-                        state.queue.append([s, r])
+                        state.queue.extend(later_batch)
                     in_flight.clear()
-                    if retries_left > 0:
-                        state.queue.append([spec, retries_left - 1])
-                    elif oom_reason:
-                        self._store_task_error(
-                            spec, OutOfMemoryError(oom_reason))
-                    else:
-                        self._store_task_error(
-                            spec, RayTaskError(f"worker died: {e}"))
+                    n_inflight = 0
+                    for spec, retries_left in batch:
+                        if retries_left > 0:
+                            state.queue.append([spec, retries_left - 1])
+                        elif oom_reason:
+                            self._store_task_error(
+                                spec, OutOfMemoryError(oom_reason))
+                        else:
+                            self._store_task_error(
+                                spec, RayTaskError(f"worker died: {e}"))
                     return
-                self._process_task_reply(spec, reply)
+                if len(batch) == 1:
+                    replies = [replies]
+                for (spec, _), reply in zip(batch, replies):
+                    self._process_task_reply(spec, reply)
                 if depth == 1:
                     return  # SPREAD: one task per lease
         finally:
@@ -1481,6 +1611,10 @@ class CoreWorker:
     ):
         task_id = TaskID.of(self.job_id, self.current_task_id,
                             next(self._task_counter), actor_id)
+        if not tracing.enabled():
+            return self._submit_actor_task_traced(
+                actor_id, task_id, None, method_name, args, kwargs,
+                num_returns, streaming, concurrency_group)
         with tracing.submit_span(method_name,
                                  task_mod.ACTOR_TASK) as trace_ctx:
             return self._submit_actor_task_traced(
@@ -1821,19 +1955,50 @@ class CoreWorker:
         """Executor side of the coalesced submit: one frame, many tasks.
         All are enqueued before the first reply is awaited, and the one
         reply frame carries every result (submitter batches replies back
-        out to per-task processing)."""
+        out to per-task processing). Tasks bound for the serial executor
+        (normal tasks; sync actors without concurrency machinery) ride
+        ONE executor hop and post all their results in ONE threadsafe
+        callback — per-task thread wakeups would dominate small-task
+        batches."""
         futs = []
+        serial: list = []  # (spec, fut) executed back-to-back
         for wire in req["specs"]:
             spec = task_mod.TaskSpec.from_wire(wire)
             fut = self._loop.create_future()
+            futs.append(fut)
             if spec.task_type == task_mod.ACTOR_TASK:
-                await self._enqueue_ordered(spec, fut)
+                for pair in self._enqueue_ordered_collect(spec, fut):
+                    if self._serial_executable(pair[0]):
+                        serial.append(pair)
+                    else:
+                        self._dispatch_actor_task(*pair)
+            else:
+                serial.append((spec, fut))
+        if len(serial) == 1:
+            spec, fut = serial[0]
+            if spec.task_type == task_mod.ACTOR_TASK:
+                self._dispatch_actor_task(spec, fut)
             else:
                 self._exec_queue.put((spec, fut))
-            futs.append(fut)
+        elif serial:
+            self._exec_queue.put((serial, None))
         return await asyncio.gather(*futs)
 
+    def _serial_executable(self, spec: task_mod.TaskSpec) -> bool:
+        """True when this actor task would land on the worker main
+        thread anyway (no async loop, no threadpool, no concurrency
+        groups) — the only case batch execution cannot reduce
+        parallelism."""
+        return (self._actor_async_loop is None
+                and self._actor_threadpool is None
+                and not self._actor_group_pools
+                and not self._resolve_group(spec))
+
     async def _enqueue_ordered(self, spec: task_mod.TaskSpec, fut):
+        for pair in self._enqueue_ordered_collect(spec, fut):
+            self._dispatch_actor_task(*pair)
+
+    def _enqueue_ordered_collect(self, spec: task_mod.TaskSpec, fut):
         """Per-caller (epoch, seq) ordering (reference: ActorSchedulingQueue).
 
         The epoch bumps when the caller restarts numbering (reconnect after a
@@ -1843,25 +2008,26 @@ class CoreWorker:
         and resync at seq 0. An older epoch is a stray orphan; run it rather
         than wedge the stream."""
         caller = spec.owner_worker_id
+        ready: list = []
         st = self._actor_seq_state.get(caller)
         if st is None:
             st = self._actor_seq_state[caller] = {
                 "epoch": -1, "expect": 0, "buffer": {},
             }
         if spec.seq_epoch < st["epoch"]:
-            self._dispatch_actor_task(spec, fut)
-            return
+            ready.append((spec, fut))
+            return ready
         if spec.seq_epoch > st["epoch"]:
             for seq in sorted(st["buffer"]):
-                self._dispatch_actor_task(*st["buffer"][seq])
+                ready.append(st["buffer"][seq])
             st["buffer"] = {}
             st["epoch"] = spec.seq_epoch
             st["expect"] = 0
         st["buffer"][spec.seq_no] = (spec, fut)
         while st["expect"] in st["buffer"]:
-            ready_spec, ready_fut = st["buffer"].pop(st["expect"])
+            ready.append(st["buffer"].pop(st["expect"]))
             st["expect"] += 1
-            self._dispatch_actor_task(ready_spec, ready_fut)
+        return ready
 
     def _dispatch_actor_task(self, spec, fut):
         if self._actor_async_loop is not None:
@@ -1891,19 +2057,36 @@ class CoreWorker:
             self._exec_queue.put((spec, fut))
 
     def run_task_loop(self):
-        """Blocks forever executing tasks (worker main thread)."""
+        """Blocks forever executing tasks (worker main thread). Queue
+        items are (spec, fut) singles or ([(spec, fut), ...], None)
+        batches from rpc_push_task_batch."""
         while True:
             item = self._exec_queue.get()
             if item is None:
                 break
             spec, fut = item
-            self._execute_to_future(spec, fut)
+            if isinstance(spec, list):
+                self._execute_batch(spec)
+            else:
+                self._execute_to_future(spec, fut)
 
     def _execute_to_future(self, spec, fut):
         reply = self.execute_task(spec)
         self._loop.call_soon_threadsafe(
             lambda: fut.done() or fut.set_result(reply)
         )
+
+    def _execute_batch(self, pairs):
+        """Execute a batch serially, then resolve every reply future in
+        ONE loop callback (one self-pipe write instead of len(pairs))."""
+        results = [(fut, self.execute_task(spec)) for spec, fut in pairs]
+
+        def post():
+            for fut, reply in results:
+                if not fut.done():
+                    fut.set_result(reply)
+
+        self._loop.call_soon_threadsafe(post)
 
     async def _run_async_actor_task(self, spec, fut):
         group = self._resolve_group(spec) \

@@ -312,21 +312,22 @@ class GcsServer:
     _TASK_EVENTS_CAP = 10_000
 
     async def rpc_add_task_events(self, req):
-        for ev in req["events"]:
-            task_id = ev["task_id"]
+        # wire form: (task_id, name, type, state, ts) tuples — see
+        # CoreWorker._emit_task_event
+        for task_id, name, task_type, state, ts in req["events"]:
             rec = self.task_events.get(task_id)
             if rec is None:
                 rec = self.task_events[task_id] = {
                     "task_id": task_id,
-                    "name": ev.get("name", ""),
-                    "type": ev.get("type", ""),
+                    "name": name,
+                    "type": task_type,
                     "state": "",
                     "events": [],
                 }
                 while len(self.task_events) > self._TASK_EVENTS_CAP:
                     self.task_events.popitem(last=False)
-            rec["state"] = ev["state"]
-            rec["events"].append((ev["state"], ev["ts"]))
+            rec["state"] = state
+            rec["events"].append((state, ts))
         return None  # notify-only path
 
     async def rpc_list_task_events(self, req):

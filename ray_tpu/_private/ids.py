@@ -97,7 +97,18 @@ class PlacementGroupID(BaseID):
 
 
 class TaskID(BaseID):
+    """Task ids are structural: sha1(job, parent, actor)[:8] prefix +
+    submission counter (5 bytes) + 3 zero bytes. The zero suffix is the
+    keyspace `ObjectID.for_task_return` substitutes the return index
+    into, so deriving a return id is a slice+concat instead of a hash —
+    this pair is the hottest id math in the system (2 per task
+    submission). The 64-bit prefix gives the same birthday-bound
+    uniqueness story as the reference's hash-derived ids
+    (`src/ray/common/id.h`), with counters disambiguating within a
+    submitter context."""
+
     __slots__ = ()
+    _prefix_cache: dict = {}
 
     @classmethod
     def for_driver(cls, job_id: JobID):
@@ -107,13 +118,19 @@ class TaskID(BaseID):
     @classmethod
     def of(cls, job_id: JobID, parent_task_id: "TaskID", counter: int,
            actor_id: ActorID | None = None):
-        h = hashlib.sha1()
-        h.update(job_id.binary())
-        h.update(parent_task_id.binary())
-        h.update(counter.to_bytes(8, "big"))
-        if actor_id is not None:
-            h.update(actor_id.binary())
-        return cls(h.digest()[:ID_SIZE])
+        key = (job_id._bytes, parent_task_id._bytes,
+               None if actor_id is None else actor_id._bytes)
+        prefix = cls._prefix_cache.get(key)
+        if prefix is None:
+            if len(cls._prefix_cache) > 4096:
+                cls._prefix_cache.clear()  # workers churn parent contexts
+            h = hashlib.sha1()
+            h.update(job_id.binary())
+            h.update(parent_task_id.binary())
+            if actor_id is not None:
+                h.update(actor_id.binary())
+            prefix = cls._prefix_cache[key] = h.digest()[:8]
+        return cls(prefix + counter.to_bytes(5, "big") + b"\x00\x00\x00")
 
 
 class ObjectID(BaseID):
@@ -121,10 +138,13 @@ class ObjectID(BaseID):
 
     @classmethod
     def for_task_return(cls, task_id: TaskID, return_index: int):
-        h = hashlib.sha1()
-        h.update(task_id.binary())
-        h.update(return_index.to_bytes(4, "big"))
-        return cls(h.digest()[:ID_SIZE])
+        # Slot the 1-based index into the task id's zero suffix (see
+        # TaskID.of). Return ids and task ids live in disjoint keyspaces
+        # everywhere they are stored, so index 0 colliding with the
+        # task id itself would still be harmless — but 1-based keeps
+        # them distinct anyway.
+        return cls(task_id._bytes[:13]
+                   + (return_index + 1).to_bytes(3, "big"))
 
     @classmethod
     def for_put(cls, task_id: TaskID, put_index: int):

@@ -87,10 +87,60 @@ _pip_failed: Dict[str, str] = {}
 _PIP_CACHE_MAX_ENVS = int(os.environ.get("RAY_TPU_PIP_ENV_CACHE_MAX", "10"))
 
 
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True  # exists, owned by someone else
+
+
+def _venv_in_use(path: str) -> bool:
+    """True if any live process holds an `.inuse.<pid>` marker on this
+    venv (written by `mark_pip_env_in_use`). Stale markers from dead
+    processes are pruned as a side effect."""
+    in_use = False
+    try:
+        for name in os.listdir(path):
+            if not name.startswith(".inuse."):
+                continue
+            try:
+                pid = int(name.rsplit(".", 1)[1])
+            except ValueError:
+                continue
+            if _pid_alive(pid):
+                in_use = True
+            else:
+                try:
+                    os.unlink(os.path.join(path, name))
+                except OSError:
+                    pass
+    except OSError:
+        pass
+    return in_use
+
+
+def mark_pip_env_in_use(dest: str) -> None:
+    """Pin a venv against LRU eviction for this process's lifetime.
+    Called by each pooled WORKER running from the venv interpreter
+    (worker_main) — the pin lasts exactly as long as the workers that
+    need the env, and crashes self-clean because the marker is checked
+    against pid liveness (the reference refcounts URIs and deletes only
+    on release — `runtime_env/pip.py`)."""
+    try:
+        open(os.path.join(dest, f".inuse.{os.getpid()}"), "w").close()
+    except OSError:
+        pass
+
+
 def _evict_pip_cache(root: str, keep: str) -> None:
     """Bound the venv cache: beyond the cap, drop the least-recently-used
-    entries (.ready mtime is touched on reuse). The reference refcounts
-    URIs and deletes on release; an LRU cap is the agentless equivalent."""
+    entries (.ready mtime is touched on reuse) — skipping venvs whose
+    interpreter is still backing a live process's worker pool. The
+    reference refcounts URIs and deletes on release; an LRU cap with
+    liveness pins is the agentless equivalent."""
     try:
         entries = [d for d in os.listdir(root)
                    if d != keep and ".tmp." not in d
@@ -99,8 +149,15 @@ def _evict_pip_cache(root: str, keep: str) -> None:
             return
         entries.sort(key=lambda d: os.path.getmtime(
             os.path.join(root, d, ".ready")))
-        for d in entries[:len(entries) + 1 - _PIP_CACHE_MAX_ENVS]:
-            shutil.rmtree(os.path.join(root, d), ignore_errors=True)
+        excess = len(entries) + 1 - _PIP_CACHE_MAX_ENVS
+        for d in entries:
+            if excess <= 0:
+                break
+            full = os.path.join(root, d)
+            if _venv_in_use(full):
+                continue  # live workers run from this interpreter
+            shutil.rmtree(full, ignore_errors=True)
+            excess -= 1
     except OSError:
         pass
 
@@ -114,7 +171,8 @@ def ensure_pip_env(pip_wire: Dict) -> str:
 
     The venv inherits the base interpreter's site-packages
     (--system-site-packages) so ray_tpu and its deps stay importable;
-    pip runs from the base install targeting the venv."""
+    pip resolves from the inherited site-packages, with an ensurepip
+    bootstrap fallback for bases that carry no pip."""
     key = hashlib.sha1(json.dumps(
         pip_wire, sort_keys=True).encode()).hexdigest()[:20]
     root = pip_env_cache_root()
@@ -166,10 +224,25 @@ def _build_pip_env(pip_wire: Dict, root: str, dest: str, py: str,
                     f.write(p + "\n")
         pkgs = pip_wire.get("packages", [])
         if pkgs:
-            cmd = [os.path.join(tmp, "bin", "python"), "-m", "pip",
-                   "install", "--quiet", "--disable-pip-version-check",
-                   *pip_wire.get("install_options", []), *pkgs]
-            res = subprocess.run(cmd, capture_output=True, timeout=600)
+            # `--without-pip` + `-m pip` rides the builder interpreter's
+            # site-packages pip (exposed via --system-site-packages).
+            # When the base install has no importable pip, bootstrap one
+            # into the venv with ensurepip and retry — instead of failing
+            # every pip runtime_env on such bases.
+            install = ["-m", "pip", "install", "--quiet",
+                       "--disable-pip-version-check",
+                       *pip_wire.get("install_options", []), *pkgs]
+            venv_py = os.path.join(tmp, "bin", "python")
+            res = subprocess.run([venv_py, *install],
+                                 capture_output=True, timeout=600)
+            if (res.returncode != 0
+                    and b"No module named pip" in res.stderr):
+                boot = subprocess.run(
+                    [venv_py, "-m", "ensurepip", "--upgrade"],
+                    capture_output=True, timeout=300)
+                if boot.returncode == 0:
+                    res = subprocess.run([venv_py, *install],
+                                         capture_output=True, timeout=600)
             if res.returncode != 0:
                 raise RuntimeEnvSetupError(
                     "pip install failed for runtime_env "

@@ -24,7 +24,7 @@ STRATEGY_NODE_AFFINITY = "NODE_AFFINITY"
 STRATEGY_PLACEMENT_GROUP = "PLACEMENT_GROUP"
 
 
-@dataclass
+@dataclass(slots=True)
 class TaskSpec:
     task_id: bytes
     job_id: bytes
@@ -77,6 +77,11 @@ class TaskSpec:
     # the default group).
     concurrency_groups: Optional[Dict[str, int]] = None
     concurrency_group: str = ""
+    # Submitter-local only (never on the wire; must stay the LAST field
+    # so `from_wire`'s positional splat fills exactly the wire fields):
+    # the nested ObjectRefs found while serializing args (truthy ⇒ the
+    # spec must not ride a multi-task batch — see CoreWorker._batchable).
+    _nested_refs: Any = False
 
     # Positional wire encoding: a flat msgpack array in field order.
     # Packing 29 values is ~3x cheaper than a 29-key string map (no key
@@ -143,7 +148,9 @@ class TaskSpec:
 
 
 # from_wire unpacks positionally — the wire tuple and the dataclass field
-# order must stay in lockstep or every spec silently corrupts.
-assert TaskSpec._WIRE_FIELDS == tuple(
+# order must stay in lockstep (submitter-local fields trail the wire
+# fields, defaulted) or every spec silently corrupts.
+_LOCAL_FIELDS = ("_nested_refs",)
+assert TaskSpec._WIRE_FIELDS + _LOCAL_FIELDS == tuple(
     f.name for f in TaskSpec.__dataclass_fields__.values()), \
     "TaskSpec._WIRE_FIELDS out of sync with field order"

@@ -60,6 +60,14 @@ def main():
         renv_mod.materialize(
             cw, env_wire,
             os.path.join(args.session_dir, "runtime_envs"))
+        # Running from a cached pip venv: pin it against LRU eviction
+        # with THIS worker's pid — the pin dies with the pool, unlike a
+        # raylet-pid marker which would pin every env forever.
+        import sys as _sys
+
+        if env_wire.get("pip") and _sys.prefix.startswith(
+                renv_mod.pip_env_cache_root()):
+            renv_mod.mark_pip_env_in_use(_sys.prefix)
         # introspectable via ray_tpu.get_runtime_context()
         cw.current_runtime_env = env_wire
 

@@ -122,7 +122,10 @@ class Autoscaler:
             seen = self._seen_up.get(inst.instance_id, 0)
             first = self._first_seen.setdefault(inst.instance_id,
                                                 time.monotonic())
-            if up < expected and \
+            # lost-host check FIRST: a slice that fully booted and later
+            # dropped a host is BROKEN, and must not be mis-diagnosed as
+            # "never booted" merely because it outlived boot_timeout_s
+            if up >= seen and up < expected and \
                     time.monotonic() - first > self.boot_timeout_s:
                 # bootstrap never (fully) joined within the timeout: a
                 # failed startup script would otherwise absorb its
@@ -149,6 +152,7 @@ class Autoscaler:
                     seen, up, expected)
                 self.provider.terminate_node(inst)
                 self._seen_up.pop(inst.instance_id, None)
+                self._first_seen.pop(inst.instance_id, None)
                 instances.remove(inst)
                 inst_hosts.pop(inst.instance_id, None)
                 continue

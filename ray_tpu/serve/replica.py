@@ -147,7 +147,10 @@ class Replica:
         with self._lock:
             self._ongoing += 1
             self._total += 1
-        q: "queue_mod.Queue" = queue_mod.Queue()
+        # Bounded: a fast-streaming app with a slow HTTP client must
+        # stall in send() instead of accumulating the whole response
+        # body in replica memory.
+        q: "queue_mod.Queue" = queue_mod.Queue(maxsize=64)
         loop = self._ensure_asgi_loop()
         app = self._asgi_app
 
@@ -171,16 +174,39 @@ class Replica:
                 return {"type": "http.disconnect"}
 
             async def send(event):
-                q.put(event)
+                # backpressure without blocking the (shared) ASGI loop:
+                # poll-put so a full queue suspends only THIS app
+                # coroutine until the proxy-side consumer drains
+                while True:
+                    try:
+                        q.put_nowait(event)
+                        return
+                    except queue_mod.Full:
+                        await asyncio.sleep(0.005)
 
+            # Termination: a LIVE consumer must receive every queued
+            # event plus the sentinel (backpressured send — never drop
+            # data from a valid stream). A cancelled request means the
+            # consumer is gone (it cancels us from its own finally /
+            # timeout), so nothing is delivered and the sentinel is
+            # skipped; cancellation also breaks any in-progress send's
+            # poll loop, so no coroutine can spin forever.
+            cancelled = False
             try:
                 await app(scope, receive, send)
             except asyncio.CancelledError:
-                pass
+                cancelled = True
             except BaseException as e:  # noqa: BLE001 — shipped to proxy
-                q.put({"type": "serve.error", "error": repr(e)})
+                try:
+                    await send({"type": "serve.error", "error": repr(e)})
+                except asyncio.CancelledError:
+                    cancelled = True
             finally:
-                q.put(None)
+                if not cancelled:
+                    try:
+                        await send(None)
+                    except asyncio.CancelledError:
+                        pass  # consumer left mid-sentinel
 
         task_box: dict = {}
 

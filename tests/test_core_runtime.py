@@ -93,6 +93,79 @@ def test_wait():
     assert len(ready) + len(not_ready) == 6
 
 
+def test_wait_drain_loop():
+    """The reference `wait_multiple_refs` pattern: drain a batch one
+    wait() at a time. Exercises both the caller-thread ready fast path
+    and the scan-and-pulse slow path; every ref must surface exactly
+    once."""
+    refs = [square.remote(i) for i in range(200)]
+    seen = []
+    not_ready = refs
+    while not_ready:
+        ready, not_ready = ray_tpu.wait(not_ready, timeout=60)
+        assert ready, "wait timed out with tasks still pending"
+        seen.extend(ready)
+    assert len(seen) == 200
+    assert {r.binary() for r in seen} == {r.binary() for r in refs}
+    assert sorted(ray_tpu.get(seen)) == sorted(i * i for i in range(200))
+
+
+def test_wait_timeout_none_ready():
+    @ray_tpu.remote
+    def sleepy():
+        import time as _t
+        _t.sleep(5)
+        return 1
+
+    ref = sleepy.remote()
+    ready, not_ready = ray_tpu.wait([ref], timeout=0.3)
+    assert ready == [] and not_ready == [ref]
+    assert ray_tpu.get(ref, timeout=60) == 1
+
+
+def test_task_burst_with_ref_dependencies():
+    """A burst where later tasks depend on earlier ones' returns must not
+    deadlock in the batched push pipeline (dependent specs ride their own
+    frame — the batch reply would otherwise withhold the upstream value
+    the executor is blocked on)."""
+    @ray_tpu.remote
+    def add_one(x):
+        return x + 1
+
+    ref = add_one.remote(0)
+    refs = [ref]
+    for _ in range(20):
+        ref = add_one.remote(ref)
+        refs.append(ref)
+    assert ray_tpu.get(refs[-1], timeout=120) == 21
+
+    # interleaved: independent + dependent specs in one burst
+    base = [add_one.remote(i) for i in range(10)]
+    chained = [add_one.remote(b) for b in base]
+    assert ray_tpu.get(chained, timeout=120) == [i + 2 for i in range(10)]
+
+
+def test_task_burst_batched_pipeline():
+    """A burst bigger than the lease-pipeline window rides batch frames;
+    results and errors must still map back per-task."""
+    @ray_tpu.remote
+    def may_fail(i):
+        if i % 17 == 0:
+            raise ValueError(f"boom {i}")
+        return i
+
+    refs = [may_fail.remote(i) for i in range(300)]
+    ok, errs = 0, 0
+    for i, r in enumerate(refs):
+        try:
+            assert ray_tpu.get(r, timeout=120) == i
+            ok += 1
+        except ray_tpu.RayTaskError as e:
+            assert f"boom {i}" in str(e)
+            errs += 1
+    assert ok == 282 and errs == 18
+
+
 def test_nested_tasks():
     @ray_tpu.remote
     def outer(n):
@@ -203,6 +276,7 @@ def test_actor_seq_epoch_resync():
     class Stub:
         _actor_seq_state = {}
         dispatched = []
+        _enqueue_ordered_collect = CoreWorker._enqueue_ordered_collect
 
         def _dispatch_actor_task(self, spec, fut):
             self.dispatched.append((spec.seq_epoch, spec.seq_no))
