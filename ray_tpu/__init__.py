@@ -13,6 +13,7 @@ from ray_tpu._private.core_worker import (
     ObjectRefGenerator,
     OutOfMemoryError,
     RayTaskError,
+    TaskCancelledError,
 )
 from ray_tpu._private.object_ref import ObjectRef
 from ray_tpu._private.worker_api import (
@@ -22,6 +23,7 @@ from ray_tpu._private.worker_api import (
     PlacementGroup,
     PlacementGroupSchedulingStrategy,
     available_resources,
+    cancel,
     cluster_resources,
     get,
     get_actor,
@@ -55,7 +57,9 @@ __all__ = [
     "PlacementGroupSchedulingStrategy",
     "RayTaskError",
     "RuntimeContext",
+    "TaskCancelledError",
     "available_resources",
+    "cancel",
     "get_runtime_context",
     "cluster_resources",
     "get",

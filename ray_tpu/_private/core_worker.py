@@ -58,6 +58,19 @@ class RayTaskError(Exception):
         self.cause = cause
 
 
+class TaskCancelledError(RayTaskError):
+    """The task was cancelled via ray_tpu.cancel() (reference:
+    `ray.exceptions.TaskCancelledError`; cancel protocol
+    `src/ray/protobuf/core_worker.proto:252-270`)."""
+
+
+class _TaskCancelledInterrupt(BaseException):
+    """Raised asynchronously inside an executing worker thread to
+    interrupt a running task (the reference interrupts with
+    KeyboardInterrupt — a BaseException so `except Exception` in user
+    code cannot swallow the cancellation)."""
+
+
 class ActorDiedError(RayTaskError):
     pass
 
@@ -129,9 +142,13 @@ class _MemoryStore:
         # Re-check AFTER publishing: if the reply landed between the
         # membership test and the store (the loop thread pops without
         # the lock), the value dicts are already populated and the
-        # orphaned entry must not linger.
+        # orphaned entry must not linger. RESOLVE what we pop — another
+        # thread may have grabbed this same future in the meantime and
+        # would otherwise block on it forever.
         if self.ready(oid):
-            self.thread_waiters.pop(oid, None)
+            w = self.thread_waiters.pop(oid, None)
+            if w is not None and not w.done():
+                w.set_result(True)
             return None
         return fut
 
@@ -303,6 +320,15 @@ class CoreWorker:
         # (reference: the submit queue in direct_task_transport.h).
         self._submit_buffer: deque = deque()  # ("normal"|"actor", spec)
         self._submit_flush_scheduled = False
+        # Cancellation (reference: CancelTask/RemoteCancelTask,
+        # core_worker.proto:252-270). Owner side: ids the user cancelled
+        # (suppresses retries; pending specs error out at push time) and
+        # where each in-flight task was pushed (to route the cancel RPC).
+        # id -> insertion time: entries are dropped at terminal reply
+        # AND age-pruned (a cancel of an already-finished task would
+        # otherwise park its id here forever).
+        self._cancelled_tasks: Dict[bytes, float] = {}
+        self._inflight_tasks: Dict[bytes, str] = {}  # task_id -> addr
 
         # Executor state (worker mode). SimpleQueue: C-implemented
         # lock-free handoff — the per-task wakeup is measurably cheaper
@@ -315,6 +341,15 @@ class CoreWorker:
         self._actor_async_loop: Optional[asyncio.AbstractEventLoop] = None
         self._actor_seq_state: Dict[bytes, dict] = {}
         self._function_cache: Dict[bytes, Any] = {}
+        # Executor side of cancellation: ids whose cancel arrived before
+        # (or during) execution; running task -> thread ident (sync) or
+        # asyncio.Task (async actors); executing task -> ids of the
+        # child tasks it submitted (recursive cancel). Same id -> time
+        # age-pruned form as _cancelled_tasks.
+        self._cancel_requested: Dict[bytes, float] = {}
+        self._running_threads: Dict[bytes, int] = {}
+        self._running_async: Dict[bytes, Any] = {}
+        self._task_children: Dict[bytes, List[bytes]] = {}
         self._shutdown = False
         self.memory_store: Optional[_MemoryStore] = None
 
@@ -1082,6 +1117,12 @@ class CoreWorker:
             streaming=streaming,
             runtime_env=runtime_env,
         )
+        if self.mode == "worker":
+            # recursive-cancel bookkeeping: this spec is a child of the
+            # task currently executing on this worker (best-effort for
+            # concurrent actors — current_task_id is per-worker)
+            self._task_children.setdefault(
+                self.current_task_id.binary(), []).append(spec.task_id)
         if streaming:
             # plain dict insert; ordered before the task via the same
             # submit-buffer flush the enqueue rides on
@@ -1378,6 +1419,11 @@ class CoreWorker:
                             // max(1, state.requesting))
                 window = min(depth, share)
                 while state.queue and n_inflight < window:
+                    if state.queue[0][0].task_id in self._cancelled_tasks:
+                        spec, _ = state.queue.popleft()
+                        self._store_task_error(
+                            spec, TaskCancelledError("task was cancelled"))
+                        continue
                     take = min(window - n_inflight, len(state.queue))
                     # Only dependency-free specs may share a frame: the
                     # batch's single reply is withheld until every task
@@ -1391,7 +1437,9 @@ class CoreWorker:
                     else:
                         batch = []
                         while (state.queue and len(batch) < take
-                               and self._batchable(state.queue[0][0])):
+                               and self._batchable(state.queue[0][0])
+                               and state.queue[0][0].task_id
+                               not in self._cancelled_tasks):
                             batch.append(state.queue.popleft())
                     try:
                         if len(batch) == 1:
@@ -1411,6 +1459,8 @@ class CoreWorker:
                         break
                     in_flight.append((batch, fut))
                     n_inflight += len(batch)
+                    for b in batch:
+                        self._inflight_tasks[b[0].task_id] = worker_addr
                 if not in_flight:
                     return
                 batch, fut = in_flight.popleft()
@@ -1436,10 +1486,19 @@ class CoreWorker:
                         f.add_done_callback(
                             lambda fut: fut.cancelled() or fut.exception())
                         state.queue.extend(later_batch)
+                        for b in later_batch:
+                            self._inflight_tasks.pop(b[0].task_id, None)
                     in_flight.clear()
                     n_inflight = 0
                     for spec, retries_left in batch:
-                        if retries_left > 0:
+                        self._inflight_tasks.pop(spec.task_id, None)
+                        if spec.task_id in self._cancelled_tasks:
+                            # a force-cancel kills the worker: the lost
+                            # connection IS the cancellation succeeding
+                            self._store_task_error(
+                                spec,
+                                TaskCancelledError("task was cancelled"))
+                        elif retries_left > 0:
                             state.queue.append([spec, retries_left - 1])
                         elif oom_reason:
                             self._store_task_error(
@@ -1451,6 +1510,7 @@ class CoreWorker:
                 if len(batch) == 1:
                     replies = [replies]
                 for (spec, _), reply in zip(batch, replies):
+                    self._inflight_tasks.pop(spec.task_id, None)
                     self._process_task_reply(spec, reply)
                 if depth == 1:
                     return  # SPREAD: one task per lease
@@ -1483,6 +1543,7 @@ class CoreWorker:
         self._emit_task_event(
             spec.task_id, spec.name, spec.task_type,
             "FAILED" if reply.get("error") else "FINISHED")
+        self._cancelled_tasks.pop(spec.task_id, None)  # terminal
         mem = self.memory_store
         plasma_oids: List[bytes] = []
         for entry in reply.get("returns", []):
@@ -1527,6 +1588,7 @@ class CoreWorker:
     def _store_task_error(self, spec: task_mod.TaskSpec, err: Exception):
         self._emit_task_event(spec.task_id, spec.name, spec.task_type,
                               "FAILED")
+        self._cancelled_tasks.pop(spec.task_id, None)  # terminal
         fut = self._reconstructing.pop(spec.task_id, None)
         if fut is not None and not fut.done():
             fut.set_result(False)
@@ -1763,6 +1825,8 @@ class CoreWorker:
             for spec in specs:
                 self._actor_task_failed(st, spec, addr, e)
             return
+        for spec in specs:
+            self._inflight_tasks[spec.task_id] = addr
         if len(specs) == 1:
             fut.add_done_callback(
                 lambda f, spec=specs[0], st=st, addr=addr:
@@ -1781,6 +1845,7 @@ class CoreWorker:
                 self._actor_task_failed(st, spec, addr, e)
             return
         for spec, reply in zip(specs, replies):
+            self._inflight_tasks.pop(spec.task_id, None)
             self._process_task_reply(spec, reply)
 
     def _assign_seq(self, st: dict, addr: str, restarts: int,
@@ -1807,6 +1872,13 @@ class CoreWorker:
         if st.get("instance") and st["instance"][0] == addr:
             st["instance"] = None
         st["seq_instance"] = None
+        self._inflight_tasks.pop(spec.task_id, None)
+        if spec.task_id in self._cancelled_tasks:
+            # force-cancel took the worker down mid-call: report the
+            # cancellation, not a spurious actor death
+            self._store_task_error(
+                spec, TaskCancelledError("task was cancelled"))
+            return
         self._store_task_error(
             spec,
             ActorDiedError(
@@ -1822,6 +1894,7 @@ class CoreWorker:
         except (ConnectionLost, RpcError, OSError) as e:
             self._actor_task_failed(st, spec, addr, e)
             return
+        self._inflight_tasks.pop(spec.task_id, None)
         self._process_task_reply(spec, reply)
 
     async def _actor_sender(self, actor_id: bytes, st: dict):
@@ -1849,8 +1922,10 @@ class CoreWorker:
                                addr: str):
         try:
             worker = await self._clients.get(addr)
+            self._inflight_tasks[spec.task_id] = addr
             reply = await worker.call("push_task", {"spec": spec.to_wire()},
                                       timeout=None)
+            self._inflight_tasks.pop(spec.task_id, None)
             self._process_task_reply(spec, reply)
         except (ConnectionLost, RpcError, OSError) as e:
             self._actor_task_failed(st, spec, addr, e)
@@ -1892,6 +1967,66 @@ class CoreWorker:
             "actor_id": actor_id.binary(),
             "reason": "ray_tpu.kill",
         }))
+
+    # ------------------------------------------------------------------
+    # task cancellation (reference: ray.cancel, worker.py:2932;
+    # CancelTask/RemoteCancelTask, core_worker.proto:252-270)
+    # ------------------------------------------------------------------
+
+    def cancel(self, ref, force: bool = False, recursive: bool = True):
+        """Best-effort cancel of the task that produces `ref`: pending
+        tasks are dequeued and error with TaskCancelledError; running
+        tasks are interrupted at the executor (async actor tasks via
+        coroutine cancel, sync tasks via an async thread exception);
+        `force` kills the executing worker process; `recursive` also
+        cancels the task's unfinished children."""
+        if isinstance(ref, ObjectRefGenerator):
+            ref.close()
+            return
+        # return ids embed the producing task id in their first 13 bytes
+        # (ids.ObjectID.for_task_return)
+        task_id = ref.binary()[:13] + b"\x00\x00\x00"
+        self._run_sync(self._cancel_task_async(task_id, force, recursive))
+
+    async def _cancel_task_async(self, task_id: bytes, force: bool,
+                                 recursive: bool) -> bool:
+        self._prune_cancel_ids(self._cancelled_tasks)
+        self._cancelled_tasks[task_id] = time.monotonic()
+        err = TaskCancelledError("task was cancelled")
+        # pending in a normal-task submit queue: dequeue + error
+        for state in self._key_states.values():
+            for entry in list(state.queue):
+                if entry[0].task_id == task_id:
+                    try:
+                        state.queue.remove(entry)
+                    except ValueError:
+                        continue  # a drain loop claimed it first
+                    self._store_task_error(entry[0], err)
+                    return True
+        # pending in an actor send queue
+        for st in self._actor_clients.values():
+            for spec in list(st["queue"]):
+                if spec.task_id == task_id:
+                    try:
+                        st["queue"].remove(spec)
+                    except ValueError:
+                        continue
+                    self._store_task_error(spec, err)
+                    return True
+        # pushed: ask the worker it is executing on (or queued at)
+        addr = self._inflight_tasks.get(task_id)
+        if addr is not None:
+            try:
+                w = await self._clients.get(addr)
+                await w.call("cancel_task", {
+                    "task_id": task_id, "force": force,
+                    "recursive": recursive,
+                }, timeout=10.0)
+                return True
+            except (ConnectionLost, RpcError, OSError,
+                    asyncio.TimeoutError):
+                return False
+        return False
 
     # ------------------------------------------------------------------
     # owner services (RPC handlers, run on io loop)
@@ -1936,6 +2071,41 @@ class CoreWorker:
 
     async def rpc_ping(self, req):
         return {"ok": True, "worker_id": self.worker_id.binary()}
+
+    async def rpc_cancel_task(self, req):
+        """Executor side of ray_tpu.cancel (reference: RemoteCancelTask,
+        core_worker.proto:261). Marks the id so a not-yet-started task
+        errors at dispatch; interrupts a running one (coroutine cancel
+        for async actors, async thread exception for sync executors);
+        recursively cancels the task's children; `force` exits the
+        worker process."""
+        task_id = req["task_id"]
+        force = req.get("force", False)
+        recursive = req.get("recursive", True)
+        self._prune_cancel_ids(self._cancel_requested)
+        self._cancel_requested[task_id] = time.monotonic()
+        atask = self._running_async.get(task_id)
+        if atask is not None and self._actor_async_loop is not None:
+            self._actor_async_loop.call_soon_threadsafe(atask.cancel)
+        else:
+            tid = self._running_threads.get(task_id)
+            if tid is not None:
+                import ctypes
+
+                ctypes.pythonapi.PyThreadState_SetAsyncExc(
+                    ctypes.c_ulong(tid),
+                    ctypes.py_object(_TaskCancelledInterrupt))
+        if recursive:
+            # this worker OWNS the children the task submitted — cancel
+            # them through its own submitter machinery
+            for child in list(self._task_children.get(task_id, ())):
+                asyncio.ensure_future(
+                    self._cancel_task_async(child, force, recursive))
+        if force:
+            # reply first, then die: the owner maps the connection loss
+            # to TaskCancelledError via its cancelled set
+            self._loop.call_later(0.1, os._exit, 1)
+        return {"ok": True}
 
     # ------------------------------------------------------------------
     # task execution (worker mode; reference: _raylet.pyx execute_task)
@@ -2061,17 +2231,64 @@ class CoreWorker:
         items are (spec, fut) singles or ([(spec, fut), ...], None)
         batches from rpc_push_task_batch."""
         while True:
-            item = self._exec_queue.get()
-            if item is None:
-                break
-            spec, fut = item
-            if isinstance(spec, list):
-                self._execute_batch(spec)
+            item = None
+            try:
+                item = self._exec_queue.get()
+                if item is None:
+                    break
+                spec, fut = item
+                if isinstance(spec, list):
+                    self._execute_batch(spec)
+                else:
+                    self._execute_to_future(spec, fut)
+            except _TaskCancelledInterrupt:
+                # A cancel interrupt that landed between tasks (the
+                # target already finished): the loop must survive it,
+                # and the in-hand item's reply futures must still
+                # resolve — a dropped item would strand its owner's
+                # get() forever.
+                if item is not None:
+                    self._resolve_lost_item(item)
+                continue
+
+    def _resolve_lost_item(self, item) -> None:
+        spec, fut = item
+        pairs = spec if isinstance(spec, list) else [(spec, fut)]
+        replies = []
+        for s, f in pairs:
+            if s.task_id in self._cancel_requested:
+                replies.append((f, self._package_cancelled(s)))
             else:
-                self._execute_to_future(spec, fut)
+                try:
+                    raise RayTaskError(
+                        "task interrupted by a stale cancellation")
+                except RayTaskError as e:
+                    replies.append((f, self._package_error(s, e)))
+
+        def post():
+            for f, reply in replies:
+                if not f.done():
+                    f.set_result(reply)
+
+        self._loop.call_soon_threadsafe(post)
+
+    def _execute_guarded(self, spec) -> dict:
+        """execute_task plus a net for cancel interrupts that land in
+        the gaps outside its own try block — a reply is ALWAYS produced
+        (a swallowed interrupt would strand the owner's future)."""
+        try:
+            return self.execute_task(spec)
+        except _TaskCancelledInterrupt:
+            if spec.task_id in self._cancel_requested:
+                return self._package_cancelled(spec)
+            try:
+                raise RayTaskError(
+                    "task interrupted by a stale cancellation")
+            except RayTaskError as e:
+                return self._package_error(spec, e)
 
     def _execute_to_future(self, spec, fut):
-        reply = self.execute_task(spec)
+        reply = self._execute_guarded(spec)
         self._loop.call_soon_threadsafe(
             lambda: fut.done() or fut.set_result(reply)
         )
@@ -2079,7 +2296,8 @@ class CoreWorker:
     def _execute_batch(self, pairs):
         """Execute a batch serially, then resolve every reply future in
         ONE loop callback (one self-pipe write instead of len(pairs))."""
-        results = [(fut, self.execute_task(spec)) for spec, fut in pairs]
+        results = [(fut, self._execute_guarded(spec))
+                   for spec, fut in pairs]
 
         def post():
             for fut, reply in results:
@@ -2089,15 +2307,27 @@ class CoreWorker:
         self._loop.call_soon_threadsafe(post)
 
     async def _run_async_actor_task(self, spec, fut):
-        group = self._resolve_group(spec) \
-            if spec.task_type == task_mod.ACTOR_TASK else ""
-        sems = self._actor_group_sems
-        if group and group not in sems:
-            reply = self._group_error(spec, group)
-        else:
-            sem = sems.get(group, self._actor_async_sem)
-            async with sem:
-                reply = await self._execute_task_async(spec)
+        self._running_async[spec.task_id] = asyncio.current_task()
+        try:
+            if spec.task_id in self._cancel_requested:
+                reply = self._package_cancelled(spec)
+            else:
+                group = self._resolve_group(spec) \
+                    if spec.task_type == task_mod.ACTOR_TASK else ""
+                sems = self._actor_group_sems
+                if group and group not in sems:
+                    reply = self._group_error(spec, group)
+                else:
+                    sem = sems.get(group, self._actor_async_sem)
+                    async with sem:
+                        reply = await self._execute_task_async(spec)
+        except asyncio.CancelledError:
+            # ray_tpu.cancel on a running async actor task: catching the
+            # cancellation (not re-raising) lets the reply flow back
+            reply = self._package_cancelled(spec)
+        finally:
+            self._running_async.pop(spec.task_id, None)
+            self._cancel_requested.pop(spec.task_id, None)
         self._loop.call_soon_threadsafe(
             lambda: fut.done() or fut.set_result(reply)
         )
@@ -2139,9 +2369,30 @@ class CoreWorker:
         with tracing.execute_span(spec):
             return self._execute_task_inner(spec)
 
+    @staticmethod
+    def _prune_cancel_ids(d: Dict[bytes, float], max_age: float = 600.0,
+                          soft_cap: int = 1024) -> None:
+        """Bound the cancel-id books: ids normally leave at the task's
+        terminal reply, but a cancel aimed at an already-finished task
+        has no terminal event — age the stragglers out."""
+        if len(d) <= soft_cap:
+            return
+        cutoff = time.monotonic() - max_age
+        for k in [k for k, ts in d.items() if ts < cutoff]:
+            del d[k]
+
+    def _package_cancelled(self, spec: task_mod.TaskSpec) -> dict:
+        try:
+            raise TaskCancelledError("task was cancelled")
+        except TaskCancelledError as e:
+            return self._package_error(spec, e)
+
     def _execute_task_inner(self, spec: task_mod.TaskSpec) -> dict:
+        if spec.task_id in self._cancel_requested:
+            return self._package_cancelled(spec)  # cancelled while queued
         prev_task = self.current_task_id
         self.current_task_id = TaskID(spec.task_id)
+        self._running_threads[spec.task_id] = threading.get_ident()
         try:
             # All-inline args decode right here; only by-reference args
             # need the event loop's async resolution machinery (two
@@ -2201,10 +2452,25 @@ class CoreWorker:
             else:
                 raise RuntimeError(f"unknown task type {spec.task_type}")
             return self._package_returns(spec, result)
+        except _TaskCancelledInterrupt:
+            if spec.task_id in self._cancel_requested:
+                return self._package_cancelled(spec)
+            # stale interrupt aimed at a prior task landed here (the
+            # SetAsyncExc race window): report honestly, not as a
+            # cancellation of THIS task
+            try:
+                raise RayTaskError(
+                    "task interrupted by a stale cancellation aimed at "
+                    "a previously-running task")
+            except RayTaskError as e:
+                return self._package_error(spec, e)
         except Exception as e:  # noqa: BLE001
             return self._package_error(spec, e)
         finally:
             self.current_task_id = prev_task
+            self._running_threads.pop(spec.task_id, None)
+            self._task_children.pop(spec.task_id, None)
+            self._cancel_requested.pop(spec.task_id, None)
 
     @staticmethod
     def _has_async_methods(cls) -> bool:
@@ -2365,9 +2631,10 @@ class CoreWorker:
     def _package_error(self, spec: task_mod.TaskSpec, exc: Exception) -> dict:
         tb = traceback.format_exc()
         logger.warning("task %s failed: %s", spec.name, tb)
-        err = RayTaskError(
-            f"task {spec.name} failed:\n{tb}", cause=None
-        )
+        # preserve framework error subtypes (TaskCancelledError etc.) so
+        # the owner can re-raise the exact class the API promises
+        cls = type(exc) if isinstance(exc, RayTaskError) else RayTaskError
+        err = cls(f"task {spec.name} failed:\n{tb}", cause=None)
         frame = serialization.dumps(err)
         returns = []
         for i in range(max(spec.num_returns, 1)):

@@ -23,6 +23,7 @@ from ray_tpu._private.core_worker import (
     CoreWorker,
     GetTimeoutError,
     RayTaskError,
+    TaskCancelledError,
 )
 from ray_tpu._private.ids import ActorID, JobID, ObjectID, PlacementGroupID
 from ray_tpu._private.node import Cluster
@@ -288,6 +289,21 @@ def kill(actor: "ActorHandle", *, no_restart: bool = True):
         state.client.kill(actor, no_restart=no_restart)
         return
     state.core_worker.kill_actor(actor._actor_id, no_restart)
+
+
+def cancel(ref, *, force: bool = False, recursive: bool = True):
+    """Cancel the task producing `ref` (reference `ray.cancel`,
+    `python/ray/_private/worker.py:2932`): a pending task is dequeued, a
+    running one is interrupted at its executor, `force=True` kills the
+    executing worker process, and `recursive=True` also cancels the
+    task's children. Best-effort — a task that already finished is
+    unaffected. `ray_tpu.get` on a cancelled task raises
+    TaskCancelledError."""
+    state = _require_state()
+    if state.client is not None:
+        state.client.cancel(ref, force=force, recursive=recursive)
+        return
+    state.core_worker.cancel(ref, force=force, recursive=recursive)
 
 
 # ----------------------------------------------------------------------

@@ -45,8 +45,16 @@ class ScalingConfig:
     trainer_resources: Optional[Dict[str, float]] = None
     # TPU pod-slice topology (e.g. "v4-16"): gang-place one worker per
     # host of a single complete slice, atomically — num_workers must
-    # equal the slice's host count. See scheduling.place_slice_bundles.
+    # equal the slice's host count (x num_slices for multislice). See
+    # scheduling.place_slice_bundles.
     topology: Optional[str] = None
+    # Multislice (SURVEY §7.1; generalizes the reference's pod
+    # convention, python/ray/_private/accelerators/tpu.py:363-388):
+    # place one atomic gang per slice, num_slices gangs total. Workers
+    # split evenly across slices; in-slice collectives ride ICI, the
+    # cross-slice data-parallel axis rides DCN
+    # (parallel.mesh.build_hybrid_mesh / ShardingStrategy.dcn_dp).
+    num_slices: int = 1
     # how long fit() waits for the gang placement before failing
     pg_timeout_s: float = 120.0
 
@@ -62,13 +70,29 @@ class ScalingConfig:
     def num_tpus_per_worker(self) -> float:
         return self._worker_resources().get("TPU", 0.0)
 
+    @property
+    def workers_per_slice(self) -> int:
+        if self.num_slices <= 1:
+            return self.num_workers
+        if self.num_workers % self.num_slices != 0:
+            raise ValueError(
+                f"num_workers={self.num_workers} must divide evenly "
+                f"across num_slices={self.num_slices}")
+        return self.num_workers // self.num_slices
+
     def bundles(self) -> List[Dict[str, float]]:
-        """One bundle per worker (+ a zero-CPU trainer bundle is implicit)."""
+        """One bundle per worker (+ a zero-CPU trainer bundle is implicit).
+        For multislice this is ONE slice's worth — the executor creates
+        num_slices placement groups from it."""
+        n = self.workers_per_slice if self.num_slices > 1 else self.num_workers
+        return [self._worker_resources() for _ in range(n)]
+
+    def total_bundles(self) -> List[Dict[str, float]]:
         return [self._worker_resources() for _ in range(self.num_workers)]
 
     def total_resources(self) -> Dict[str, float]:
         total: Dict[str, float] = {}
-        for b in self.bundles():
+        for b in self.total_bundles():
             for k, v in b.items():
                 total[k] = total.get(k, 0.0) + v
         return total

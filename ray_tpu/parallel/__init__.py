@@ -9,7 +9,8 @@ natively on `jax.sharding.Mesh` + GSPMD + `shard_map`, with XLA collectives
 riding ICI inside a slice and DCN across slices.
 """
 
-from ray_tpu.parallel.mesh import MeshConfig, build_mesh, mesh_shape_for
+from ray_tpu.parallel.mesh import (MeshConfig, build_hybrid_mesh,
+                                   build_mesh, mesh_shape_for)
 from ray_tpu.parallel.sharding import (
     ShardingStrategy,
     logical_axis_rules,
@@ -20,6 +21,7 @@ from ray_tpu.parallel.sharding import (
 __all__ = [
     "MeshConfig",
     "ShardingStrategy",
+    "build_hybrid_mesh",
     "build_mesh",
     "logical_axis_rules",
     "mesh_shape_for",

@@ -3,11 +3,20 @@
 Replaces the reference's `torch.distributed` rendezvous
 (`python/ray/train/torch/config.py:65` — rank-0 address broadcast then
 `dist.init_process_group`): here the GCS KV is the rendezvous store and
-`jax.distributed.initialize` forms the slice, after which every collective
-rides ICI/DCN via XLA — no NCCL anywhere.
+`jax.distributed.initialize` forms the world, after which every
+collective rides ICI/DCN via XLA — no NCCL anywhere.
 
 Each train worker (actor) is one JAX process owning its host's chips
 (multi-controller model); the driver never touches TPUs.
+
+Multislice: N slice gangs join ONE jax.distributed world through the
+same rendezvous — world_size spans every host of every slice, and the
+TPU runtime links the slices over DCN (megascale). The mesh layer then
+places the cross-slice axis outermost (`mesh.build_hybrid_mesh` /
+`ShardingStrategy.dcn_dp`) so only the data-parallel gradient reduction
+crosses slices; Train places one atomic gang per slice
+(`ScalingConfig.num_slices`) and exposes `get_slice_rank()` in the
+session context.
 """
 
 from __future__ import annotations

@@ -104,6 +104,50 @@ def build_mesh(
     return Mesh(device_array, names)
 
 
+def build_hybrid_mesh(
+    ici_axes: Dict[str, int],
+    dcn_axes: Dict[str, int],
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Hybrid ICI x DCN mesh for multislice (SURVEY §7.1: "in-slice =
+    ICI ...; cross-slice = DCN (multislice)"; generalizes the reference's
+    pod convention `python/ray/_private/accelerators/tpu.py:363-388`).
+
+    The dcn axes are OUTERMOST so every collective over an ici axis stays
+    inside one slice's fast fabric; only dcn-axis collectives (typically
+    the data-parallel gradient reduction) cross the slower inter-slice
+    network — the scaling-book layout.
+
+    On real multislice TPU the devices carry `slice_index` and
+    `mesh_utils.create_hybrid_device_mesh` assigns them; on CPU (tests,
+    the driver's virtual dryrun) devices are partitioned into contiguous
+    blocks, one block playing each slice.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    ici_shape = tuple(ici_axes.values())
+    dcn_shape = tuple(dcn_axes.values())
+    n_slices = int(np.prod(dcn_shape)) if dcn_shape else 1
+    per_slice = int(np.prod(ici_shape)) if ici_shape else 1
+    if n_slices * per_slice != len(devices):
+        raise ValueError(
+            f"hybrid mesh {dcn_axes} x {ici_axes} needs "
+            f"{n_slices * per_slice} devices, have {len(devices)}")
+    names = tuple(dcn_axes.keys()) + tuple(ici_axes.keys())
+    if devices and devices[0].platform == "tpu" \
+            and getattr(devices[0], "slice_index", None) is not None:
+        from jax.experimental import mesh_utils
+
+        # same-rank shapes: each axis is parallel over exactly one
+        # network (dcn axes are 1 in the ici shape and vice versa)
+        mesh_shape = (1,) * len(dcn_shape) + ici_shape
+        dcn_mesh_shape = dcn_shape + (1,) * len(ici_shape)
+        device_array = mesh_utils.create_hybrid_device_mesh(
+            mesh_shape, dcn_mesh_shape, devices)
+    else:
+        device_array = np.asarray(devices).reshape(dcn_shape + ici_shape)
+    return Mesh(device_array, names)
+
+
 def slice_info() -> dict:
     """Topology of the local TPU slice (host count, chips per host, ICI
     coords) — drives slice-aware gang scheduling (reference sketch:

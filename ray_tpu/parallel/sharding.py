@@ -29,7 +29,12 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 @dataclass(frozen=True)
 class ShardingStrategy:
     """Declarative parallelism config (the ScalingConfig extension promised
-    in SURVEY.md §7.1)."""
+    in SURVEY.md §7.1).
+
+    `dcn_dp` is the multislice knob: the number of ICI slices ganged over
+    the inter-slice (DCN) network, used as an extra OUTER data-parallel
+    axis. The per-slice axes (dp/fsdp/tp/sp/pp/ep) describe one slice's
+    mesh; the full mesh is dcn x per-slice (mesh.build_hybrid_mesh)."""
 
     dp: int = 1
     fsdp: int = 1
@@ -37,29 +42,42 @@ class ShardingStrategy:
     sp: int = 1
     pp: int = 1
     ep: int = 1
+    dcn_dp: int = 1
 
     def mesh_axes(self, n_devices: int) -> Dict[str, int]:
+        """Per-slice (ICI) axes for `n_devices` devices in ONE slice."""
         from ray_tpu.parallel.mesh import mesh_shape_for
 
         return mesh_shape_for(n_devices, dp=self.dp, fsdp=self.fsdp,
                               tp=self.tp, sp=self.sp, pp=self.pp, ep=self.ep)
 
     def build_mesh(self, devices=None) -> Mesh:
-        from ray_tpu.parallel.mesh import MeshConfig, build_mesh
+        from ray_tpu.parallel.mesh import (MeshConfig, build_hybrid_mesh,
+                                           build_mesh)
 
         devices = list(devices if devices is not None else jax.devices())
+        if self.dcn_dp > 1:
+            if len(devices) % self.dcn_dp != 0:
+                raise ValueError(
+                    f"{len(devices)} devices not divisible into "
+                    f"{self.dcn_dp} slices")
+            per_slice = len(devices) // self.dcn_dp
+            return build_hybrid_mesh(
+                self.mesh_axes(per_slice), {"dcn": self.dcn_dp}, devices)
         return build_mesh(MeshConfig(self.mesh_axes(len(devices))), devices)
 
     @property
     def data_axes(self) -> Tuple[str, ...]:
-        """Mesh axes the global batch is split over."""
-        return tuple(a for a, n in (("dp", self.dp), ("fsdp", self.fsdp))
-                     if n > 1) or ("dp",)
+        """Mesh axes the global batch is split over (dcn outermost)."""
+        axes = tuple(a for a, n in (("dcn", self.dcn_dp), ("dp", self.dp),
+                                    ("fsdp", self.fsdp)) if n > 1)
+        return axes or ("dp",)
 
 
 def logical_axis_rules(strategy: ShardingStrategy) -> List[Tuple[str, Optional[tuple]]]:
     """Logical-axis -> mesh-axis rules for `flax.linen.logical_axis_rules`."""
-    batch_axes = tuple(a for a, n in (("dp", strategy.dp),
+    batch_axes = tuple(a for a, n in (("dcn", strategy.dcn_dp),
+                                      ("dp", strategy.dp),
                                       ("fsdp", strategy.fsdp)) if n > 1)
     rules: List[Tuple[str, Optional[tuple]]] = [
         ("batch", batch_axes or None),

@@ -43,6 +43,7 @@ class BackendExecutor:
         self.trial_id = trial_id
         self.worker_group: Optional[WorkerGroup] = None
         self.pg = None
+        self.pgs: list = []
         self._finished_workers: set[int] = set()
         self._errors: Dict[int, str] = {}
 
@@ -53,21 +54,32 @@ class BackendExecutor:
             timeout = self.scaling.pg_timeout_s
         bundles = self.scaling.bundles()
         # topology="v4-16" gang-places one worker bundle per host of a
-        # single complete TPU slice, all-or-nothing (survey §7.1)
-        self.pg = ray_tpu.placement_group(
-            bundles, strategy=self.scaling.placement_strategy,
-            topology=self.scaling.topology)
-        if not self.pg.ready(timeout=timeout):
-            raise TrainingFailedError(
-                f"placement group with bundles {bundles} "
-                + (f"on slice topology {self.scaling.topology!r} "
-                   if self.scaling.topology else "")
-                + f"not placeable within {timeout}s (cluster resources: "
-                f"{ray_tpu.cluster_resources()})")
+        # single complete TPU slice, all-or-nothing (survey §7.1).
+        # Multislice: one atomic gang PER SLICE — num_slices placement
+        # groups, each a complete slice (the reference's pod-head
+        # convention generalized, accelerators/tpu.py:363-388).
+        n_slices = max(1, self.scaling.num_slices)
+        self.pgs = [
+            ray_tpu.placement_group(
+                bundles, strategy=self.scaling.placement_strategy,
+                topology=self.scaling.topology)
+            for _ in range(n_slices)
+        ]
+        self.pg = self.pgs[0]
+        deadline = time.monotonic() + timeout
+        for i, pg in enumerate(self.pgs):
+            if not pg.ready(timeout=max(0.0, deadline - time.monotonic())):
+                raise TrainingFailedError(
+                    f"placement group {i + 1}/{n_slices} with bundles "
+                    f"{bundles} "
+                    + (f"on slice topology {self.scaling.topology!r} "
+                       if self.scaling.topology else "")
+                    + f"not placeable within {timeout}s (cluster "
+                    f"resources: {ray_tpu.cluster_resources()})")
         self.worker_group = WorkerGroup(
             self.scaling.num_workers,
             self.scaling._worker_resources(),
-            placement_group=self.pg,
+            placement_group=self.pgs if n_slices > 1 else self.pg,
             worker_env=self.backend_config.worker_env(),
         )
         # Rank assignment: sort by (hostname, pid) for stable local ranks
@@ -105,6 +117,8 @@ class BackendExecutor:
                 local_rank=local_rank,
                 local_world_size=0,  # patched below
                 node_rank=node_rank[host],
+                slice_rank=wg.slice_rank(rank),
+                num_slices=wg.num_slices,
                 trial_id=self.trial_id,
                 trial_dir=trial_dir,
                 checkpoint=checkpoint,
@@ -213,9 +227,10 @@ class BackendExecutor:
                 pass
             self.worker_group.shutdown()
             self.worker_group = None
-        if self.pg is not None:
+        for pg in getattr(self, "pgs", None) or ([self.pg] if self.pg else []):
             try:
-                ray_tpu.remove_placement_group(self.pg)
+                ray_tpu.remove_placement_group(pg)
             except Exception:
                 pass
-            self.pg = None
+        self.pg = None
+        self.pgs = []

@@ -29,6 +29,10 @@ class SessionConfig:
     local_rank: int
     local_world_size: int
     node_rank: int
+    # multislice: which ICI slice this worker's gang occupies, and how
+    # many slices the run spans (cross-slice traffic rides DCN)
+    slice_rank: int = 0
+    num_slices: int = 1
     trial_id: str = "default"
     trial_dir: str = ""        # {storage_path}/{trial_id}
     checkpoint: Optional[Checkpoint] = None   # restore-from
@@ -141,6 +145,13 @@ class TrainContext:
 
     def get_node_rank(self) -> int:
         return _require().config.node_rank
+
+    def get_slice_rank(self) -> int:
+        """Which ICI slice this worker's gang occupies (multislice)."""
+        return _require().config.slice_rank
+
+    def get_num_slices(self) -> int:
+        return _require().config.num_slices
 
     def get_trial_id(self) -> str:
         return _require().config.trial_id

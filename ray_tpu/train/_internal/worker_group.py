@@ -115,7 +115,12 @@ def _safe_exc(e: BaseException):
 
 
 class WorkerGroup:
-    """Fleet of TrainWorker actors pinned to placement-group bundles."""
+    """Fleet of TrainWorker actors pinned to placement-group bundles.
+
+    `placement_group` may be a single PG, or a LIST of PGs for
+    multislice — workers are split evenly across them in rank order
+    (slice_rank = world_rank // workers_per_slice), so each slice's gang
+    is a contiguous rank range and in-slice collectives stay on ICI."""
 
     def __init__(self, num_workers: int,
                  resources_per_worker: Dict[str, float],
@@ -123,7 +128,12 @@ class WorkerGroup:
                  worker_env: Optional[Dict[str, str]] = None):
         self.num_workers = num_workers
         self.workers: List[Any] = []
-        self._pg = placement_group
+        pgs = (list(placement_group)
+               if isinstance(placement_group, (list, tuple))
+               else ([placement_group] if placement_group is not None
+                     else None))
+        self.num_slices = len(pgs) if pgs else 1
+        per_slice = num_workers // self.num_slices if pgs else num_workers
         cls = ray_tpu.remote(TrainWorker)
         res = dict(resources_per_worker)
         num_cpus = res.pop("CPU", 1.0)
@@ -132,12 +142,16 @@ class WorkerGroup:
             opts: Dict[str, Any] = dict(num_cpus=num_cpus, resources=dict(res))
             if num_tpus:
                 opts["num_tpus"] = num_tpus
-            if placement_group is not None:
+            if pgs is not None:
                 opts["scheduling_strategy"] = \
                     ray_tpu.PlacementGroupSchedulingStrategy(
-                        placement_group=placement_group,
-                        placement_group_bundle_index=i)
+                        placement_group=pgs[i // per_slice],
+                        placement_group_bundle_index=i % per_slice)
             self.workers.append(cls.options(**opts).remote(worker_env))
+
+    def slice_rank(self, world_rank: int) -> int:
+        per_slice = self.num_workers // self.num_slices
+        return world_rank // per_slice
 
     def execute_async(self, fn: Callable, *args, **kwargs) -> List[Any]:
         return [w.execute.remote(fn, *args, **kwargs) for w in self.workers]

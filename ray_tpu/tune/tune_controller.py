@@ -199,10 +199,17 @@ class TuneController:
 
     def _stop_actor(self, trial: Trial, kill: bool = False) -> None:
         actor = self._actors.pop(trial.trial_id, None)
-        # drop any in-flight step ref for this trial
+        # drop any in-flight step ref for this trial — and CANCEL it, so
+        # a straggling step is preempted instead of running to completion
+        # under a doomed actor (reference: ray.cancel-based preemption;
+        # the subsequent kill is the backstop for non-cooperative steps)
         for ref, tid in list(self._pending_step.items()):
             if tid == trial.trial_id:
                 del self._pending_step[ref]
+                try:
+                    ray_tpu.cancel(ref, recursive=True)
+                except Exception:  # noqa: BLE001 — cancel is best-effort
+                    pass
         if actor is None:
             return
         try:

@@ -256,6 +256,11 @@ class ClientContext:
         self._call("kill_actor", {"actor_id": handle._actor_id,
                                   "no_restart": no_restart})
 
+    def cancel(self, ref: ClientObjectRef, *, force: bool = False,
+               recursive: bool = True):
+        self._call("cancel", {"ref": ref.ref_id, "force": force,
+                              "recursive": recursive})
+
     def get_actor(self, name: str) -> ClientActorHandle:
         try:
             reply = self._call("get_named_actor", {"name": name})

@@ -117,6 +117,19 @@ class ClientServer:
         return {"ready": [r.binary() for r in ready],
                 "not_ready": [r.binary() for r in not_ready]}
 
+    async def rpc_cancel(self, req):
+        ref = self._resolve(req["ref"])
+
+        def do_cancel():
+            return self._ray.cancel(ref, force=req.get("force", False),
+                                    recursive=req.get("recursive", True))
+
+        try:
+            await self._blocking(do_cancel)
+        except Exception as e:  # noqa: BLE001
+            return {"exc": dump_exception(e)}
+        return {"ok": True}
+
     async def rpc_release(self, req):
         sess = self._sessions.get(req.get("session", ""), set())
         for rid in req["refs"]:

@@ -106,6 +106,24 @@ def test_client_task_error_propagates(client):
         ray_tpu.get(boom.remote())
 
 
+def test_client_cancel(client):
+    """ray_tpu.cancel proxies through the client server (no local core
+    worker in client mode)."""
+    import time as time_mod
+
+    @ray_tpu.remote
+    def busy():
+        d = time_mod.monotonic() + 60
+        while time_mod.monotonic() < d:
+            time_mod.sleep(0.02)
+
+    ref = busy.remote()
+    time_mod.sleep(0.8)
+    ray_tpu.cancel(ref)
+    with pytest.raises(Exception, match="cancel"):
+        ray_tpu.get(ref, timeout=30)
+
+
 def test_client_unknown_actor_raises(client):
     with pytest.raises(ValueError):
         ray_tpu.get_actor("does-not-exist")

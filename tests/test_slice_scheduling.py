@@ -173,3 +173,75 @@ def test_train_slice_unplaceable_fails_cleanly(slice_cluster, tmp_path):
     )
     with pytest.raises(TrainingFailedError):
         trainer.fit()
+
+
+# ---------------------------------------------------------------------------
+# multislice: N atomic slice gangs, DCN data axis across them
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def multislice_cluster(slice_cluster):
+    # grow the shared module cluster to TWO v2-8 slices (a second
+    # module-scoped cluster can't coexist with slice_cluster's init).
+    # Must be the LAST tests in this module: the extra slice changes
+    # capacity assumptions of earlier pending-PG tests.
+    slice_cluster.add_slice("v2-8", num_hosts=2, chips_per_host=4)
+    return slice_cluster
+
+
+def test_train_multislice_places_gang_per_slice(multislice_cluster,
+                                                tmp_path):
+    """ScalingConfig(num_slices=2, topology=...) creates one atomic gang
+    PER SLICE (VERDICT r4 item 2); workers learn their slice_rank and
+    each slice's gang lands on a distinct slice instance."""
+    import json as json_mod
+
+    from ray_tpu import train
+
+    info_dir = tmp_path / "worker_info"
+    info_dir.mkdir()
+
+    def loop(config):
+        import json
+        import os
+
+        ctx = train.get_context()
+        rank = ctx.get_world_rank()
+        # per-worker invariants checked IN the worker (only rank 0's
+        # reports surface in metrics_history)
+        assert ctx.get_world_size() == 4
+        assert ctx.get_num_slices() == 2
+        assert ctx.get_slice_rank() == rank // 2
+        with open(os.path.join(config["info_dir"], f"{rank}.json"),
+                  "w") as f:
+            json.dump({
+                "rank": rank,
+                "slice_rank": ctx.get_slice_rank(),
+                "chips": os.environ.get("TPU_VISIBLE_CHIPS", ""),
+                "host": os.environ.get("RAY_TPU_NODE_ID", ""),
+            }, f)
+        train.report({"rank": rank})
+
+    trainer = train.DataParallelTrainer(
+        loop,
+        train_loop_config={"info_dir": str(info_dir)},
+        scaling_config=ScalingConfig(
+            num_workers=4, num_slices=2, topology="v2-8",
+            resources_per_worker={"CPU": 1.0, "TPU": 4.0}),
+        run_config=RunConfig(storage_path=str(tmp_path),
+                             name="multislice"),
+    )
+    result = trainer.fit()
+    assert result.error is None
+    infos = {}
+    for f in info_dir.iterdir():
+        rec = json_mod.loads(f.read_text())
+        infos[rec["rank"]] = rec
+    assert set(infos) == {0, 1, 2, 3}
+    # contiguous rank ranges per slice
+    assert infos[0]["slice_rank"] == infos[1]["slice_rank"] == 0
+    assert infos[2]["slice_rank"] == infos[3]["slice_rank"] == 1
+    # each worker holds a full host's chips
+    assert all(len(m["chips"].split(",")) == 4 for m in infos.values())
+    # the two gangs landed on 4 DISTINCT hosts (2 per slice)
+    assert len({m["host"] for m in infos.values()}) == 4
