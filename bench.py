@@ -187,9 +187,8 @@ def bench_llama_tokens_per_sec(steps: int = 20):
 
     import jax
 
-    from ray_tpu.models.gpt import cross_entropy_loss
     from ray_tpu.models.llama import Llama, LlamaConfig, flops_per_token
-    from ray_tpu.ops import flash_attention
+    from ray_tpu.ops import flash_attention, fused_cross_entropy
 
     dev = jax.devices()[0]
     if dev.platform != "tpu":
@@ -198,8 +197,11 @@ def bench_llama_tokens_per_sec(steps: int = 20):
     batch, seq = 16, 1024
     model = Llama(cfg, attention_fn=partial(flash_attention, causal=True))
 
+    # same hot path as the GPT-2 bench: fused LM-head CE (bf16 hidden x
+    # tied embedding, logits never hit HBM)
     def loss_fn(model, p, inputs, targets):
-        return cross_entropy_loss(model.apply(p, inputs), targets)
+        hidden, wte = model.apply(p, inputs, return_hidden=True)
+        return fused_cross_entropy(hidden, wte, targets)
 
     tokens_per_sec, _ = _bench_train(
         model, loss_fn, cfg.vocab_size, batch, seq, steps)

@@ -18,6 +18,7 @@ pool (threaded actors), or a dedicated actor event loop (async actors).
 from __future__ import annotations
 
 import asyncio
+import contextvars
 import hashlib
 import itertools
 import logging
@@ -56,6 +57,15 @@ class RayTaskError(Exception):
     def __init__(self, message: str, cause: Exception | None = None):
         super().__init__(message)
         self.cause = cause
+
+
+# The task id executing on THIS thread/coroutine. A ContextVar is the
+# one mechanism correct for BOTH executor shapes: pool threads each see
+# their own context, and every asyncio task gets a copied context — so
+# concurrent async actor tasks attribute their children correctly where
+# a shared instance attribute could not (recursive-cancel bookkeeping).
+_executing_task_id: contextvars.ContextVar = contextvars.ContextVar(
+    "ray_tpu_executing_task_id", default=None)
 
 
 class TaskCancelledError(RayTaskError):
@@ -1119,10 +1129,13 @@ class CoreWorker:
         )
         if self.mode == "worker":
             # recursive-cancel bookkeeping: this spec is a child of the
-            # task currently executing on this worker (best-effort for
-            # concurrent actors — current_task_id is per-worker)
-            self._task_children.setdefault(
-                self.current_task_id.binary(), []).append(spec.task_id)
+            # task executing on this thread/coroutine (context-local, so
+            # concurrent actor tasks attribute correctly); entries are
+            # popped when the parent finishes
+            parent = _executing_task_id.get()
+            if parent is not None:
+                self._task_children.setdefault(parent, []).append(
+                    spec.task_id)
         if streaming:
             # plain dict insert; ordered before the task via the same
             # submit-buffer flush the enqueue rides on
@@ -2095,16 +2108,26 @@ class CoreWorker:
                 ctypes.pythonapi.PyThreadState_SetAsyncExc(
                     ctypes.c_ulong(tid),
                     ctypes.py_object(_TaskCancelledInterrupt))
+        child_cancels = []
         if recursive:
             # this worker OWNS the children the task submitted — cancel
             # them through its own submitter machinery
             for child in list(self._task_children.get(task_id, ())):
-                asyncio.ensure_future(
-                    self._cancel_task_async(child, force, recursive))
+                child_cancels.append(asyncio.ensure_future(
+                    self._cancel_task_async(child, force, recursive)))
         if force:
-            # reply first, then die: the owner maps the connection loss
-            # to TaskCancelledError via its cancelled set
-            self._loop.call_later(0.1, os._exit, 1)
+            # Reply first, then die: the owner maps the connection loss
+            # to TaskCancelledError via its cancelled set. The exit must
+            # WAIT for the child-cancel RPCs — this process is those
+            # children's owner; dying before the cancels reach their
+            # executors would orphan them running to completion.
+            async def _die():
+                if child_cancels:
+                    await asyncio.wait(child_cancels, timeout=5.0)
+                await asyncio.sleep(0.1)  # let the reply frame flush
+                os._exit(1)
+
+            asyncio.ensure_future(_die())
         return {"ok": True}
 
     # ------------------------------------------------------------------
@@ -2246,9 +2269,13 @@ class CoreWorker:
                 # target already finished): the loop must survive it,
                 # and the in-hand item's reply futures must still
                 # resolve — a dropped item would strand its owner's
-                # get() forever.
-                if item is not None:
-                    self._resolve_lost_item(item)
+                # get() forever. item None means the interrupt consumed
+                # the shutdown sentinel (or beat the store of a popped
+                # item — vanishingly rare): exit rather than risk
+                # blocking on get() forever after a lost sentinel.
+                if item is None:
+                    break
+                self._resolve_lost_item(item)
                 continue
 
     def _resolve_lost_item(self, item) -> None:
@@ -2308,6 +2335,7 @@ class CoreWorker:
 
     async def _run_async_actor_task(self, spec, fut):
         self._running_async[spec.task_id] = asyncio.current_task()
+        _executing_task_id.set(spec.task_id)  # task-local context
         try:
             if spec.task_id in self._cancel_requested:
                 reply = self._package_cancelled(spec)
@@ -2327,6 +2355,7 @@ class CoreWorker:
             reply = self._package_cancelled(spec)
         finally:
             self._running_async.pop(spec.task_id, None)
+            self._task_children.pop(spec.task_id, None)
             self._cancel_requested.pop(spec.task_id, None)
         self._loop.call_soon_threadsafe(
             lambda: fut.done() or fut.set_result(reply)
@@ -2393,6 +2422,7 @@ class CoreWorker:
         prev_task = self.current_task_id
         self.current_task_id = TaskID(spec.task_id)
         self._running_threads[spec.task_id] = threading.get_ident()
+        ctx_token = _executing_task_id.set(spec.task_id)
         try:
             # All-inline args decode right here; only by-reference args
             # need the event loop's async resolution machinery (two
@@ -2468,6 +2498,7 @@ class CoreWorker:
             return self._package_error(spec, e)
         finally:
             self.current_task_id = prev_task
+            _executing_task_id.reset(ctx_token)
             self._running_threads.pop(spec.task_id, None)
             self._task_children.pop(spec.task_id, None)
             self._cancel_requested.pop(spec.task_id, None)

@@ -165,7 +165,8 @@ class Llama(nn.Module):
     attention_fn: Optional[Callable] = None
 
     @nn.compact
-    def __call__(self, tokens, deterministic: bool = True):
+    def __call__(self, tokens, deterministic: bool = True,
+                 return_hidden: bool = False):
         cfg = self.config
         wte = self.param(
             "wte",
@@ -185,6 +186,10 @@ class Llama(nn.Module):
 
         x = RMSNorm(cfg.norm_eps, cfg.dtype, cfg.param_dtype,
                     name="final_norm")(x)
+        if return_hidden:
+            # for ops.fused_cross_entropy: the [B, T, vocab] logits are
+            # never materialized in HBM (same hook as GPT.return_hidden)
+            return x, wte.astype(cfg.dtype)
         # tied LM head
         return jnp.einsum("btd,vd->btv", x, wte.astype(cfg.dtype))
 
