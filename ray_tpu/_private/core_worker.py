@@ -285,7 +285,13 @@ class CoreWorker:
         self.node_id_hex = node_id_hex
         self.store = store
         self.tpu_chips = tpu_chips
-        self.current_task_id = TaskID.for_driver(self.job_id)
+        # Per-PROCESS random base task id, NOT a job-deterministic one:
+        # submissions from non-task threads (driver main thread, worker
+        # background threads like Data's split coordinator) use this as
+        # the parent. A shared deterministic base would give two
+        # processes identical (parent, counter) pairs — colliding task
+        # and return-object ids that alias stale values across owners.
+        self.current_task_id = TaskID.from_random()
         self.current_actor_id: Optional[ActorID] = None
 
         self._put_counter = itertools.count(1)
@@ -2473,8 +2479,16 @@ class CoreWorker:
                     )
                 return {"returns": []}
             elif spec.task_type == task_mod.ACTOR_TASK:
-                method = getattr(self._actor_instance, spec.method_name)
-                result = method(*args, **kwargs)
+                if spec.method_name == "__ray_tpu_channel_loop__":
+                    # compiled-DAG channel stage (reference: the aDAG
+                    # executor loop, compiled_dag_node.py): starts a
+                    # daemon thread pumping in-channel -> method ->
+                    # out-channel, so the actor stays callable
+                    result = self._start_channel_loop(*args, **kwargs)
+                else:
+                    method = getattr(self._actor_instance,
+                                     spec.method_name)
+                    result = method(*args, **kwargs)
                 if asyncio.iscoroutine(result):
                     # Sync path got a coroutine (async method, concurrency 1
                     # without dedicated loop): run it to completion here.
@@ -2502,6 +2516,58 @@ class CoreWorker:
             self._running_threads.pop(spec.task_id, None)
             self._task_children.pop(spec.task_id, None)
             self._cancel_requested.pop(spec.task_id, None)
+
+    def _start_channel_loop(self, in_name: str, out_name: str,
+                            method_name: str) -> str:
+        """Compiled-DAG stage executor (reference: the per-actor loop a
+        compiled graph installs, `compiled_dag_node.py`; channel design
+        `experimental_mutable_object_manager.h:37`): attach the stage's
+        in/out shm channels NOW (so a wrong-node placement fails the
+        compile call loudly), then pump them on a daemon thread. Values
+        travel as ("ok", value) / ("err", message) — an upstream error
+        flows through untouched so the driver sees the original."""
+        import pickle
+
+        from ray_tpu.experimental.channel import (ChannelClosedError,
+                                                  ShmChannel)
+
+        in_ch = ShmChannel.attach(in_name)
+        out_ch = ShmChannel.attach(out_name)
+        method = getattr(self._actor_instance, method_name)
+
+        def loop():
+            try:
+                while True:
+                    tag, value = pickle.loads(in_ch.read())
+                    if tag == "err":
+                        out_ch.write(pickle.dumps((tag, value)))
+                        continue
+                    try:
+                        result = method(value)
+                        payload = pickle.dumps(("ok", result))
+                    except Exception as e:  # noqa: BLE001 — to driver
+                        payload = pickle.dumps(
+                            ("err",
+                             f"{method_name} failed: "
+                             f"{traceback.format_exc()}\n{e!r}"))
+                    try:
+                        out_ch.write(payload)
+                    except ValueError as e:
+                        # oversize result: the pump must survive and the
+                        # driver must see the cause (the tiny error
+                        # frame always fits)
+                        out_ch.write(pickle.dumps(
+                            ("err", f"{method_name} result does not fit "
+                                    f"the channel: {e}")))
+            except ChannelClosedError:
+                pass
+            finally:
+                in_ch.close()
+                out_ch.close()
+
+        threading.Thread(target=loop, daemon=True,
+                         name=f"dag-{method_name}").start()
+        return "started"
 
     @staticmethod
     def _has_async_methods(cls) -> bool:

@@ -19,6 +19,7 @@ import logging
 import os
 import time
 from collections import OrderedDict
+from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional
 
 from ray_tpu._private import task as task_mod
@@ -44,7 +45,8 @@ DEAD = "DEAD"
 class GcsServer:
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  config: Config | None = None,
-                 persist_path: str | None = None):
+                 persist_path: str | None = None,
+                 store_path: str | None = None):
         self.config = config or Config.from_env()
         self.server = RpcServer(host, port)
         self.clients = ClientPool()
@@ -71,8 +73,26 @@ class GcsServer:
         # heartbeat reregister handshake, clients reconnect through
         # their ReconnectingClient handles.
         self.persist_path = persist_path
-        if persist_path:
+        # Pluggable write-through StoreClient (reference:
+        # RedisStoreClient, src/ray/gcs/store_client/
+        # redis_store_client.h): every table MUTATION is durable at
+        # write time — a GCS killed between snapshot intervals restarts
+        # with current tables, not the last snapshot's.
+        from ray_tpu._private.store_client import make_store_client
+
+        self.store = make_store_client(store_path)
+        self._store_pool = (ThreadPoolExecutor(1, "gcs-store")
+                            if self.store else None)
+        if self.store is not None and self.store.tables():
+            self._load_from_store()
+        elif persist_path:
             self._load_snapshot()
+            if self.store is not None:
+                # migration: snapshot-restored tables must reach the
+                # store NOW — the next restart takes the (then
+                # non-empty) store as authoritative, and anything left
+                # only in the snapshot would silently vanish
+                self._dump_all_to_store()
 
     _SNAPSHOT_TABLES = ("kv", "jobs", "actors", "named_actors",
                         "placement_groups", "subscribers", "task_events")
@@ -91,6 +111,25 @@ class GcsServer:
         for name in self._SNAPSHOT_TABLES:
             if name in data:
                 setattr(self, name, data[name])
+        self._resume_pending("snapshot")
+
+    def _load_from_store(self):
+        """Rebuild tables from the write-through StoreClient — the
+        authoritative copy (fresher than any snapshot: it has every
+        mutation up to the instant of death)."""
+        self.actors = self.store.get_all("actors")
+        self.placement_groups = self.store.get_all("placement_groups")
+        self.jobs = self.store.get_all("jobs")
+        self.named_actors = {
+            k.decode(): v
+            for k, v in self.store.get_all("named_actors").items()}
+        self.kv = {}
+        for table in self.store.tables():
+            if table.startswith("kv:"):
+                self.kv[table[3:]] = self.store.get_all(table)
+        self._resume_pending("store")
+
+    def _resume_pending(self, source: str):
         # resume interrupted placements: anything not terminal goes back
         # on the pending queues
         for actor_id, info in self.actors.items():
@@ -100,9 +139,46 @@ class GcsServer:
             if pg["state"] == "PENDING":
                 self._pending_pgs.append(pg_id)
         logger.info(
-            "restored GCS state: %d actors, %d PGs, %d jobs, %d kv ns",
-            len(self.actors), len(self.placement_groups),
-            len(self.jobs), len(self.kv))
+            "restored GCS state from %s: %d actors, %d PGs, %d jobs, "
+            "%d kv ns", source, len(self.actors),
+            len(self.placement_groups), len(self.jobs), len(self.kv))
+
+    def _dump_all_to_store(self):
+        for actor_id, rec in self.actors.items():
+            self.store.put("actors", actor_id, rec)
+        for pg_id, rec in self.placement_groups.items():
+            self.store.put("placement_groups", pg_id, rec)
+        for job_id, rec in self.jobs.items():
+            self.store.put("jobs", job_id, rec)
+        for name, actor_id in self.named_actors.items():
+            self.store.put("named_actors", name.encode(), actor_id)
+        for ns, table in self.kv.items():
+            for k, v in table.items():
+                self.store.put(f"kv:{ns}", k, v)
+
+    # -- write-through persistence (StoreClient seam) -------------------
+
+    def _persist(self, table: str, key: bytes, record) -> None:
+        """Serialize on the loop thread (consistent view of the record),
+        write on the dedicated store thread (ordered per key — a single
+        writer thread keeps mutation order)."""
+        if self.store is None:
+            return
+        import pickle
+
+        blob = pickle.dumps(record)
+        self._store_pool.submit(self._store_put, table, key, blob)
+
+    def _store_put(self, table, key, blob):
+        try:
+            self.store.put_blob(table, key, blob)
+        except Exception:  # noqa: BLE001 — durability is best-effort
+            logger.exception("store write failed: %s/%s", table, key.hex())
+
+    def _unpersist(self, table: str, key: bytes) -> None:
+        if self.store is None:
+            return
+        self._store_pool.submit(self.store.delete, table, key)
 
     def _write_snapshot(self):
         self._write_snapshot_bytes(self._serialize_snapshot())
@@ -425,11 +501,13 @@ class GcsServer:
     # ------------------------------------------------------------------
 
     async def rpc_kv_put(self, req):
-        ns = self.kv.setdefault(req.get("ns", ""), {})
+        ns_name = req.get("ns", "")
+        ns = self.kv.setdefault(ns_name, {})
         key = req["key"]
         if not req.get("overwrite", True) and key in ns:
             return {"added": False}
         ns[key] = req["value"]
+        self._persist(f"kv:{ns_name}", key, req["value"])
         return {"added": True}
 
     async def rpc_kv_get(self, req):
@@ -437,7 +515,10 @@ class GcsServer:
         return {"value": value}
 
     async def rpc_kv_del(self, req):
-        existed = self.kv.get(req.get("ns", ""), {}).pop(req["key"], None)
+        ns_name = req.get("ns", "")
+        existed = self.kv.get(ns_name, {}).pop(req["key"], None)
+        if existed is not None:
+            self._unpersist(f"kv:{ns_name}", req["key"])
         return {"deleted": existed is not None}
 
     async def rpc_kv_keys(self, req):
@@ -460,6 +541,7 @@ class GcsServer:
             "start_time": time.time(),
             "finished": False,
         }
+        self._persist("jobs", job_id, self.jobs[job_id])
         await self.publish("jobs", {"event": "started", "job_id": job_id})
         return {"ok": True}
 
@@ -469,6 +551,7 @@ class GcsServer:
         if job:
             job["finished"] = True
             job["end_time"] = time.time()
+            self._persist("jobs", job_id, job)
         # Tear down the job's non-detached actors.
         for actor_id, info in list(self.actors.items()):
             if info["job_id"] == job_id and not info.get("detached") \
@@ -494,6 +577,8 @@ class GcsServer:
                     return {"ok": False,
                             "error": f"actor name taken: {spec.actor_name}"}
             self.named_actors[spec.actor_name] = actor_id
+            self._persist("named_actors", spec.actor_name.encode(),
+                          actor_id)
         self.actors[actor_id] = {
             "actor_id": actor_id,
             "job_id": spec.job_id,
@@ -508,6 +593,7 @@ class GcsServer:
             "death_cause": None,
             "class_name": spec.name,
         }
+        self._persist("actors", actor_id, self.actors[actor_id])
         self._pending_actors.append(actor_id)
         self._retry_wakeup.set()
         return {"ok": True}
@@ -592,6 +678,9 @@ class GcsServer:
 
     async def _publish_actor(self, actor_id: bytes):
         info = self.actors[actor_id]
+        # every actor state transition flows through here — the one
+        # write-through hook actor durability needs
+        self._persist("actors", actor_id, info)
         await self.publish("actors", {
             "actor_id": actor_id,
             "state": info["state"],
@@ -727,6 +816,8 @@ class GcsServer:
             # one-per-host onto a single complete slice, atomically
             "topology": req.get("topology"),
         }
+        self._persist("placement_groups", pg_id,
+                      self.placement_groups[pg_id])
         self._pending_pgs.append(pg_id)
         self._retry_wakeup.set()
         return {"ok": True}
@@ -775,6 +866,7 @@ class GcsServer:
                               {"pg_id": pg_id, "bundle_index": index})
         pg["state"] = "CREATED"
         pg["bundle_nodes"] = [n.node_id for n in placement]
+        self._persist("placement_groups", pg_id, pg)
         await self.publish("placement_groups", {
             "pg_id": pg_id, "state": "CREATED",
             "bundle_nodes": pg["bundle_nodes"],
@@ -803,6 +895,7 @@ class GcsServer:
                 except (ConnectionLost, RpcError, OSError):
                     pass
         pg["state"] = "REMOVED"
+        self._persist("placement_groups", pg["pg_id"], pg)
         await self.publish("placement_groups",
                            {"pg_id": pg["pg_id"], "state": "REMOVED"})
         return {"ok": True}
@@ -843,11 +936,13 @@ class GcsServer:
 
 
 async def main(host: str, port: int, metrics_port=None,
-               daemonize: bool = False, persist_path=None):
+               daemonize: bool = False, persist_path=None,
+               store_path=None):
     import os
     import signal
 
-    server = GcsServer(host, port, persist_path=persist_path)
+    server = GcsServer(host, port, persist_path=persist_path,
+                       store_path=store_path)
     await server.start(metrics_port=metrics_port)
     print(f"GCS_READY {server.address}", flush=True)
     stop = asyncio.Event()
@@ -877,6 +972,10 @@ if __name__ == "__main__":
     parser.add_argument("--metrics-port", type=int, default=None)
     parser.add_argument("--persist-path", default=None,
                         help="snapshot file for GCS fault tolerance")
+    parser.add_argument("--store-path", default=None,
+                        help="write-through StoreClient dir (file-per-"
+                             "key Redis-role backend; fresher than "
+                             "snapshots)")
     parser.add_argument("--log-file", default=None)
     parser.add_argument("--daemonize", action="store_true",
                         help="survive the launching process (CLI mode)")
@@ -885,4 +984,5 @@ if __name__ == "__main__":
         logging.basicConfig(filename=args.log_file, level=logging.INFO)
     asyncio.run(main(args.host, args.port, args.metrics_port,
                      daemonize=args.daemonize,
-                     persist_path=args.persist_path))
+                     persist_path=args.persist_path,
+                     store_path=args.store_path))

@@ -99,12 +99,16 @@ class Cluster:
         object_store_memory: int | None = None,
         session_dir: str | None = None,
         gcs_persistence: bool = False,
+        gcs_store: bool = False,
     ):
         ts = int(time.time() * 1000)
         self.session_dir = session_dir or f"/tmp/ray_tpu/session_{ts}_{os.getpid()}"
         os.makedirs(os.path.join(self.session_dir, "logs"), exist_ok=True)
         self.object_store_memory = object_store_memory
         self.gcs_persistence = gcs_persistence
+        # write-through external store (Redis-role FileStoreClient):
+        # durability per mutation, no snapshot-interval freshness window
+        self.gcs_store = gcs_store
         self.gcs: Optional[ProcessHandle] = None
         self.nodes: List[NodeHandle] = []
         self._start_gcs()
@@ -121,15 +125,20 @@ class Cluster:
         if self.gcs_persistence:
             args += ["--persist-path",
                      os.path.join(self.session_dir, "gcs_state.pkl")]
+        if self.gcs_store:
+            args += ["--store-path",
+                     os.path.join(self.session_dir, "gcs_store")]
         self.gcs = _spawn(args, self._log("gcs.out"), "GCS_READY")
         self.gcs_addr = self.gcs.ready_line.split()[1]
 
     def restart_gcs(self):
         """Kill and respawn the GCS on the same address (fault-tolerance
-        testing; requires gcs_persistence so tables survive — reference:
-        test_gcs_fault_tolerance.py's restart_gcs_server)."""
-        if not self.gcs_persistence:
-            raise RuntimeError("restart_gcs requires gcs_persistence")
+        testing; requires gcs_persistence or gcs_store so tables
+        survive — reference: test_gcs_fault_tolerance.py's
+        restart_gcs_server)."""
+        if not (self.gcs_persistence or self.gcs_store):
+            raise RuntimeError(
+                "restart_gcs requires gcs_persistence or gcs_store")
         port = int(self.gcs_addr.rsplit(":", 1)[1])
         self.gcs.terminate()
         self._start_gcs(port=port)

@@ -1,0 +1,125 @@
+"""Pluggable GCS table storage (reference: `StoreClient`
+`src/ray/gcs/store_client/store_client.h` with its InMemory and Redis
+implementations, `{in_memory,redis}_store_client.h`).
+
+The GCS keeps its working set in process memory; a StoreClient is the
+DURABILITY backend written through at every table mutation — unlike the
+periodic snapshot, a mutation is on disk before anything observes its
+effects, so a GCS killed at any instant restarts with current tables.
+
+`FileStoreClient` plays the Redis role with zero dependencies: one
+directory per table, one file per key, atomic-rename writes. The
+interface is the seam where an actual Redis/etcd client would slot in
+(zero-egress environments get the file backend).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, Dict, Optional
+
+
+class StoreClient:
+    """Key/value-per-table durability backend."""
+
+    def put(self, table: str, key: bytes, value: Any) -> None:
+        self.put_blob(table, key, pickle.dumps(value))
+
+    def put_blob(self, table: str, key: bytes, blob: bytes) -> None:
+        """Store an already-pickled value (the GCS serializes on its
+        event loop for a consistent view, then hands the blob to a
+        writer thread)."""
+        raise NotImplementedError
+
+    def delete(self, table: str, key: bytes) -> None:
+        raise NotImplementedError
+
+    def get_all(self, table: str) -> Dict[bytes, Any]:
+        raise NotImplementedError
+
+    def tables(self) -> list:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class InMemoryStoreClient(StoreClient):
+    """No durability — the default when no store is configured (kept for
+    interface parity with the reference's InMemoryStoreClient)."""
+
+    def __init__(self):
+        self._tables: Dict[str, Dict[bytes, Any]] = {}
+
+    def put_blob(self, table, key, blob):
+        self._tables.setdefault(table, {})[key] = pickle.loads(blob)
+
+    def delete(self, table, key):
+        self._tables.get(table, {}).pop(key, None)
+
+    def get_all(self, table):
+        return dict(self._tables.get(table, {}))
+
+    def tables(self):
+        return list(self._tables)
+
+
+class FileStoreClient(StoreClient):
+    """File-per-key store: `root/<table>/<key hex>` holding the pickled
+    value. Writes go through a temp file + `os.replace`, so a reader (or
+    a restarting GCS) never sees a torn record."""
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _table_dir(self, table: str) -> str:
+        # table names are framework-controlled identifiers; keep them
+        # path-safe anyway
+        return os.path.join(self.root, table.replace("/", "_"))
+
+    def put_blob(self, table, key, blob):
+        d = self._table_dir(table)
+        os.makedirs(d, exist_ok=True)
+        path = os.path.join(d, key.hex())
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+        os.replace(tmp, path)
+
+    def delete(self, table, key):
+        try:
+            os.unlink(os.path.join(self._table_dir(table), key.hex()))
+        except FileNotFoundError:
+            pass
+
+    def get_all(self, table):
+        d = self._table_dir(table)
+        out: Dict[bytes, Any] = {}
+        try:
+            names = os.listdir(d)
+        except FileNotFoundError:
+            return out
+        for name in names:
+            if ".tmp." in name:
+                continue
+            try:
+                with open(os.path.join(d, name), "rb") as f:
+                    out[bytes.fromhex(name)] = pickle.load(f)
+            except (OSError, ValueError, pickle.PickleError):
+                continue  # torn leftover; atomic writes make this rare
+        return out
+
+    def tables(self):
+        try:
+            return [n for n in os.listdir(self.root)
+                    if os.path.isdir(os.path.join(self.root, n))]
+        except FileNotFoundError:
+            return []
+
+
+def make_store_client(path: Optional[str]) -> Optional[StoreClient]:
+    """Factory for the GCS: a path selects the file backend; None means
+    no external store (snapshot-only persistence, if configured)."""
+    return FileStoreClient(path) if path else None

@@ -75,15 +75,24 @@ def bind(remote_fn, *args, **kwargs) -> DAGNode:
 
 
 class CompiledDAG:
-    """Repeat-execution form. For graphs whose nodes are jax-pure
-    callables the whole DAG compiles into one jitted function with
-    donated buffers (the TPU replacement for channel-based aDAGs);
-    otherwise it falls back to cached lazy execution, which still avoids
-    graph reconstruction per call."""
+    """Repeat-execution form, lowered by graph shape:
+
+    - a linear chain of pure-JAX stages fuses into ONE jitted function
+      with donated buffers (the TPU path: XLA owns the inter-stage
+      transfers over ICI);
+    - a linear chain of ACTOR METHOD calls lowers onto pre-allocated
+      shared-memory channels between the actor processes (reference
+      aDAG: `experimental_mutable_object_manager.h:37`,
+      `python/ray/experimental/channel/shared_memory_channel.py`) —
+      each execute() writes the input buffer and reads the output
+      buffer, with NO per-call task submission;
+    - anything else falls back to cached lazy execution.
+    """
 
     def __init__(self, dag: DAGNode):
         self._dag = dag
         self._jitted = None
+        self._channels = None
         jax_fns = self._extract_pure_jax_chain(dag)
         if jax_fns is not None:
             import jax
@@ -96,6 +105,73 @@ class CompiledDAG:
             # donate the input: intermediates stay on device, XLA owns
             # the buffers end to end
             self._jitted = jax.jit(fused, donate_argnums=(0,))
+            return
+        actor_chain = self._extract_actor_chain(dag)
+        if actor_chain is not None:
+            self._setup_channels(actor_chain)
+
+    @staticmethod
+    def _extract_actor_chain(dag: DAGNode):
+        """A linear chain of single-arg actor-method calls rooted at an
+        InputNode -> [(handle, method_name), ...] upstream-first."""
+        from ray_tpu._private.worker_api import ActorMethod
+
+        chain = []
+        node: Any = dag
+        while isinstance(node, DAGNode):
+            m = node._fn
+            if not isinstance(m, ActorMethod) or node._kwargs \
+                    or len(node._args) != 1:
+                return None
+            chain.append((m._handle, m._name))
+            node = node._args[0]
+        if not isinstance(node, InputNode) or not chain:
+            return None
+        chain.reverse()
+        return chain
+
+    def _setup_channels(self, chain, capacity: int = 8 << 20):
+        """Allocate n+1 shm channels (driver->s0->s1->...->driver) and
+        install the pump loop on every actor. The install call attaches
+        the channels inside each actor — an actor on another node fails
+        here, loudly, at compile time (shm channels are same-node; the
+        cross-node story is the jitted path where ICI moves arrays)."""
+        import ray_tpu
+        from ray_tpu._private.worker_api import ActorMethod
+        from ray_tpu.experimental.channel import ShmChannel
+
+        names = [ShmChannel.make_name(i) for i in range(len(chain) + 1)]
+        self._channels = [ShmChannel.create(n, capacity) for n in names]
+        acks = [
+            ActorMethod(handle, "__ray_tpu_channel_loop__").remote(
+                names[i], names[i + 1], method_name)
+            for i, (handle, method_name) in enumerate(chain)
+        ]
+        try:
+            got = ray_tpu.get(acks, timeout=60)
+            if got != ["started"] * len(chain):
+                raise RuntimeError(
+                    f"channel-loop install returned {got!r}")
+        except Exception:
+            self.teardown()
+            raise
+
+    def teardown(self):
+        """Shut the channels down; stage threads exit at their next
+        read/write and the shm segments are unlinked."""
+        if self._channels:
+            for ch in self._channels:
+                ch.signal_shutdown()
+            for ch in self._channels:
+                ch.destroy()
+                ch.close()
+            self._channels = None
+
+    def __del__(self):
+        try:
+            self.teardown()
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass
 
     @staticmethod
     def _extract_pure_jax_chain(dag: DAGNode) -> Optional[List]:
@@ -117,6 +193,17 @@ class CompiledDAG:
     def execute(self, *root_args):
         if self._jitted is not None:
             return self._jitted(*root_args)
+        if self._channels is not None:
+            import pickle
+
+            self._channels[0].write(
+                pickle.dumps(("ok", root_args[0])), timeout=60.0)
+            tag, value = pickle.loads(
+                self._channels[-1].read(timeout=60.0))
+            if tag == "err":
+                raise ray_tpu.RayTaskError(
+                    f"compiled DAG stage failed:\n{value}")
+            return value
         return ray_tpu.get(self._dag.execute(*root_args))
 
 

@@ -280,9 +280,23 @@ class Dataset:
         return out
 
     def streaming_split(self, n: int) -> List[DataIterator]:
-        """Per-train-worker iterators (reference
-        `StreamSplitDataIterator`, `stream_split_iterator.py:32`)."""
-        return [DataIterator(ds._execute()) for ds in self.split(n)]
+        """Per-train-worker iterators over ONE shared streaming
+        execution (reference `StreamSplitDataIterator`,
+        `stream_split_iterator.py:32`): a coordinator actor runs the
+        plan in the background and hands each completed block to
+        whichever consumer asks first — dynamically balanced (a slow
+        worker gets fewer blocks), with first-block latency set by the
+        first task, not the whole pipeline."""
+        from ray_tpu.data.iterator import (_SplitCoordinator,
+                                           StreamSplitDataIterator)
+
+        if self._materialized is not None:
+            op = L.InputBlocks(self._materialized)
+        else:
+            op = self._op
+        coord_cls = ray_tpu.remote(_SplitCoordinator)
+        coord = coord_cls.options(num_cpus=0).remote(op)
+        return [StreamSplitDataIterator(coord) for _ in range(n)]
 
     # -- writes ------------------------------------------------------------
 

@@ -47,6 +47,18 @@ def _expand_paths(paths) -> List[str]:
     return out
 
 
+class SimpleDatasource(Datasource):
+    """Wrap a list of zero-arg read callables, one per partition —
+    the minimal custom-source seam (reference: user Datasource
+    subclasses, `python/ray/data/datasource/datasource.py`)."""
+
+    def __init__(self, read_fns: List[ReadTask]):
+        self._read_fns = list(read_fns)
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        return list(self._read_fns)
+
+
 class RangeDatasource(Datasource):
     def __init__(self, n: int, tensor_shape: Optional[tuple] = None):
         self.n = n

@@ -12,7 +12,7 @@ the reference's push-based shuffle.
 
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
 
@@ -263,6 +263,35 @@ class StreamingExecutor:
                     in_flight.pop(r, None)
         return out
 
+    def _windowed_iter(self, fns) -> "Iterator[Any]":
+        """Generator flavor of _windowed: pull submit thunks LAZILY from
+        `fns` and yield each block ref as its task COMPLETES (completion
+        order). Lazy pull means backpressure propagates up a chain of
+        streaming stages; completion-order yield is what lets a split
+        coordinator hand finished blocks to whichever consumer is
+        hungriest (reference StreamingExecutor's pull-based loop,
+        `streaming_executor_state.py:165`)."""
+        budget = self._budget
+        cap = budget.task_cap()
+        fns = iter(fns)
+        in_flight: Dict[Any, Any] = {}  # wait_ref -> yield_ref
+        exhausted = False
+        while not exhausted or in_flight:
+            while not exhausted and len(in_flight) < cap:
+                if in_flight and budget.store_pressure():
+                    break
+                try:
+                    ref = next(fns)()
+                except StopIteration:
+                    exhausted = True
+                    break
+                in_flight[ref[0] if isinstance(ref, list) else ref] = ref
+            if in_flight:
+                ready, _ = ray_tpu.wait(list(in_flight), num_returns=1,
+                                        timeout=30.0)
+                for r in ready:
+                    yield in_flight.pop(r)
+
     # -- plan walk ---------------------------------------------------------
 
     def execute(self, op: L.LogicalOp) -> List[Any]:
@@ -270,10 +299,38 @@ class StreamingExecutor:
         op = L.optimize(op)
         return self._exec(op)
 
+    def execute_iter(self, op: L.LogicalOp) -> "Iterator[Any]":
+        """Streaming execution: yield output block refs as their tasks
+        complete, while upstream stages keep producing — first blocks
+        are consumable long before the pipeline finishes (the
+        train-ingest hot path; reference `stream_split_iterator.py:32`).
+        Barrier ops (shuffle/sort/groupby/...) and actor-pool stages
+        fall back to full materialization of their subtree."""
+        op = L.optimize(op)
+        yield from self._iter(op)
+
+    def _iter(self, op: L.LogicalOp) -> "Iterator[Any]":
+        if isinstance(op, L.Read) and op.limit_rows is None:
+            tasks = op.datasource.get_read_tasks(op.parallelism)
+            rf = self._remote.get(_run_read)
+            yield from self._windowed_iter(
+                (lambda t=t: rf.remote(t)) for t in tasks)
+        elif isinstance(op, L.AbstractMap) and op.compute is None:
+            transform = op.make_transform()
+            rf = self._remote.get(_run_transform)
+            upstream = self._iter(op.input_op)
+            yield from self._windowed_iter(
+                (lambda b=b, i=i: rf.remote(transform, b, i))
+                for i, b in enumerate(upstream))
+        else:
+            yield from self._exec(op)
+
     def _exec(self, op: L.LogicalOp) -> List[Any]:
         if isinstance(op, L.InputBlocks):
             return list(op.block_refs)
         if isinstance(op, L.Read):
+            if op.limit_rows is not None:
+                return self._exec_read_limited(op)
             tasks = op.datasource.get_read_tasks(op.parallelism)
             rf = self._remote.get(_run_read)
             return self._windowed([
@@ -451,6 +508,29 @@ class StreamingExecutor:
         return self._windowed([
             (lambda i=i: reduce_rf.remote(*extra_args(i), merged[i]))
             for i in range(p)])
+
+    def _exec_read_limited(self, op: L.Read) -> List[Any]:
+        """Limit-pushdown read (reference `set_read_parallelism` /
+        `limit_pushdown.py`): launch read tasks in small waves and STOP
+        once enough rows exist — a `.limit(n)` over a big datasource
+        must not fan out the whole read."""
+        tasks = op.datasource.get_read_tasks(op.parallelism)
+        rf = self._remote.get(_run_read)
+        rf_count = self._remote.get(_count_rows)
+        out: List[Any] = []
+        rows = 0
+        i = 0
+        window = max(1, min(4, self._budget.task_cap()))
+        in_flight: List[tuple] = []  # (block_ref, count_ref)
+        while rows < op.limit_rows and (i < len(tasks) or in_flight):
+            while i < len(tasks) and len(in_flight) < window:
+                b = rf.remote(tasks[i])
+                in_flight.append((b, rf_count.remote(b)))
+                i += 1
+            b, c = in_flight.pop(0)
+            rows += ray_tpu.get(c, timeout=300)
+            out.append(b)
+        return out
 
     def _exec_limit(self, op: L.Limit) -> List[Any]:
         inputs = self._exec(op.input_op)

@@ -20,9 +20,33 @@ class DataIterator:
     def __init__(self, block_refs: List[Any]):
         self._block_refs = block_refs
 
-    def _iter_blocks(self) -> Iterator[Block]:
-        for ref in self._block_refs:
-            yield ray_tpu.get(ref, timeout=600)
+    def _iter_blocks(self, prefetch: int = 0) -> Iterator[Block]:
+        if prefetch <= 0:
+            for ref in self._block_refs:
+                yield ray_tpu.get(ref, timeout=600)
+            return
+        # Resolve up to `prefetch` blocks AHEAD of the consumer: the
+        # fetch/deserialize of block i+1..i+P overlaps the caller's
+        # compute on block i, so step wall-time approaches
+        # max(fetch, compute) instead of their sum (reference
+        # `iterator.py:109` prefetch_batches).
+        from collections import deque
+
+        window: deque = deque()
+        refs = iter(self._block_refs)
+        try:
+            while True:
+                while len(window) <= prefetch:
+                    try:
+                        window.append(next(refs).future())
+                    except StopIteration:
+                        break
+                if not window:
+                    return
+                yield window.popleft().result(timeout=600)
+        finally:
+            for f in window:
+                f.cancel()
 
     def iter_batches(
         self,
@@ -30,6 +54,7 @@ class DataIterator:
         batch_size: int = 256,
         batch_format: str = "numpy",
         drop_last: bool = False,
+        prefetch_batches: int = 1,
         local_shuffle_buffer_size: Optional[int] = None,
         local_shuffle_seed: Optional[int] = None,
     ) -> Iterator[Any]:
@@ -49,7 +74,7 @@ class DataIterator:
             shuffle buffer (reference local_shuffle_buffer_size)."""
             buf: List[Block] = []
             buf_rows = 0
-            for block in self._iter_blocks():
+            for block in self._iter_blocks(prefetch=prefetch_batches):
                 if not block or not BlockAccessor(block).num_rows():
                     continue
                 if rng is None:
@@ -87,3 +112,117 @@ class DataIterator:
 
     def materialize_numpy(self) -> Block:
         return BlockAccessor.concat(list(self._iter_blocks()))
+
+
+class _SplitCoordinator:
+    """Actor owning one streaming execution of a dataset plan, feeding N
+    consumers (reference `stream_split_iterator.py:32`
+    SplitCoordinator). Blocks are handed out PULL-BASED: whichever
+    consumer asks first gets the next completed block, so a slow train
+    worker naturally receives fewer blocks while fast ones stay fed —
+    dynamic balancing with no static assignment. Execution runs in a
+    background thread pushing into a bounded queue, so the first blocks
+    are consumable while upstream stages still produce (and the bound
+    backpressures the pipeline against slow consumers)."""
+
+    def __init__(self, op):
+        import queue
+        import threading
+
+        from ray_tpu.data.executor import StreamingExecutor
+
+        self._q: "queue.Queue" = queue.Queue(maxsize=16)
+        self._error = None
+        self._stopped = threading.Event()
+
+        def run():
+            try:
+                for ref in StreamingExecutor().execute_iter(op):
+                    while not self._stopped.is_set():
+                        try:
+                            self._q.put(ref, timeout=0.5)
+                            break
+                        except queue.Full:
+                            continue
+                    if self._stopped.is_set():
+                        return
+            except BaseException as e:  # noqa: BLE001 — surfaced to consumers
+                self._error = e
+            finally:
+                self._q.put(None)
+
+        threading.Thread(target=run, daemon=True,
+                         name="split-coordinator").start()
+
+    def next_block(self):
+        """Next completed block ref, or None when the stream ends."""
+        item = self._q.get()
+        if item is None:
+            # poison-pill relay: wake every other blocked consumer
+            self._q.put(None)
+            if self._error is not None:
+                raise self._error
+            return None
+        return item
+
+    def stop(self):
+        """Abandon the stream: the producer thread exits at its next
+        put and remaining queued refs are dropped (consumers that
+        stopped iterating early must call this via the iterator's
+        `shutdown()` or the pipeline keeps producing into the queue)."""
+        import queue
+
+        self._stopped.set()
+        while True:
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                break
+        self._q.put(None)
+        return True
+
+
+class StreamSplitDataIterator(DataIterator):
+    """Per-train-worker view of a streaming split: pulls block refs from
+    the shared coordinator on demand. Picklable (carries only the actor
+    handle), so Train workers can consume a split created on the
+    driver."""
+
+    def __init__(self, coord):
+        super().__init__([])
+        self._coord = coord
+
+    def shutdown(self):
+        """Tear the SHARED coordinator down (all sibling split
+        iterators stop receiving). Call when abandoning consumption
+        early — e.g. between training epochs — so the coordinator's
+        pipeline and actor don't linger for the session."""
+        import ray_tpu
+
+        try:
+            ray_tpu.get(self._coord.stop.remote(), timeout=30)
+        except Exception:  # noqa: BLE001 — best-effort teardown
+            pass
+        try:
+            ray_tpu.kill(self._coord)
+        except Exception:  # noqa: BLE001
+            pass
+
+    def _iter_blocks(self, prefetch: int = 0) -> Iterator[Block]:
+        from collections import deque
+
+        # keep `prefetch`+1 next_block requests outstanding: the
+        # coordinator round-trip AND the block fetch overlap consumer
+        # compute
+        pending: deque = deque()
+        done = False
+        while True:
+            while not done and len(pending) <= max(0, prefetch):
+                pending.append(self._coord.next_block.remote())
+            if not pending:
+                return
+            ref = ray_tpu.get(pending.popleft(), timeout=600)
+            if ref is None:
+                done = True
+                continue
+            yield ray_tpu.get(ref, timeout=600)

@@ -61,6 +61,9 @@ class Read(LogicalOp):
         super().__init__(None)
         self.datasource = datasource
         self.parallelism = parallelism
+        # set by the limit-pushdown rule: the executor launches read
+        # tasks incrementally and stops once this many rows exist
+        self.limit_rows: Optional[int] = None
 
 
 class InputBlocks(LogicalOp):
@@ -320,17 +323,78 @@ class Zip(LogicalOp):
         self.right = right
 
 
+# map ops that preserve row count 1:1 — Limit commutes past them
+# (reference `rules/limit_pushdown.py`: only cardinality-preserving
+# one-to-one ops; Filter/FlatMap/MapBatches can change row counts)
+_CARDINALITY_PRESERVING = (MapRows, AddColumn, DropColumns, SelectColumns)
+
+
+def _push_limit(op: "Limit") -> LogicalOp:
+    """Limit pushdown (reference `rules/limit_pushdown.py`):
+    - Limit(Limit(x, m), n) -> Limit(x, min(m, n))
+    - Limit commutes below cardinality-preserving maps, so the map runs
+      on only the surviving rows
+    - Limit(Read) stays put but stamps `limit_rows` on the Read — the
+      executor then launches read tasks incrementally instead of the
+      whole wave (set_read_parallelism analogue)."""
+    changed = True
+    while changed:
+        changed = False
+        child = op.input_op
+        if isinstance(child, Limit):
+            op = Limit(child.input_op, min(op.n, child.n))
+            changed = True
+        elif (isinstance(child, _CARDINALITY_PRESERVING)
+                and child.compute is None):
+            inner = Limit(child.input_op, op.n)
+            child.input_op = _push_limit(inner)
+            return child
+    if isinstance(op.input_op, Read):
+        op.input_op.limit_rows = op.n
+    return op
+
+
+def clone_plan(op: LogicalOp) -> LogicalOp:
+    """Per-node shallow copy of a plan tree. Datasets SHARE op objects
+    (`Dataset._derive` wraps `self._op` without copying), so optimizer
+    rules that rewire `input_op` or stamp fields must work on a private
+    copy — mutating shared nodes would silently change the plans of
+    sibling datasets."""
+    import copy
+
+    if not isinstance(op, LogicalOp):
+        return op
+    new = copy.copy(op)
+    if isinstance(new, Union):
+        new.inputs = [clone_plan(i) for i in new.inputs]
+    elif isinstance(new, Zip):
+        new.left = clone_plan(new.left)
+        new.right = clone_plan(new.right)
+    elif new.input_op is not None:
+        new.input_op = clone_plan(new.input_op)
+    return new
+
+
 def optimize(op: LogicalOp) -> LogicalOp:
-    """Bottom-up fusion of AbstractMap chains (reference
-    `logical/rules/operator_fusion.py`)."""
+    """Bottom-up rules (reference `logical/rules/`): limit pushdown,
+    then fusion of AbstractMap chains (`operator_fusion.py`). Operates
+    on a private clone — the caller's plan is never mutated."""
+    return _optimize(clone_plan(op))
+
+
+def _optimize(op: LogicalOp) -> LogicalOp:
     if isinstance(op, Union):
-        op.inputs = [optimize(i) for i in op.inputs]
+        op.inputs = [_optimize(i) for i in op.inputs]
         return op
     if isinstance(op, Zip):
-        op.left, op.right = optimize(op.left), optimize(op.right)
+        op.left, op.right = _optimize(op.left), _optimize(op.right)
         return op
+    if isinstance(op, Limit):
+        op = _push_limit(op)
+        if not isinstance(op, Limit):
+            return _optimize(op)  # limit sank below a map: re-walk
     if op.input_op is not None:
-        op.input_op = optimize(op.input_op)
+        op.input_op = _optimize(op.input_op)
     if isinstance(op, AbstractMap) and isinstance(op.input_op, AbstractMap) \
             and op.compute is None and op.input_op.compute is None:
         # actor-compute stages never fuse: their UDF state lives in a

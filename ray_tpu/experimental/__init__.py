@@ -1,0 +1,6 @@
+"""Experimental APIs (reference: `python/ray/experimental/`)."""
+
+from ray_tpu.experimental.channel import (  # noqa: F401
+    ChannelClosedError,
+    ShmChannel,
+)

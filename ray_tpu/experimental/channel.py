@@ -1,0 +1,147 @@
+"""Mutable shared-memory channels between actor processes.
+
+Reference: the compiled-graph (aDAG) channel layer —
+`src/ray/core_worker/experimental_mutable_object_manager.h:37` and
+`python/ray/experimental/channel/shared_memory_channel.py`. A channel is
+a PRE-ALLOCATED single-writer/single-reader shm buffer reused across
+executions: writing a new value mutates the buffer in place and bumps a
+sequence number instead of creating an object + submitting a task, which
+is what makes a compiled DAG's steady-state latency land in microseconds
+instead of the task-submission path's hundreds.
+
+Synchronization is a seqlock-style pair of 8-byte counters (write_seq
+advanced only by the writer, read_seq only by the reader) polled with an
+adaptive spin->sleep backoff — no cross-process mutex, so a crashed peer
+can never leave the lock held. The payload store happens before the seq
+bump in program order; on x86-64's total-store-order memory model the
+reader observing the new seq therefore observes the payload. (A weakly-
+ordered ISA would need explicit fences here; TPU-VM hosts are x86-64.)
+
+Channels are same-node by construction (POSIX shm). The TPU-native
+analogue for device arrays is jit fusion with buffer donation — see
+ray_tpu/dag.py `jax_stage` — where XLA owns the transfers over ICI;
+these channels are the host-side control/data plane for actor graphs.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+import uuid
+from multiprocessing import shared_memory
+from typing import Optional
+
+_HEADER = 32  # write_seq | read_seq | length | flags — 4 x 8 bytes LE
+_FLAG_SHUTDOWN = 1
+
+
+class ChannelClosedError(RuntimeError):
+    """The channel was shut down by its owner (compiled DAG teardown)."""
+
+
+def _pause(spins: int) -> None:
+    if spins < 200:
+        time.sleep(0)  # yield the GIL/core, stay hot
+    else:
+        time.sleep(min(0.001, 2e-5 * (spins - 199)))
+
+
+class ShmChannel:
+    """Single-writer single-reader mutable buffer (capacity fixed at
+    creation). `write` blocks until the reader consumed the previous
+    value (depth-1 backpressure — the aDAG execution semantics: one
+    in-flight value per edge)."""
+
+    def __init__(self, shm: shared_memory.SharedMemory, owner: bool):
+        self._shm = shm
+        self._owner = owner
+        self._buf = shm.buf
+
+    # -- lifecycle ---------------------------------------------------------
+
+    @classmethod
+    def create(cls, name: str, capacity: int = 8 << 20) -> "ShmChannel":
+        shm = shared_memory.SharedMemory(name=name, create=True,
+                                         size=_HEADER + capacity)
+        shm.buf[:_HEADER] = b"\x00" * _HEADER
+        return cls(shm, owner=True)
+
+    @classmethod
+    def attach(cls, name: str) -> "ShmChannel":
+        return cls(shared_memory.SharedMemory(name=name), owner=False)
+
+    @staticmethod
+    def make_name(index: int) -> str:
+        return f"rtpu_ch_{os.getpid()}_{uuid.uuid4().hex[:12]}_{index}"
+
+    def close(self) -> None:
+        try:
+            self._buf = None
+            self._shm.close()
+        except (OSError, BufferError):
+            pass
+
+    def destroy(self) -> None:
+        """Owner side: signal shutdown, then unlink the segment."""
+        try:
+            self._set(3, _FLAG_SHUTDOWN)
+        except (TypeError, ValueError):
+            pass  # already closed
+        try:
+            self._shm.unlink()
+        except FileNotFoundError:
+            pass
+
+    # -- header ------------------------------------------------------------
+
+    def _get(self, slot: int) -> int:
+        return int.from_bytes(self._buf[slot * 8:(slot + 1) * 8], "little")
+
+    def _set(self, slot: int, value: int) -> None:
+        self._buf[slot * 8:(slot + 1) * 8] = value.to_bytes(8, "little")
+
+    @property
+    def capacity(self) -> int:
+        return len(self._buf) - _HEADER
+
+    def signal_shutdown(self) -> None:
+        self._set(3, self._get(3) | _FLAG_SHUTDOWN)
+
+    def _check_open(self) -> None:
+        if self._get(3) & _FLAG_SHUTDOWN:
+            raise ChannelClosedError("channel was shut down")
+
+    # -- data path ---------------------------------------------------------
+
+    def write(self, data: bytes, timeout: Optional[float] = None) -> None:
+        if len(data) > self.capacity:
+            raise ValueError(
+                f"value of {len(data)} bytes exceeds channel capacity "
+                f"{self.capacity}")
+        deadline = None if timeout is None else time.monotonic() + timeout
+        spins = 0
+        # depth-1 ring: previous value must be consumed first
+        while self._get(0) != self._get(1):
+            self._check_open()
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError("channel write timed out")
+            _pause(spins)
+            spins += 1
+        self._check_open()
+        self._buf[_HEADER:_HEADER + len(data)] = data
+        self._set(2, len(data))
+        self._set(0, self._get(0) + 1)  # publish AFTER the payload store
+
+    def read(self, timeout: Optional[float] = None) -> bytes:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        spins = 0
+        while self._get(0) == self._get(1):
+            self._check_open()
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError("channel read timed out")
+            _pause(spins)
+            spins += 1
+        n = self._get(2)
+        data = bytes(self._buf[_HEADER:_HEADER + n])
+        self._set(1, self._get(1) + 1)  # release the slot to the writer
+        return data

