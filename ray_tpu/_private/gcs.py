@@ -333,6 +333,15 @@ class GcsServer:
             f"node {node_id.hex()[:8]} joined",
             node_id=node_id.hex(), raylet_addr=req["raylet_addr"])
         await self.publish("nodes", {"event": "added", "node": self.nodes[node_id]})
+        # seed peer raylets' views immediately (see the resource-gossip
+        # delta push in rpc_heartbeat)
+        await self.publish("resources", {
+            "node_id": node_id,
+            "raylet_addr": req["raylet_addr"],
+            "total": req["total"],
+            "available": req["available"],
+            "labels": req.get("labels", {}),
+        })
         self._retry_wakeup.set()
         return {"ok": True}
 
@@ -359,6 +368,27 @@ class GcsServer:
         self.view.update_node(node_id, node["raylet_addr"], node["total"],
                               req["available"])
         self._last_heartbeat[node_id] = time.monotonic()
+        # Push-based resource gossip (reference: ray_syncer's streaming
+        # node-resource sync, src/ray/common/ray_syncer/ray_syncer.h:88
+        # — replacing the polled view): when a node's availability
+        # CHANGES, fan the delta out to subscribed raylets immediately,
+        # so spillback decisions ride fresh state instead of waiting out
+        # a heartbeat period. The heartbeat reply's full view remains
+        # the liveness-coupled fallback.
+        if node.get("_pub_avail") != req["available"]:
+            node["_pub_avail"] = dict(req["available"])
+            # AWAITED, not fire-and-forget: publishes must leave in
+            # handler order or a delayed availability delta could land
+            # after this node's death publish and resurrect it in peer
+            # views (subscriber-side application is synchronous, so
+            # arrival order is application order)
+            await self.publish("resources", {
+                "node_id": node_id,
+                "raylet_addr": node["raylet_addr"],
+                "total": node["total"],
+                "available": req["available"],
+                "labels": node.get("labels") or {},
+            })
         if req.get("idle_freed"):
             self._retry_wakeup.set()
         # Reply with the cluster resource view so raylets can spill back
@@ -491,6 +521,8 @@ class GcsServer:
             node_id=node_id.hex(), reason=reason)
         await self.publish("nodes", {"event": "removed", "node_id": node_id,
                                      "reason": reason})
+        await self.publish("resources", {"node_id": node_id,
+                                         "dead": True})
         # Fail over actors that lived on that node.
         for actor_id, info in list(self.actors.items()):
             if info.get("node_id") == node_id and info["state"] in (ALIVE, PENDING):

@@ -162,6 +162,13 @@ class Raylet:
         # of) an object per chunk
         self._transfer_handles: Dict[bytes, object] = {}
         self._freed_since_heartbeat = False
+        # wakes the heartbeat loop early when local resources free up —
+        # the raylet->GCS half of push-based resource gossip
+        self._heartbeat_nudge = asyncio.Event()
+        # node_id -> monotonic time of its last push-delivered view
+        # update (guards the heartbeat-reply prune against racing a
+        # just-registered node's seed publish)
+        self._view_push_ts: Dict[bytes, float] = {}
         self._actor_workers: Dict[bytes, bytes] = {}  # worker_id -> actor_id
         # Memory-monitor kill records: owners query these to turn a
         # generic "worker died" into an actionable OutOfMemoryError
@@ -210,8 +217,16 @@ class Raylet:
         })
         await self.gcs.call("subscribe",
                             {"channel": "jobs", "addr": self.server.address})
+        # push-based resource gossip: availability deltas arrive the
+        # moment another node's heartbeat reports a change (reference:
+        # ray_syncer.h:88 streaming sync), so spillback sees fresh state
+        # instead of a view up to one heartbeat period stale
+        await self.gcs.call("subscribe",
+                            {"channel": "resources",
+                             "addr": self.server.address})
         self.view.update_node(self.node_id.binary(), self.server.address,
                               self.total, self.available)
+        self._heartbeat_nudge.set()  # first heartbeat immediately
         self._bg = [
             asyncio.ensure_future(self._heartbeat_loop()),
             asyncio.ensure_future(self._reap_loop()),
@@ -252,7 +267,18 @@ class Raylet:
 
     async def _heartbeat_loop(self):
         while True:
-            await asyncio.sleep(self.config.raylet_heartbeat_period_s)
+            # timer tick OR an on-change nudge (resources freed): the
+            # nudge makes the raylet->GCS direction of the resource
+            # gossip push-based too — freed capacity reaches the GCS
+            # (and fans out to peer raylets) in milliseconds, not at
+            # the next heartbeat period
+            try:
+                await asyncio.wait_for(
+                    self._heartbeat_nudge.wait(),
+                    self.config.raylet_heartbeat_period_s)
+            except asyncio.TimeoutError:
+                pass
+            self._heartbeat_nudge.clear()
             try:
                 reply = await self.gcs.call("heartbeat", {
                     "node_id": self.node_id.binary(),
@@ -288,9 +314,18 @@ class Raylet:
                                           n["total"], n["available"],
                                           labels=n.get("labels"))
                 current = {n["node_id"] for n in reply.get("view", [])}
+                now = time.monotonic()
                 for node_id in list(self.view.nodes):
-                    if node_id not in current:
+                    # prune nodes the GCS no longer reports — EXCEPT
+                    # ones freshly seeded by a "resources" push, which
+                    # may have registered after this reply's view was
+                    # assembled (removing them would undo the push for
+                    # a whole heartbeat period)
+                    if node_id not in current and \
+                            now - self._view_push_ts.get(node_id, 0.0) \
+                            > 10.0:
                         self.view.remove_node(node_id)
+                        self._view_push_ts.pop(node_id, None)
                 self._respill_pending()
             except (ConnectionLost, RpcError, OSError, asyncio.TimeoutError):
                 pass
@@ -523,6 +558,21 @@ class Raylet:
                 if worker.job_id == job_id and worker.proc \
                         and worker.proc.returncode is None:
                     worker.proc.terminate()
+        elif msg["channel"] == "resources":
+            d = msg["data"]
+            if d.get("node_id") == self.node_id.binary():
+                return None  # our own state is authoritative locally
+            if d.get("dead"):
+                self.view.remove_node(d["node_id"])
+                self._view_push_ts.pop(d["node_id"], None)
+            else:
+                self.view.update_node(d["node_id"], d["raylet_addr"],
+                                      d["total"], d["available"],
+                                      labels=d.get("labels"))
+                self._view_push_ts[d["node_id"]] = time.monotonic()
+                # fresh capacity elsewhere: queued leases that could not
+                # place locally may spill NOW instead of next heartbeat
+                self._respill_pending()
         return None
 
     # ------------------------------------------------------------------
@@ -764,6 +814,7 @@ class Raylet:
             pool[k] = pool.get(k, 0.0) + v
         lease.acquired = False
         self._freed_since_heartbeat = True
+        self._heartbeat_nudge.set()
 
     def _find_idle_tpu_worker(self, job_id: bytes, n_chips: int,
                               env_hash: str = ""):
@@ -899,6 +950,7 @@ class Raylet:
             lease.resources = {k: v for k, v in lease.resources.items()
                                if k == "TPU"}
             self._freed_since_heartbeat = True
+            self._heartbeat_nudge.set()
         if not lease.reply_fut.done():
             lease.reply_fut.set_result({
                 "granted": True,
@@ -967,6 +1019,7 @@ class Raylet:
             for k, v in demand.items():
                 self.available[k] = self.available.get(k, 0.0) + v
             self._freed_since_heartbeat = True
+            self._heartbeat_nudge.set()
         self._dispatch()
         return {"ok": True}
 

@@ -1,0 +1,61 @@
+"""Push-based resource gossip staleness test (VERDICT r3 weak #9).
+
+Own module: it manages its own cluster + heartbeat-period env and must
+not share the multi-node module's session-scoped init.
+"""
+
+
+def test_resource_gossip_push_beats_heartbeat():
+    """VERDICT r3 weak #9: spillback decisions must not ride views up to
+    a heartbeat period stale. With the heartbeat timer cranked to 120s,
+    the ONLY way freed remote capacity can reach a peer raylet quickly
+    is the push path (freed -> nudged heartbeat -> GCS delta publish ->
+    peer view update -> respill). A queued task must land on the freed
+    node within the 75s bound, not at the next timer tick."""
+    import os
+    import time
+
+    import ray_tpu
+    from ray_tpu._private.node import Cluster
+
+    env_key = "RAY_TPU_RAYLET_HEARTBEAT_PERIOD_S"
+    old = os.environ.get(env_key)
+    # the margin between the assert bound below and this period is what
+    # discriminates push from timer — wide enough to stay meaningful
+    # under heavy CPU contention on a 1-core CI box
+    os.environ[env_key] = "120"
+    try:
+        cluster = Cluster(head_resources={"CPU": 1.0})
+        cluster.add_node({"CPU": 1.0})
+        ray_tpu.init(address=cluster.gcs_addr)
+        try:
+            @ray_tpu.remote
+            def busy(seconds):
+                d = time.monotonic() + seconds
+                while time.monotonic() < d:
+                    time.sleep(0.02)
+                return "done"
+
+            # occupy BOTH nodes: one long task locally, one spilled to
+            # the second node (its registration delta seeded the view)
+            long_ref = busy.remote(45)
+            short_ref = busy.remote(4)
+            time.sleep(1.0)
+            # third task: no capacity anywhere -> queues
+            start = time.monotonic()
+            queued_ref = busy.remote(0.1)
+            assert ray_tpu.get(queued_ref, timeout=60) == "done"
+            elapsed = time.monotonic() - start
+            # short task frees its node at ~4s; the queued task must
+            # follow the push path there LONG before the 120s heartbeat
+            assert elapsed < 75.0, f"gossip too stale: {elapsed:.1f}s"
+            assert ray_tpu.get(short_ref, timeout=60) == "done"
+            ray_tpu.cancel(long_ref, force=True)
+        finally:
+            ray_tpu.shutdown()
+            cluster.shutdown()
+    finally:
+        if old is None:
+            os.environ.pop(env_key, None)
+        else:
+            os.environ[env_key] = old
