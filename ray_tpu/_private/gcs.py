@@ -67,6 +67,9 @@ class GcsServer:
         self._pending_pgs: List[bytes] = []
         self._bg_tasks: list = []
         self._retry_wakeup = asyncio.Event()
+        # orders availability deltas against death publishes on the
+        # "resources" gossip channel (see _publish_resource_delta)
+        self._resources_pub_lock = asyncio.Lock()
         # Persistence (reference: RedisStoreClient-backed GCS tables,
         # store_client/redis_store_client.h — here a snapshot file):
         # tables survive a GCS restart; raylets reregister via the
@@ -142,6 +145,19 @@ class GcsServer:
             "restored GCS state from %s: %d actors, %d PGs, %d jobs, "
             "%d kv ns", source, len(self.actors),
             len(self.placement_groups), len(self.jobs), len(self.kv))
+
+    async def _publish_resource_delta(self, node_id: bytes, data: dict):
+        """Resource-gossip deltas ride a per-channel LOCK shared with the
+        death publish (reference ordering concern: ray_syncer versions
+        its messages): a heartbeat handler suspended mid-publish cannot
+        have its delta land AFTER a concurrent death publish and
+        resurrect the node in peer views — the lock serializes the two,
+        and aliveness is re-checked inside it."""
+        async with self._resources_pub_lock:
+            node = self.nodes.get(node_id)
+            if node is None or not node["alive"]:
+                return  # died while we waited: death publish stands
+            await self.publish("resources", data)
 
     def _dump_all_to_store(self):
         for actor_id, rec in self.actors.items():
@@ -377,12 +393,7 @@ class GcsServer:
         # the liveness-coupled fallback.
         if node.get("_pub_avail") != req["available"]:
             node["_pub_avail"] = dict(req["available"])
-            # AWAITED, not fire-and-forget: publishes must leave in
-            # handler order or a delayed availability delta could land
-            # after this node's death publish and resurrect it in peer
-            # views (subscriber-side application is synchronous, so
-            # arrival order is application order)
-            await self.publish("resources", {
+            await self._publish_resource_delta(node_id, {
                 "node_id": node_id,
                 "raylet_addr": node["raylet_addr"],
                 "total": node["total"],
@@ -521,8 +532,9 @@ class GcsServer:
             node_id=node_id.hex(), reason=reason)
         await self.publish("nodes", {"event": "removed", "node_id": node_id,
                                      "reason": reason})
-        await self.publish("resources", {"node_id": node_id,
-                                         "dead": True})
+        async with self._resources_pub_lock:
+            await self.publish("resources", {"node_id": node_id,
+                                             "dead": True})
         # Fail over actors that lived on that node.
         for actor_id, info in list(self.actors.items()):
             if info.get("node_id") == node_id and info["state"] in (ALIVE, PENDING):

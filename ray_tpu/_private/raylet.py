@@ -266,6 +266,7 @@ class Raylet:
     # ------------------------------------------------------------------
 
     async def _heartbeat_loop(self):
+        last_sent = 0.0
         while True:
             # timer tick OR an on-change nudge (resources freed): the
             # nudge makes the raylet->GCS direction of the resource
@@ -278,7 +279,17 @@ class Raylet:
                     self.config.raylet_heartbeat_period_s)
             except asyncio.TimeoutError:
                 pass
+            # Debounce nudged sends: a tight task stream frees
+            # resources per completion, and a heartbeat + GCS delta
+            # fan-out per task would tax the submission path it serves
+            # (measured: -25% on single-client sync tasks). One nudged
+            # heartbeat per 50ms coalesces bursts while keeping
+            # freed-capacity propagation ~10x faster than the timer.
+            gap = time.monotonic() - last_sent
+            if gap < 0.05:
+                await asyncio.sleep(0.05 - gap)
             self._heartbeat_nudge.clear()
+            last_sent = time.monotonic()
             try:
                 reply = await self.gcs.call("heartbeat", {
                     "node_id": self.node_id.binary(),
@@ -328,7 +339,11 @@ class Raylet:
                         self._view_push_ts.pop(node_id, None)
                 self._respill_pending()
             except (ConnectionLost, RpcError, OSError, asyncio.TimeoutError):
-                pass
+                # the nudge was cleared before the failed send: re-arm
+                # it so the freed-capacity signal retries (debounce +
+                # the RPC timeout bound the retry rate) instead of
+                # silently waiting out a whole timer period
+                self._heartbeat_nudge.set()
 
     def _respill_pending(self):
         """Hand queued leases that this node cannot currently satisfy to

@@ -33,6 +33,65 @@ _MAX_PACKAGE_BYTES = 100 * 1024 * 1024
 
 
 # ---------------------------------------------------------------------------
+# plugin seam (reference: python/ray/_private/runtime_env/plugin.py —
+# RuntimeEnvPlugin ABC + the RAY_RUNTIME_ENV_PLUGINS registration env
+# var). A plugin owns one runtime_env FIELD: it validates/uploads on the
+# driver and materializes on the worker. The built-in fields
+# (env_vars/working_dir/py_modules/pip) are handled natively below; any
+# OTHER field must have a registered plugin — the seam where a
+# container/hermetic-image backend slots in (zero-egress environments
+# get no container plugin by default, but the extension point is load-
+# bearing and tested).
+# ---------------------------------------------------------------------------
+
+
+class RuntimeEnvPlugin:
+    """Owns one runtime_env field (`name`). Driver side: `prepare`
+    validates the user value and returns its wire form (uploading any
+    payloads — `upload(path) -> key` stores into the GCS KV). Worker
+    side: `materialize` applies the wire value before any task runs
+    (chdir, sys.path, env vars via os.environ)."""
+
+    name: str = ""
+
+    def prepare(self, value, upload) -> Any:
+        return value
+
+    def materialize(self, value, fetch, target_root: str) -> None:
+        raise NotImplementedError
+
+
+_plugins: Dict[str, RuntimeEnvPlugin] = {}
+_env_plugins_loaded = False
+
+
+def register_plugin(plugin: RuntimeEnvPlugin) -> None:
+    if not plugin.name:
+        raise ValueError("plugin must set a field name")
+    _plugins[plugin.name] = plugin
+
+
+def _load_env_plugins() -> None:
+    """One-time load of plugins named in RAY_TPU_RUNTIME_ENV_PLUGINS
+    ("module:Class,module:Class" — the reference's env-var registration
+    mechanism). Runs on both driver and worker, so a plugin's two
+    halves resolve symmetrically."""
+    global _env_plugins_loaded
+    if _env_plugins_loaded:
+        return
+    spec = os.environ.get("RAY_TPU_RUNTIME_ENV_PLUGINS", "")
+    for entry in filter(None, (e.strip() for e in spec.split(","))):
+        mod_name, _, cls_name = entry.partition(":")
+        import importlib
+
+        cls = getattr(importlib.import_module(mod_name), cls_name)
+        register_plugin(cls())
+    # marked loaded only after EVERY entry imported: a bad entry must
+    # surface on each attempt, not silently freeze a partial registry
+    _env_plugins_loaded = True
+
+
+# ---------------------------------------------------------------------------
 # pip venv isolation (reference python/ray/_private/runtime_env/pip.py)
 # ---------------------------------------------------------------------------
 
@@ -308,10 +367,17 @@ def prepare(cw, runtime_env: Dict) -> Dict:
         ]
     if runtime_env.get("pip"):
         wire["pip"] = normalize_pip(runtime_env["pip"])
+    _load_env_plugins()
     unknown = set(runtime_env) - {"env_vars", "working_dir", "py_modules",
                                   "pip"}
-    if unknown:
-        raise ValueError(f"unsupported runtime_env fields: {unknown}")
+    for field_name in sorted(unknown):
+        plugin = _plugins.get(field_name)
+        if plugin is None:
+            raise ValueError(
+                f"unsupported runtime_env field {field_name!r} (no "
+                f"registered plugin; see runtime_env.register_plugin / "
+                f"RAY_TPU_RUNTIME_ENV_PLUGINS)")
+        wire[field_name] = plugin.prepare(runtime_env[field_name], upload)
     # precompute the pooling identity once: scheduling_key() reads it on
     # every submit, which must not pay a json+sha1 per task
     wire["_hash"] = hashlib.sha1(
@@ -376,3 +442,29 @@ def materialize(cw, wire: Dict, target_root: str) -> None:
         os.chdir(dest)
         if dest not in sys.path:
             sys.path.insert(0, dest)
+
+    _load_env_plugins()
+
+    def fetch(key: str) -> bytes:
+        reply = cw._run_sync(cw.gcs.call("kv_get", {
+            "ns": _KV_NS, "key": key.encode()}))
+        if reply["value"] is None:
+            raise RuntimeError(f"runtime_env payload {key} missing")
+        return reply["value"]
+
+    builtin = {"env_vars", "working_dir", "py_modules", "pip", "_hash"}
+    for field_name in wire:
+        if field_name in builtin:
+            continue
+        plugin = _plugins.get(field_name)
+        if plugin is None:
+            # iterate WIRE fields, not registered plugins: a field the
+            # driver validated but this worker cannot apply must FAIL
+            # the env setup, never silently run the task without its
+            # declared environment (ship the plugin module via
+            # py_modules + RAY_TPU_RUNTIME_ENV_PLUGINS)
+            raise RuntimeError(
+                f"runtime_env field {field_name!r} has no registered "
+                f"plugin in this worker (set RAY_TPU_RUNTIME_ENV_PLUGINS "
+                f"in env_vars and ship the module via py_modules)")
+        plugin.materialize(wire[field_name], fetch, target_root)

@@ -170,3 +170,55 @@ def test_pip_env_install_failure_fails_task(tmp_path):
 
     with pytest.raises(Exception, match="runtime_env setup failed"):
         ray_tpu.get(f.remote(), timeout=180)
+
+
+def test_runtime_env_plugin_seam(tmp_path):
+    """Custom runtime_env fields route through registered plugins
+    (reference: `python/ray/_private/runtime_env/plugin.py` +
+    RAY_RUNTIME_ENV_PLUGINS): driver-side prepare produces the wire
+    form, worker-side materialize applies it before the task runs. The
+    plugin module ships to workers via py_modules and loads there via
+    the RAY_TPU_RUNTIME_ENV_PLUGINS env var."""
+    import sys
+    import textwrap
+
+    from ray_tpu._private import runtime_env as renv
+
+    mod_dir = tmp_path / "touchplugin"
+    mod_dir.mkdir()
+    (mod_dir / "__init__.py").write_text(textwrap.dedent("""
+        import os
+        from ray_tpu._private.runtime_env import RuntimeEnvPlugin
+
+        class TouchPlugin(RuntimeEnvPlugin):
+            name = "touch_file"
+
+            def prepare(self, value, upload):
+                return {"path": str(value), "token": "prepared"}
+
+            def materialize(self, value, fetch, target_root):
+                with open(value["path"], "w") as f:
+                    f.write(value["token"])
+    """))
+    sys.path.insert(0, str(tmp_path))
+    try:
+        import touchplugin
+
+        renv.register_plugin(touchplugin.TouchPlugin())
+        marker = tmp_path / "touched.txt"
+
+        @ray_tpu.remote(runtime_env={
+            "touch_file": str(marker),
+            "py_modules": [str(mod_dir)],
+            "env_vars": {
+                "RAY_TPU_RUNTIME_ENV_PLUGINS": "touchplugin:TouchPlugin",
+            },
+        })
+        def probe():
+            with open(str(marker)) as f:
+                return f.read()
+
+        assert ray_tpu.get(probe.remote(), timeout=120) == "prepared"
+    finally:
+        sys.path.remove(str(tmp_path))
+        renv._plugins.pop("touch_file", None)
