@@ -872,6 +872,11 @@ class CoreWorker:
 
     def wait(self, refs: Sequence[ObjectRef], num_returns: int = 1,
              timeout: float | None = None):
+        # Duplicate refs make ready/not_ready partition counts lie
+        # (len(ready)+len(not_ready) < len(refs)); the reference rejects
+        # them outright (ray.wait, python/ray/_private/worker.py).
+        if len({r.binary() for r in refs}) != len(refs):
+            raise ValueError("wait() requires a list of unique object refs")
         # Caller-thread fast path: enough refs already visible in the
         # memory store resolves the wait with no loop round-trip — the
         # drain-a-big-batch pattern (`while not_ready: ready, not_ready =
@@ -1931,6 +1936,11 @@ class CoreWorker:
                     while st["queue"]:
                         self._store_task_error(st["queue"].popleft(), e)
                     return
+                if not st["queue"] or st["queue"][0] is not spec:
+                    # cancel dequeued the head while we awaited
+                    # _resolve_actor: the cancelled spec must not be sent,
+                    # and whatever is at the head now must not be dropped.
+                    continue
                 st["queue"].popleft()
                 self._assign_seq(st, addr, restarts, spec)
                 asyncio.ensure_future(self._push_actor_task(st, spec, addr))
@@ -2328,9 +2338,28 @@ class CoreWorker:
 
     def _execute_batch(self, pairs):
         """Execute a batch serially, then resolve every reply future in
-        ONE loop callback (one self-pipe write instead of len(pairs))."""
-        results = [(fut, self._execute_guarded(spec))
-                   for spec, fut in pairs]
+        ONE loop callback (one self-pipe write instead of len(pairs)).
+        A cancel interrupt landing BETWEEN tasks of the batch must not
+        discard batchmates: completed results stand, the in-hand spec
+        gets a cancelled reply only if it was the cancel target, and
+        everything else resumes execution (a stale interrupt — its
+        target already finished — is simply consumed)."""
+        results = []
+        i = 0
+        while i < len(pairs):
+            spec, fut = pairs[i]
+            try:
+                results.append((fut, self._execute_guarded(spec)))
+                i += 1
+            except _TaskCancelledInterrupt:
+                if spec.task_id in self._cancel_requested:
+                    results.append((fut, self._package_cancelled(spec)))
+                    i += 1
+                # else: stale interrupt aimed at an already-finished
+                # batchmate; retry the in-hand spec. (The only
+                # double-execution window is the few bytecodes between
+                # _execute_guarded returning and append — acceptable
+                # for a best-effort cancel, same as reference.)
 
         def post():
             for fut, reply in results:

@@ -18,6 +18,7 @@ from __future__ import annotations
 import os
 import pickle
 from typing import Any, Dict, Optional
+from urllib.parse import quote, unquote
 
 
 class StoreClient:
@@ -73,11 +74,24 @@ class FileStoreClient(StoreClient):
     def __init__(self, root: str):
         self.root = root
         os.makedirs(root, exist_ok=True)
+        # Migrate table dirs written by the pre-quote encoding (which
+        # left ':' etc. intact): without this, a store created before
+        # the reversible encoding restores every kv namespace empty —
+        # 'kv:default' would be read back but fetched as 'kv%3Adefault'.
+        for name in os.listdir(root):
+            canon = quote(unquote(name), safe="")
+            if canon != name:
+                src = os.path.join(root, name)
+                dst = os.path.join(root, canon)
+                if os.path.isdir(src) and not os.path.exists(dst):
+                    os.replace(src, dst)
 
     def _table_dir(self, table: str) -> str:
-        # table names are framework-controlled identifiers; keep them
-        # path-safe anyway
-        return os.path.join(self.root, table.replace("/", "_"))
+        # Reversible path-safe encoding: tables() reconstructs kv
+        # namespaces from directory names after a GCS restart, so the
+        # mapping must be injective ('a/b' and 'a_b' must not collide,
+        # and a namespace containing '/' must round-trip exactly).
+        return os.path.join(self.root, quote(table, safe=""))
 
     def put_blob(self, table, key, blob):
         d = self._table_dir(table)
@@ -113,7 +127,7 @@ class FileStoreClient(StoreClient):
 
     def tables(self):
         try:
-            return [n for n in os.listdir(self.root)
+            return [unquote(n) for n in os.listdir(self.root)
                     if os.path.isdir(os.path.join(self.root, n))]
         except FileNotFoundError:
             return []
