@@ -32,6 +32,20 @@ LABEL_SLICE_HOST_ID = "ray_tpu.slice_host_id"
 LABEL_SLICE_NUM_HOSTS = "ray_tpu.slice_num_hosts"
 
 
+def apply_jax_platforms(platforms: Optional[str]) -> None:
+    """Make a JAX_PLATFORMS assignment effective even when a site hook
+    pre-imported jax with an accelerator backend as the default (the env
+    var is only read at first import). No-op when jax is not yet
+    imported — first import will read the env var itself."""
+    import sys
+
+    if platforms and "jax" in sys.modules:
+        try:
+            sys.modules["jax"].config.update("jax_platforms", platforms)
+        except Exception:  # noqa: BLE001 — backend may be finalized
+            pass
+
+
 def num_local_chips() -> int:
     """Detect this host's TPU chip count (reference tpu.py:104-120:
     /dev/accel* then /dev/vfio; env override first for tests)."""

@@ -385,6 +385,27 @@ def prepare(cw, runtime_env: Dict) -> Dict:
     return wire
 
 
+def merge_wire(base: Dict, override: Dict) -> Dict:
+    """Field-wise inheritance of prepared (wire-form) runtime envs: the
+    override's fields win, `env_vars` merge key-wise, and the pooling
+    hash is recomputed for the combined env (reference semantics:
+    `python/ray/_private/runtime_env/validation.py` parent/child merge).
+    """
+    merged = {k: v for k, v in base.items() if k != "_hash"}
+    for k, v in override.items():
+        if k == "_hash":
+            continue
+        if k == "env_vars":
+            ev = dict(merged.get("env_vars") or {})
+            ev.update(v or {})
+            merged[k] = ev
+        else:
+            merged[k] = v
+    merged["_hash"] = hashlib.sha1(
+        json.dumps(merged, sort_keys=True).encode()).hexdigest()[:16]
+    return merged
+
+
 def env_hash(wire: Optional[Dict]) -> str:
     """Stable identity for worker pooling; empty env hashes to ''."""
     if not wire:

@@ -331,15 +331,25 @@ _OPTION_DEFAULTS = dict(
 def _prepared_runtime_env(holder, cw, opts):
     """Resolve + upload the runtime env once per RemoteFunction/ActorClass
     instance (content-addressed, so repeats are cheap anyway); falls back
-    to the job-level default from init(runtime_env=...)."""
+    to the job-level default from init(runtime_env=...).
+
+    A per-task/actor runtime_env inherits the job-level one field-wise
+    (reference: `python/ray/_private/runtime_env/validation.py` — child
+    fields override, `env_vars` merge key-wise), so e.g. Train workers
+    that add env_vars keep the job's working_dir/pip."""
     renv = opts.get("runtime_env")
+    job_env = getattr(cw, "job_runtime_env", None)
     if renv is None:
-        return getattr(cw, "job_runtime_env", None)
+        return job_env
     cached = getattr(holder, "_prepared_env", None)
     if cached is None:
         from ray_tpu._private import runtime_env as renv_mod
 
         cached = renv_mod.prepare(cw, renv)
+        if job_env:
+            # wire-level merge: job_env's paths are already uploaded
+            # (content keys), so inheritance composes prepared forms
+            cached = renv_mod.merge_wire(job_env, cached)
         holder._prepared_env = cached
     return cached
 

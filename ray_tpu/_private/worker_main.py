@@ -31,6 +31,13 @@ def main():
         format=f"[worker {os.getpid()}] %(levelname)s %(name)s: %(message)s",
     )
 
+    # Honor the raylet's platform assignment (a worker spawned without
+    # TPU chips must not grab the node's chip) even when a site hook
+    # pre-imported jax at interpreter start.
+    from ray_tpu._private.accelerators import apply_jax_platforms
+
+    apply_jax_platforms(os.environ.get("JAX_PLATFORMS"))
+
     from ray_tpu._private.core_worker import CoreWorker
     from ray_tpu._private.ids import JobID
     from ray_tpu._private.object_store import ObjectStore

@@ -5,7 +5,8 @@ directory plus a filesystem". Here the filesystem abstraction is a plain
 local path (shared-filesystem or per-node session dir); cloud filesystems
 can layer in behind the same path string later. Convenience dict round-trip
 helpers cover the common "small state" case; sharded-array checkpoints go
-through orbax via `ray_tpu.train.orbax_utils`.
+through `ray_tpu.train.array_checkpoint` (per-host shard files + index,
+restorable onto a different topology).
 """
 
 from __future__ import annotations

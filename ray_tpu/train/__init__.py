@@ -20,6 +20,7 @@ from ray_tpu.train._internal.session import (
     get_dataset_shard,
     report,
 )
+from ray_tpu.train import array_checkpoint
 from ray_tpu.train._internal.backend_executor import TrainingFailedError
 from ray_tpu.train.backend import Backend, BackendConfig, JaxConfig
 from ray_tpu.train.trainer import (
@@ -29,6 +30,7 @@ from ray_tpu.train.trainer import (
 )
 
 __all__ = [
+    "array_checkpoint",
     "Backend",
     "BackendConfig",
     "BaseTrainer",

@@ -26,6 +26,10 @@ class TrainWorker:
     def __init__(self, worker_env: Optional[Dict[str, str]] = None):
         for k, v in (worker_env or {}).items():
             os.environ[k] = v
+        if worker_env and "JAX_PLATFORMS" in worker_env:
+            from ray_tpu._private.accelerators import apply_jax_platforms
+
+            apply_jax_platforms(worker_env["JAX_PLATFORMS"])
         self._thread: Optional[threading.Thread] = None
         self._session: Optional[session_mod._TrainSession] = None
 
@@ -142,6 +146,14 @@ class WorkerGroup:
             opts: Dict[str, Any] = dict(num_cpus=num_cpus, resources=dict(res))
             if num_tpus:
                 opts["num_tpus"] = num_tpus
+            if worker_env:
+                # spawn-time env vars: XLA_FLAGS and friends must be in
+                # the process environment BEFORE jax initializes its
+                # backend, which the post-spawn os.environ writes in
+                # TrainWorker.__init__ cannot guarantee (a pooled worker
+                # may already have jax live). The env hash also forces a
+                # fresh worker process instead of a pooled reuse.
+                opts["runtime_env"] = {"env_vars": dict(worker_env)}
             if pgs is not None:
                 opts["scheduling_strategy"] = \
                     ray_tpu.PlacementGroupSchedulingStrategy(

@@ -1,0 +1,363 @@
+"""Distributed sharded-array checkpointing.
+
+THE checkpoint story on TPU: a training state whose leaves are
+`NamedSharding`-sharded `jax.Array`s spread over a multi-host mesh must be
+saved with each host writing only the shards it holds, and restored onto a
+mesh that may have a *different* process→device topology (elastic recovery
+replaces a slice; a resumed run may have different local device counts).
+
+Reference analogue: `python/ray/train/_checkpoint.py:56` (a Checkpoint is
+a directory plus a filesystem) and `train/_internal/storage.py:349`
+(persist_current_checkpoint, the seam Train checkpoints flow through) —
+with the array-shard layer the reference delegates to torch
+`distributed_checkpoint` / orbax replaced by a native implementation.
+Native rather than orbax because the shard files must ride the Train
+session's per-rank persist convention (`checkpoint_NNN/` for rank 0,
+`checkpoint_NNN_shards/rank_k/` for the rest — see
+`session._persist_checkpoint`) and restore must work index-based across
+topology changes with no tensorstore dependency; the format below is the
+`jax.experimental.array_serialization` idea (per-shard index → byte
+blobs) in plain npz + json.
+
+Save protocol (per process):
+  * every `jax.Array` leaf contributes its *addressable* shards with
+    `replica_id == 0` (exactly one copy of each distinct block globally);
+  * shard blobs land in  ``asv_data.<proc>.npz`` (stored as raw uint8
+    views so bfloat16 round-trips without npy dtype support);
+  * ``asv_index.<proc>.json`` is written LAST — its presence marks this
+    process's contribution complete, which `is_usable` checks before an
+    elastic restart trusts the checkpoint;
+  * non-array leaves (python scalars, numpy arrays, None) are saved by
+    process 0 only, pickled into ``asv_host.<proc>.pkl``.
+
+Restore reads every index (rank-0 dir + ``<dir>_shards/rank_*``), then for
+each leaf builds the target `jax.Array` with `jax.make_array_from_callback`
+against the sharding of the caller's ``like`` tree, assembling each
+requested block from whichever saved shards overlap it — saved and target
+shard grids need not match.
+"""
+
+from __future__ import annotations
+
+import glob as glob_mod
+import json
+import os
+import pickle
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+_INDEX_FMT = "asv_index.{proc}.json"
+_DATA_FMT = "asv_data.{proc}.npz"
+_HOST_FMT = "asv_host.{proc}.pkl"
+_FORMAT_VERSION = 1
+
+
+def _np_dtype(name: str):
+    """Resolve a dtype name, including ml_dtypes extras (bfloat16 &c)."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _norm_index(index: Sequence[slice], shape: Sequence[int]
+                ) -> List[Tuple[int, int]]:
+    """Normalize a shard index (tuple of slices) to explicit [start, stop)
+    per dimension. jax pads a rank-k array's index to k slices; a scalar's
+    index is ()."""
+    out = []
+    for sl, dim in zip(index, shape):
+        start = 0 if sl.start is None else sl.start
+        stop = dim if sl.stop is None else sl.stop
+        out.append((int(start), int(stop)))
+    # trailing dims not mentioned by the index are whole
+    for dim in shape[len(out):]:
+        out.append((0, int(dim)))
+    return out
+
+
+def _is_jax_array(leaf: Any) -> bool:
+    import jax
+
+    return isinstance(leaf, jax.Array)
+
+
+def save_sharded(dir_path: str, tree: Any) -> None:
+    """Write THIS process's contribution of `tree` into `dir_path`.
+
+    Every participating process must call this with a consistently
+    structured tree (same treedef, same global shapes — the SPMD training
+    state). Single-process callers save everything.
+    """
+    import jax
+
+    os.makedirs(dir_path, exist_ok=True)
+    proc = jax.process_index()
+    leaves_with_paths, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    index: Dict[str, Any] = {
+        "format": _FORMAT_VERSION,
+        "process": proc,
+        "num_processes": jax.process_count(),
+        "treedef": str(treedef),
+        "leaves": [],
+    }
+    blobs: Dict[str, np.ndarray] = {}
+    host_values: Dict[int, Any] = {}
+    for pos, (path, leaf) in enumerate(leaves_with_paths):
+        keystr = jax.tree_util.keystr(path)
+        if _is_jax_array(leaf):
+            # replica_id==0 keeps exactly one copy of each distinct block
+            # globally; a process holding none of this array's replica-0
+            # shards (possible under multislice layouts) contributes an
+            # empty shard list for the leaf.
+            shards_meta: List[dict] = []
+            for j, shard in enumerate(leaf.addressable_shards):
+                if shard.replica_id != 0:
+                    continue
+                key = f"l{pos}s{j}"
+                data = np.ascontiguousarray(np.asarray(shard.data))
+                # flatten before the uint8 view: a 0-d array (scalar leaf)
+                # cannot change itemsize in place, and the true shape is
+                # recorded in the shard record anyway
+                blobs[key] = data.reshape(-1).view(np.uint8)
+                shards_meta.append({
+                    "key": key,
+                    "index": _norm_index(shard.index, leaf.shape),
+                    "shape": list(data.shape),
+                })
+            index["leaves"].append({
+                "pos": pos,
+                "path": keystr,
+                "kind": "array",
+                "shape": list(leaf.shape),
+                "dtype": str(leaf.dtype),
+                "shards": shards_meta,
+            })
+        else:
+            index["leaves"].append({
+                "pos": pos,
+                "path": keystr,
+                "kind": "host",
+            })
+            if proc == 0:
+                host_values[pos] = leaf
+    np.savez(os.path.join(dir_path, _DATA_FMT.format(proc=proc)), **blobs)
+    if proc == 0:
+        with open(os.path.join(dir_path, _HOST_FMT.format(proc=proc)),
+                  "wb") as f:
+            pickle.dump(host_values, f, protocol=pickle.HIGHEST_PROTOCOL)
+    # the index is the commit marker: write it last, atomically
+    ipath = os.path.join(dir_path, _INDEX_FMT.format(proc=proc))
+    tmp = f"{ipath}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(index, f)
+    os.replace(tmp, ipath)
+
+
+def _checkpoint_dirs(source: Any) -> List[str]:
+    """Rank-0 checkpoint dir + the sibling per-rank shard dirs."""
+    path = getattr(source, "path", source)
+    dirs = [path]
+    dirs.extend(sorted(glob_mod.glob(path + "_shards/rank_*")))
+    # staging layout (before session persist): everything in one dir
+    return [d for d in dirs if os.path.isdir(d)]
+
+
+def _load_indexes(source: Any) -> List[Tuple[str, dict]]:
+    out = []
+    for d in _checkpoint_dirs(source):
+        for ipath in sorted(glob_mod.glob(os.path.join(d, "asv_index.*.json"))):
+            with open(ipath) as f:
+                out.append((d, json.load(f)))
+    return out
+
+
+def is_sharded_checkpoint(source: Any) -> bool:
+    return bool(_load_indexes(source))
+
+
+def is_usable(source: Any) -> bool:
+    """True when every process's contribution is present and readable —
+    the guard an elastic restart applies before trusting a checkpoint
+    whose writer gang may have been killed mid-persist. Non-sharded
+    checkpoints are trusted (their single dict file is written
+    atomically)."""
+    indexes = _load_indexes(source)
+    if not indexes:
+        return True
+    want = indexes[0][1].get("num_processes", 1)
+    if len(indexes) != want:
+        return False
+    for d, idx in indexes:
+        data_path = os.path.join(d, _DATA_FMT.format(proc=idx["process"]))
+        try:
+            with np.load(data_path) as z:
+                have = set(z.files)
+        except (OSError, ValueError):
+            return False
+        for leaf in idx["leaves"]:
+            for sh in leaf.get("shards", ()):
+                if sh["key"] not in have:
+                    return False
+    return True
+
+
+class _ShardSource:
+    """Lazily-opened npz files keyed by directory, with the merged
+    per-leaf shard map built from every process's index."""
+
+    def __init__(self, source: Any):
+        self.indexes = _load_indexes(source)
+        if not self.indexes:
+            path = getattr(source, "path", source)
+            raise FileNotFoundError(
+                f"no sharded-array checkpoint found under {path!r}")
+        self._npz: Dict[str, Any] = {}
+        # pos -> {"meta": leaf record, "shards": [(dir, shard record)]}
+        self.leaves: Dict[int, dict] = {}
+        for d, idx in self.indexes:
+            for leaf in idx["leaves"]:
+                ent = self.leaves.setdefault(
+                    leaf["pos"], {"meta": leaf, "shards": []})
+                for sh in leaf.get("shards", ()):
+                    ent["shards"].append((d, idx["process"], sh))
+        self.host_values: Dict[int, Any] = {}
+        for d, idx in self.indexes:
+            hpath = os.path.join(d, _HOST_FMT.format(proc=idx["process"]))
+            if os.path.exists(hpath):
+                with open(hpath, "rb") as f:
+                    self.host_values.update(pickle.load(f))
+
+    def blob(self, d: str, proc: int, key: str, shape, dtype) -> np.ndarray:
+        npz_path = os.path.join(d, _DATA_FMT.format(proc=proc))
+        z = self._npz.get(npz_path)
+        if z is None:
+            z = self._npz[npz_path] = np.load(npz_path)
+        raw = z[key]
+        return raw.view(dtype).reshape(shape)
+
+    def close(self):
+        for z in self._npz.values():
+            z.close()
+
+
+def _assemble(src: _ShardSource, pos: int, req: Sequence[slice]
+              ) -> np.ndarray:
+    """Materialize the requested block of leaf `pos` from whichever saved
+    shards overlap it (saved and requested shard grids need not match)."""
+    ent = src.leaves[pos]
+    meta = ent["meta"]
+    shape = meta["shape"]
+    dtype = _np_dtype(meta["dtype"])
+    want = _norm_index(req, shape)
+    out_shape = [stop - start for start, stop in want]
+    out = np.empty(out_shape, dtype=dtype)
+    filled = 0
+    for d, proc, sh in ent["shards"]:
+        have = [(s, e) for s, e in sh["index"]]
+        inter = [(max(ws, hs), min(we, he))
+                 for (ws, we), (hs, he) in zip(want, have)]
+        if any(s >= e for s, e in inter):
+            continue
+        blob = src.blob(d, proc, sh["key"], sh["shape"], dtype)
+        src_sel = tuple(slice(s - hs, e - hs)
+                        for (s, e), (hs, _) in zip(inter, have))
+        dst_sel = tuple(slice(s - ws, e - ws)
+                        for (s, e), (ws, _) in zip(inter, want))
+        out[dst_sel] = blob[src_sel]
+        vol = 1
+        for s, e in inter:
+            vol *= e - s
+        filled += vol
+    total = 1
+    for s in out_shape:
+        total *= s
+    if filled != total:
+        raise ValueError(
+            f"sharded checkpoint leaf {meta['path']!r}: requested block "
+            f"{want} only {filled}/{total} elements covered — checkpoint "
+            f"incomplete (use is_usable() before restoring)")
+    return out
+
+
+def restore_sharded(source: Any, like: Any) -> Any:
+    """Restore a pytree saved by `save_sharded`.
+
+    `source` is a checkpoint directory path or an `air.Checkpoint` whose
+    path is the rank-0 dir (sibling `_shards/rank_*` dirs are found
+    automatically). `like` is a pytree with the SAME structure whose
+    `jax.Array` / `jax.ShapeDtypeStruct` leaves carry the *target*
+    shardings — typically the freshly initialized training state of the
+    resumed run. The restored values are bit-identical to what was saved,
+    laid out per `like`'s shardings (which may differ from the saver's
+    topology). Non-array leaves are returned from the saved host values.
+    """
+    import jax
+
+    src = _ShardSource(source)
+    try:
+        leaves_with_paths, treedef = \
+            jax.tree_util.tree_flatten_with_path(like)
+        n_saved = max(src.leaves) + 1 if src.leaves else 0
+        n_saved = max(n_saved, (max(src.host_values) + 1)
+                      if src.host_values else 0)
+        if len(leaves_with_paths) != n_saved:
+            raise ValueError(
+                f"restore structure mismatch: checkpoint has {n_saved} "
+                f"leaves, `like` has {len(leaves_with_paths)}")
+        out_leaves = []
+        for pos, (path, leaf) in enumerate(leaves_with_paths):
+            ent = src.leaves.get(pos)
+            if ent is None or ent["meta"]["kind"] == "host":
+                out_leaves.append(src.host_values.get(pos, leaf))
+                continue
+            meta = ent["meta"]
+            keystr = jax.tree_util.keystr(path)
+            if meta["path"] != keystr:
+                raise ValueError(
+                    f"restore structure mismatch at leaf {pos}: saved "
+                    f"{meta['path']!r} vs requested {keystr!r}")
+            shape = tuple(meta["shape"])
+            dtype = _np_dtype(meta["dtype"])
+            if hasattr(leaf, "shape") and tuple(leaf.shape) != shape:
+                raise ValueError(
+                    f"shape mismatch for {keystr}: saved {shape}, "
+                    f"`like` has {tuple(leaf.shape)}")
+            tgt_dtype = getattr(leaf, "dtype", None)
+            if tgt_dtype is not None and np.dtype(tgt_dtype) != dtype:
+                raise ValueError(
+                    f"dtype mismatch for {keystr}: saved {dtype}, "
+                    f"`like` has {np.dtype(tgt_dtype)} — restore is "
+                    f"bit-exact, cast after restoring if intended")
+            sharding = getattr(leaf, "sharding", None)
+            if sharding is None:
+                # host-side target: assemble the full array
+                out_leaves.append(
+                    _assemble(src, pos, (slice(None),) * len(shape)))
+                continue
+            out_leaves.append(jax.make_array_from_callback(
+                shape, sharding,
+                lambda idx, p=pos: _assemble(src, p, idx)))
+        return jax.tree_util.tree_unflatten(treedef, out_leaves)
+    finally:
+        src.close()
+
+
+def save_to_checkpoint(tree: Any, base_dir: Optional[str] = None):
+    """Stage this process's shards into a throwaway dir and wrap it as an
+    `air.Checkpoint` ready for `train.report(checkpoint=...)` — the train
+    session then persists rank 0's dir as the canonical checkpoint and
+    every other rank's into the `_shards/rank_k` sibling, reassembling the
+    full shard set in trial storage."""
+    import tempfile
+
+    from ray_tpu.air.checkpoint import Checkpoint
+
+    d = tempfile.mkdtemp(prefix="ackpt_", dir=base_dir)
+    save_sharded(d, tree)
+    ckpt = Checkpoint(d)
+    ckpt._temp_source = True
+    return ckpt

@@ -93,11 +93,21 @@ def _worker_jax_platform() -> str:
 def _init_jax_distributed(coordinator: str, num_processes: int,
                           process_id: int) -> str:
     import jax
-    jax.distributed.initialize(
-        coordinator_address=coordinator,
-        num_processes=num_processes,
-        process_id=process_id,
-    )
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except RuntimeError:
+        # a reused process with a stale (dead-coordinator) client:
+        # tear it down and join the new rendezvous
+        jax.distributed.shutdown()
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
     return f"{jax.process_index()}/{jax.process_count()}"
 
 

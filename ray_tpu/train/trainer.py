@@ -207,11 +207,26 @@ class DataParallelTrainer(BaseTrainer):
                 attempts += 1
                 if max_failures >= 0 and attempts > max_failures:
                     raise
-                restore_checkpoint = ckpt_manager.latest_checkpoint or \
-                    restore_checkpoint
+                restore_checkpoint = self._latest_usable_checkpoint(
+                    ckpt_manager) or restore_checkpoint
             except BaseException:
                 executor.shutdown()
                 raise
+
+
+    @staticmethod
+    def _latest_usable_checkpoint(ckpt_manager: CheckpointManager):
+        """Newest checkpoint whose shard set is complete. A gang killed
+        mid-persist can leave a sharded checkpoint missing some ranks'
+        files; restoring from it would fail again, so the restart walks
+        back to the newest complete one (dict checkpoints are atomic and
+        always usable)."""
+        from ray_tpu.train import array_checkpoint
+
+        for ckpt, _metrics in reversed(ckpt_manager.best_checkpoints()):
+            if array_checkpoint.is_usable(ckpt):
+                return ckpt
+        return None
 
 
 class JaxTrainer(DataParallelTrainer):

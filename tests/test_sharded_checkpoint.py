@@ -1,0 +1,208 @@
+"""Sharded-array checkpointing + elastic restore.
+
+Reference ground: `python/ray/train/tests/test_new_persistence.py` (the
+checkpoint persistence seam) and SURVEY §7.3's hard-part deliverable —
+"checkpoint-restore of sharded arrays under elastic recovery". The save
+format is native per-host shard files + index
+(`ray_tpu/train/array_checkpoint.py`); the integration test runs a REAL
+multi-process jax.distributed gang (2 train-worker processes x 2 virtual
+CPU devices = one global 4-device mesh), kills a worker mid-run, and
+resumes from the sharded checkpoint bit-identically.
+
+Own file: the trainer workers need their own spawn-time env
+(XLA device count), and the module-scoped cluster keeps init exclusive.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import train
+from ray_tpu.air import FailureConfig, RunConfig, ScalingConfig
+from ray_tpu.train import array_checkpoint as ac
+from ray_tpu.train.backend import JaxConfig
+
+
+@pytest.fixture(scope="module", autouse=True)
+def cluster():
+    ray_tpu.init(num_cpus=8, num_tpus=0,
+                 object_store_memory=256 * 1024 * 1024)
+    yield
+    ray_tpu.shutdown()
+
+
+@pytest.fixture()
+def storage(tmp_path):
+    return str(tmp_path / "results")
+
+
+# ---------------------------------------------------------------------------
+# unit: save/restore across topologies (single process, 8-device CPU mesh)
+# ---------------------------------------------------------------------------
+
+
+def test_cross_topology_restore(tmp_path):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devs = jax.devices()
+    assert len(devs) == 8
+    mesh = Mesh(np.array(devs).reshape(4, 2), ("dp", "tp"))
+    state = {
+        "w": jax.device_put(
+            jnp.arange(32, dtype=jnp.bfloat16).reshape(8, 4),
+            NamedSharding(mesh, P("dp", "tp"))),
+        "b": jax.device_put(jnp.full((4,), 2.5, jnp.float32),
+                            NamedSharding(mesh, P(None))),
+        "step": 7,
+        "rng": np.arange(3),
+    }
+    d = str(tmp_path / "ck")
+    ac.save_sharded(d, state)
+    assert ac.is_sharded_checkpoint(d)
+    assert ac.is_usable(d)
+
+    # restore onto a transposed 2x4 mesh with different partition specs
+    mesh2 = Mesh(np.array(devs).reshape(2, 4), ("dp", "tp"))
+    like = {
+        "w": jax.ShapeDtypeStruct(
+            (8, 4), jnp.bfloat16,
+            sharding=NamedSharding(mesh2, P("tp", "dp"))),
+        "b": jax.ShapeDtypeStruct(
+            (4,), jnp.float32, sharding=NamedSharding(mesh2, P("dp"))),
+        "step": 0,
+        "rng": np.zeros(3, dtype=np.int64),
+    }
+    out = ac.restore_sharded(d, like)
+    assert out["step"] == 7
+    np.testing.assert_array_equal(np.asarray(out["rng"]), np.arange(3))
+    np.testing.assert_array_equal(
+        np.asarray(out["w"]).astype(np.float32),
+        np.arange(32, dtype=np.float32).reshape(8, 4))
+    assert out["w"].dtype == jnp.bfloat16
+    assert out["w"].sharding.spec == P("tp", "dp")
+    np.testing.assert_array_equal(np.asarray(out["b"]),
+                                  np.full((4,), 2.5, np.float32))
+
+
+def test_structure_mismatch_rejected(tmp_path):
+    import jax
+    import jax.numpy as jnp
+
+    d = str(tmp_path / "ck")
+    ac.save_sharded(d, {"a": jnp.ones((4,)), "b": 1})
+    with pytest.raises(ValueError, match="structure mismatch"):
+        ac.restore_sharded(d, {"a": jnp.ones((4,))})
+    with pytest.raises(ValueError, match="shape mismatch"):
+        ac.restore_sharded(d, {"a": jnp.ones((5,)), "b": 0})
+
+
+def test_incomplete_checkpoint_detected(tmp_path):
+    import json
+
+    import jax.numpy as jnp
+
+    d = str(tmp_path / "ck")
+    ac.save_sharded(d, {"a": jnp.ones((4,))})
+    ipath = os.path.join(
+        d, [f for f in os.listdir(d) if f.startswith("asv_index")][0])
+    with open(ipath) as f:
+        rec = json.load(f)
+    rec["num_processes"] = 2  # pretend a second writer never finished
+    with open(ipath, "w") as f:
+        json.dump(rec, f)
+    assert not ac.is_usable(d)
+
+
+# ---------------------------------------------------------------------------
+# integration: multi-process gang, worker kill, elastic resume
+# ---------------------------------------------------------------------------
+
+
+def _make_elastic_loop():
+    # defined inside a factory so cloudpickle serializes it by value —
+    # train workers cannot import the test module
+    import os as os_mod
+
+    def _elastic_loop(config):
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        from ray_tpu import train as train_mod
+        from ray_tpu.train import array_checkpoint as ac_mod
+
+        devs = jax.devices()  # global: 2 procs x 2 devices
+        mesh = Mesh(np.array(devs).reshape(len(devs)), ("dp",))
+        # make_array_from_callback, not device_put: each process can only
+        # materialize its addressable shards of a global sharding
+        w0 = np.arange(32, dtype=np.float32).reshape(8, 4)
+        state = {
+            "w": jax.make_array_from_callback(
+                (8, 4), NamedSharding(mesh, P("dp")), lambda idx: w0[idx]),
+            "step": jax.make_array_from_callback(
+                (), NamedSharding(mesh, P()),
+                lambda idx: np.zeros((), np.int32)),
+        }
+
+        start = 0
+        ckpt = train_mod.get_checkpoint()
+        if ckpt is not None and ac_mod.is_sharded_checkpoint(ckpt):
+            state = ac_mod.restore_sharded(ckpt, state)
+            start = int(np.asarray(state["step"].addressable_shards[0].data))
+
+        @jax.jit
+        def update(s):
+            return {"w": s["w"] * 2.0 + 1.0, "step": s["step"] + 1}
+
+        rank = train_mod.get_context().get_world_rank()
+        for i in range(start, 4):
+            if i == 2 and rank == 1 and start == 0:
+                # Simulated hardware loss, first attempt only. The extra
+                # report makes the kill deterministic for the assertion:
+                # its enqueue (queue size 1) can only complete after the
+                # controller drained — and registered — the step-2
+                # checkpoint, so the resume point is always step 2.
+                train_mod.report({"step": i, "pre_crash": True})
+                os_mod._exit(1)
+            state = update(state)
+            # local fingerprint: addressable shards only (no collective,
+            # so a dead gang-mate cannot wedge the survivor in a psum)
+            fp = float(sum(np.asarray(s.data).sum()
+                           for s in state["w"].addressable_shards
+                           if s.replica_id == 0))
+            train_mod.report(
+                {"step": i + 1, "fp": fp, "resumed_from": start,
+                 "rank": rank},
+                checkpoint=ac_mod.save_to_checkpoint(state))
+
+    return _elastic_loop
+
+
+def test_elastic_restore_bit_identical(storage):
+    trainer = train.JaxTrainer(
+        _make_elastic_loop(),
+        backend_config=JaxConfig(
+            distributed="on", platform="cpu",
+            xla_flags="--xla_force_host_platform_device_count=2"),
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(
+            storage_path=storage, name="elastic",
+            failure_config=FailureConfig(max_failures=1)),
+    )
+    result = trainer.fit()
+    assert result.metrics["step"] == 4
+    # the retried run actually restored from the step-2 sharded
+    # checkpoint rather than restarting from scratch
+    assert result.metrics["resumed_from"] == 2
+    # bit-identical resume: w_i = w_{i-1} * 2 + 1 from arange(32) — any
+    # drift in the restored shards changes the fingerprint. The lead
+    # (rank-0) fingerprint covers its addressable half of the dp-sharded
+    # array: rows 0:4 (devices 0,1 of the 4-device mesh).
+    w = np.arange(32, dtype=np.float32).reshape(8, 4)
+    for _ in range(4):
+        w = w * 2.0 + 1.0
+    assert result.metrics["fp"] == pytest.approx(float(w[:4].sum()), abs=0.0)
