@@ -2508,12 +2508,13 @@ class CoreWorker:
                     )
                 return {"returns": []}
             elif spec.task_type == task_mod.ACTOR_TASK:
-                if spec.method_name == "__ray_tpu_channel_loop__":
-                    # compiled-DAG channel stage (reference: the aDAG
+                if spec.method_name == "__ray_tpu_channel_graph__":
+                    # compiled-DAG channel stages (reference: the aDAG
                     # executor loop, compiled_dag_node.py): starts a
-                    # daemon thread pumping in-channel -> method ->
-                    # out-channel, so the actor stays callable
-                    result = self._start_channel_loop(*args, **kwargs)
+                    # daemon thread pumping this actor's graph nodes —
+                    # read input channels, run method, write output
+                    # channels — so the actor stays callable
+                    result = self._start_channel_graph(*args, **kwargs)
                 else:
                     method = getattr(self._actor_instance,
                                      spec.method_name)
@@ -2546,56 +2547,92 @@ class CoreWorker:
             self._task_children.pop(spec.task_id, None)
             self._cancel_requested.pop(spec.task_id, None)
 
-    def _start_channel_loop(self, in_name: str, out_name: str,
-                            method_name: str) -> str:
+    def _start_channel_graph(self, stages: list) -> str:
         """Compiled-DAG stage executor (reference: the per-actor loop a
-        compiled graph installs, `compiled_dag_node.py`; channel design
-        `experimental_mutable_object_manager.h:37`): attach the stage's
-        in/out shm channels NOW (so a wrong-node placement fails the
-        compile call loudly), then pump them on a daemon thread. Values
-        travel as ("ok", value) / ("err", message) — an upstream error
-        flows through untouched so the driver sees the original."""
+        compiled graph installs, `compiled_dag_node.py:291`; channel
+        design `experimental_mutable_object_manager.h:37`): attach every
+        stage's in/out shm channels NOW (so a wrong-node placement fails
+        the compile call loudly), then pump this actor's nodes in
+        topological order on one daemon thread — fan-in reads one
+        channel per argument, fan-out writes one channel per consumer.
+        Frames travel as ("ok", seq, value) / ("err", seq, message); an
+        upstream error flows through untouched so the driver sees the
+        original, and lagging inputs are re-read until their seqs agree
+        (self-healing after a driver-side timeout)."""
         import pickle
 
         from ray_tpu.experimental.channel import (ChannelClosedError,
                                                   ShmChannel)
 
-        in_ch = ShmChannel.attach(in_name)
-        out_ch = ShmChannel.attach(out_name)
-        method = getattr(self._actor_instance, method_name)
+        attached: Dict[str, ShmChannel] = {}
+
+        def get_ch(name: str) -> ShmChannel:
+            if name not in attached:
+                attached[name] = ShmChannel.attach(name)
+            return attached[name]
+
+        prepared = []
+        for st in stages:
+            prepared.append((
+                st,
+                [(pos, get_ch(n)) for pos, n in st["ins"]],
+                [get_ch(n) for n in st["outs"]],
+                getattr(self._actor_instance, st["method"]),
+            ))
+
+        def run_stage(st, ins, outs, method):
+            entries = {pos: pickle.loads(ch.read()) for pos, ch in ins}
+            chans = dict(ins)
+            while True:
+                mx = max(s for (_t, s, _v) in entries.values())
+                lagging = [p for p, (_t, s, _v) in entries.items()
+                           if s < mx]
+                if not lagging:
+                    break
+                for p in lagging:
+                    entries[p] = pickle.loads(chans[p].read())
+            err = next((v for (t, _s, v) in entries.values()
+                        if t == "err"), None)
+            if err is not None:
+                payload = pickle.dumps(("err", mx, err))
+            else:
+                fn_args = [None] * st["nargs"]
+                for pos, v in st["consts"]:
+                    fn_args[pos] = v
+                for pos, (_t, _s, v) in entries.items():
+                    fn_args[pos] = v
+                try:
+                    payload = pickle.dumps(("ok", mx, method(*fn_args)))
+                except Exception as e:  # noqa: BLE001 — to driver
+                    payload = pickle.dumps(
+                        ("err", mx,
+                         f"{st['method']} failed: "
+                         f"{traceback.format_exc()}\n{e!r}"))
+            for out in outs:
+                try:
+                    out.write(payload)
+                except ValueError as e:
+                    # oversize result: the pump must survive and the
+                    # driver must see the cause (the tiny error frame
+                    # always fits)
+                    out.write(pickle.dumps(
+                        ("err", mx,
+                         f"{st['method']} result does not fit the "
+                         f"channel: {e}")))
 
         def loop():
             try:
                 while True:
-                    tag, value = pickle.loads(in_ch.read())
-                    if tag == "err":
-                        out_ch.write(pickle.dumps((tag, value)))
-                        continue
-                    try:
-                        result = method(value)
-                        payload = pickle.dumps(("ok", result))
-                    except Exception as e:  # noqa: BLE001 — to driver
-                        payload = pickle.dumps(
-                            ("err",
-                             f"{method_name} failed: "
-                             f"{traceback.format_exc()}\n{e!r}"))
-                    try:
-                        out_ch.write(payload)
-                    except ValueError as e:
-                        # oversize result: the pump must survive and the
-                        # driver must see the cause (the tiny error
-                        # frame always fits)
-                        out_ch.write(pickle.dumps(
-                            ("err", f"{method_name} result does not fit "
-                                    f"the channel: {e}")))
+                    for item in prepared:
+                        run_stage(*item)
             except ChannelClosedError:
                 pass
             finally:
-                in_ch.close()
-                out_ch.close()
+                for ch in attached.values():
+                    ch.close()
 
         threading.Thread(target=loop, daemon=True,
-                         name=f"dag-{method_name}").start()
+                         name="dag-graph").start()
         return "started"
 
     @staticmethod

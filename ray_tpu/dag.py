@@ -4,18 +4,29 @@ Reference: `python/ray/dag/` — `.bind()` builds a lazy `DAGNode` graph
 (`dag_node.py`), `dag.execute()` walks it submitting tasks with upstream
 ObjectRefs as arguments, and `experimental_compile` lowers repeated
 executions onto pre-allocated channels (`compiled_dag_node.py:291`,
-mutable plasma + NCCL).
+mutable plasma + NCCL). Arbitrary graphs compile: fan-out (one producer,
+many consumers), fan-in (multi-arg methods), shared nodes, and
+`MultiOutputNode` — the same surface the reference's accelerated DAGs
+support for e.g. pipeline-parallel actor graphs.
 
-TPU-first delta for the compiled path (SURVEY.md §7.1): instead of
-NCCL p2p channels, a compiled ray_tpu DAG of pure-JAX stages fuses the
-whole graph into ONE jitted function with buffer donation — XLA keeps
-intermediates on-device and schedules the transfers, which on TPU is the
-channel layer (ICI moves arrays between sharded stages inside the jit).
+TPU-first delta for the compiled path (SURVEY.md §7.1): a compiled
+ray_tpu DAG of pure-JAX stages fuses the whole graph into ONE jitted
+function with buffer donation — XLA keeps intermediates on-device and
+schedules the transfers, which on TPU is the channel layer (ICI moves
+arrays between sharded stages inside the jit). Actor graphs lower onto
+seqlock shm channels (`ray_tpu/experimental/channel.py`): one channel
+per EDGE, so a fan-out producer writes each consumer's channel and a
+fan-in consumer reads one channel per argument.
+
+Every frame on a channel is ``(tag, seq, value)`` where ``seq`` is the
+driver's execution counter: after a timeout the driver simply bumps the
+counter and readers discard stale frames, so a slow execution can never
+desynchronize the pipeline into returning a previous result.
 """
 
 from __future__ import annotations
 
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import ray_tpu
 
@@ -52,8 +63,10 @@ class DAGNode:
         cache[id(self)] = ref
         return ref
 
-    def experimental_compile(self) -> "CompiledDAG":
-        return CompiledDAG(self)
+    def experimental_compile(self, *, submit_timeout: float = 60.0,
+                             capacity: int = 8 << 20) -> "CompiledDAG":
+        return CompiledDAG(self, submit_timeout=submit_timeout,
+                           capacity=capacity)
 
 
 class InputNode:
@@ -68,6 +81,24 @@ class InputNode:
         return root_args[self._index]
 
 
+class MultiOutputNode:
+    """Marks several DAG leaves as the graph's outputs (reference
+    `python/ray/dag/output_node.py`): `execute()` returns a list of
+    refs, the compiled form returns a list of values."""
+
+    def __init__(self, nodes: Sequence[DAGNode]):
+        self._nodes = list(nodes)
+
+    def execute(self, *root_args) -> List[Any]:
+        cache: Dict[int, Any] = {}
+        return [n._execute(cache, root_args) for n in self._nodes]
+
+    def experimental_compile(self, *, submit_timeout: float = 60.0,
+                             capacity: int = 8 << 20) -> "CompiledDAG":
+        return CompiledDAG(self, submit_timeout=submit_timeout,
+                           capacity=capacity)
+
+
 def bind(remote_fn, *args, **kwargs) -> DAGNode:
     """fn.bind(...) equivalent for this framework's RemoteFunction /
     ActorMethod objects."""
@@ -80,81 +111,163 @@ class CompiledDAG:
     - a linear chain of pure-JAX stages fuses into ONE jitted function
       with donated buffers (the TPU path: XLA owns the inter-stage
       transfers over ICI);
-    - a linear chain of ACTOR METHOD calls lowers onto pre-allocated
+    - a graph of ACTOR METHOD calls — any fan-out/fan-in/diamond shape,
+      including `MultiOutputNode` — lowers onto pre-allocated
       shared-memory channels between the actor processes (reference
-      aDAG: `experimental_mutable_object_manager.h:37`,
-      `python/ray/experimental/channel/shared_memory_channel.py`) —
-      each execute() writes the input buffer and reads the output
-      buffer, with NO per-call task submission;
+      aDAG: `compiled_dag_node.py:291`,
+      `python/ray/experimental/channel/shared_memory_channel.py`): one
+      channel per edge, one pump thread per actor executing its nodes
+      in topological order, NO per-call task submission;
     - anything else falls back to cached lazy execution.
     """
 
-    def __init__(self, dag: DAGNode):
+    def __init__(self, dag, *, submit_timeout: float = 60.0,
+                 capacity: int = 8 << 20):
         self._dag = dag
         self._jitted = None
         self._channels = None
-        jax_fns = self._extract_pure_jax_chain(dag)
-        if jax_fns is not None:
-            import jax
+        self._seq = 0
+        self._timeout = submit_timeout
+        if isinstance(dag, DAGNode):
+            jax_fns = self._extract_pure_jax_chain(dag)
+            if jax_fns is not None:
+                import jax
 
-            def fused(x):
-                for fn in jax_fns:
-                    x = fn(x)
-                return x
+                def fused(x):
+                    for fn in jax_fns:
+                        x = fn(x)
+                    return x
 
-            # donate the input: intermediates stay on device, XLA owns
-            # the buffers end to end
-            self._jitted = jax.jit(fused, donate_argnums=(0,))
-            return
-        actor_chain = self._extract_actor_chain(dag)
-        if actor_chain is not None:
-            self._setup_channels(actor_chain)
+                # donate the input: intermediates stay on device, XLA
+                # owns the buffers end to end
+                self._jitted = jax.jit(fused, donate_argnums=(0,))
+                return
+        plan = self._extract_actor_graph(dag)
+        if plan is not None:
+            try:
+                self._setup_channels(plan, capacity)
+            except Exception:
+                self.teardown()
+                raise
+
+    # -- graph extraction --------------------------------------------------
 
     @staticmethod
-    def _extract_actor_chain(dag: DAGNode):
-        """A linear chain of single-arg actor-method calls rooted at an
-        InputNode -> [(handle, method_name), ...] upstream-first."""
+    def _extract_actor_graph(dag):
+        """Topologically-ordered plan for a graph whose every node is an
+        actor-method call with positional args only. Returns None when
+        any node doesn't fit (the lazy path still runs it)."""
         from ray_tpu._private.worker_api import ActorMethod
 
-        chain = []
-        node: Any = dag
-        while isinstance(node, DAGNode):
-            m = node._fn
-            if not isinstance(m, ActorMethod) or node._kwargs \
-                    or len(node._args) != 1:
-                return None
-            chain.append((m._handle, m._name))
-            node = node._args[0]
-        if not isinstance(node, InputNode) or not chain:
+        outputs = dag._nodes if isinstance(dag, MultiOutputNode) else [dag]
+        if not outputs or not all(isinstance(n, DAGNode) for n in outputs):
             return None
-        chain.reverse()
-        return chain
+        order: List[DAGNode] = []
+        seen: Dict[int, bool] = {}
 
-    def _setup_channels(self, chain, capacity: int = 8 << 20):
-        """Allocate n+1 shm channels (driver->s0->s1->...->driver) and
-        install the pump loop on every actor. The install call attaches
-        the channels inside each actor — an actor on another node fails
-        here, loudly, at compile time (shm channels are same-node; the
-        cross-node story is the jitted path where ICI moves arrays)."""
-        import ray_tpu
+        def visit(node: DAGNode):
+            if id(node) in seen:
+                if not seen[id(node)]:
+                    raise ValueError("cycle in DAG")
+                return True
+            seen[id(node)] = False
+            if not isinstance(node._fn, ActorMethod) or node._kwargs:
+                return False
+            if not any(isinstance(a, (DAGNode, InputNode))
+                       for a in node._args):
+                # an all-constant node has no execution trigger on the
+                # channel plane — leave such graphs to the lazy path
+                return False
+            for a in node._args:
+                if isinstance(a, DAGNode) and not visit(a):
+                    return False
+            seen[id(node)] = True
+            order.append(node)
+            return True
+
+        for out in outputs:
+            if not visit(out):
+                return None
+        return {"order": order, "outputs": outputs}
+
+    def _setup_channels(self, plan, capacity: int):
+        """Allocate one channel per edge and install each actor's pump.
+
+        Edges: driver -> node (InputNode args), node -> node (DAGNode
+        args; a producer consumed by k nodes writes k channels), and
+        output node -> driver. The install call attaches the channels
+        inside each actor — an actor on another node fails here, loudly,
+        at compile time (shm channels are same-node; the cross-node
+        story is the jitted path where ICI moves arrays)."""
         from ray_tpu._private.worker_api import ActorMethod
         from ray_tpu.experimental.channel import ShmChannel
 
-        names = [ShmChannel.make_name(i) for i in range(len(chain) + 1)]
-        self._channels = [ShmChannel.create(n, capacity) for n in names]
+        order: List[DAGNode] = plan["order"]
+        outputs: List[DAGNode] = plan["outputs"]
+        # self._channels from the first allocation on: a mid-setup
+        # failure (ENOSPC on /dev/shm, wrong-node actor at install) must
+        # reach teardown(), or the already-created segments leak until
+        # reboot
+        channels: List[ShmChannel] = []
+        self._channels = channels
+        names = iter(range(1 << 30))
+
+        def new_channel() -> Tuple[str, ShmChannel]:
+            name = ShmChannel.make_name(next(names))
+            ch = ShmChannel.create(name, capacity)
+            channels.append(ch)
+            return name, ch
+
+        # per-node stage descriptor + the out-channel lists (filled as
+        # consumers claim their input edges)
+        descs: Dict[int, dict] = {}
+        for node in order:
+            descs[id(node)] = {
+                "method": node._fn._name,
+                "nargs": len(node._args),
+                "ins": [],     # (argpos, channel name)
+                "consts": [],  # (argpos, value)
+                "outs": [],    # channel names
+            }
+        self._input_channels: List[Tuple[int, ShmChannel]] = []
+        for node in order:
+            d = descs[id(node)]
+            for pos, a in enumerate(node._args):
+                if isinstance(a, DAGNode):
+                    name, _ch = new_channel()
+                    descs[id(a)]["outs"].append(name)
+                    d["ins"].append((pos, name))
+                elif isinstance(a, InputNode):
+                    name, ch = new_channel()
+                    self._input_channels.append((a._index, ch))
+                    d["ins"].append((pos, name))
+                else:
+                    d["consts"].append((pos, a))
+        self._output_channels: List[ShmChannel] = []
+        for node in outputs:
+            name, ch = new_channel()
+            descs[id(node)]["outs"].append(name)
+            self._output_channels.append(ch)
+        self._single_output = not isinstance(self._dag, MultiOutputNode)
+
+        # group stages by hosting actor, preserving topological order
+        by_actor: Dict[bytes, dict] = {}
+        for node in order:
+            handle = node._fn._handle
+            ent = by_actor.setdefault(
+                handle._actor_id.binary(),
+                {"handle": handle, "stages": []})
+            ent["stages"].append(descs[id(node)])
+
         acks = [
-            ActorMethod(handle, "__ray_tpu_channel_loop__").remote(
-                names[i], names[i + 1], method_name)
-            for i, (handle, method_name) in enumerate(chain)
+            ActorMethod(ent["handle"], "__ray_tpu_channel_graph__").remote(
+                ent["stages"])
+            for ent in by_actor.values()
         ]
-        try:
-            got = ray_tpu.get(acks, timeout=60)
-            if got != ["started"] * len(chain):
-                raise RuntimeError(
-                    f"channel-loop install returned {got!r}")
-        except Exception:
-            self.teardown()
-            raise
+        got = ray_tpu.get(acks, timeout=60)
+        if got != ["started"] * len(by_actor):
+            raise RuntimeError(
+                f"channel-graph install returned {got!r}")
 
     def teardown(self):
         """Shut the channels down; stage threads exit at their next
@@ -190,21 +303,46 @@ class CompiledDAG:
         chain.reverse()
         return chain
 
-    def execute(self, *root_args):
+    def execute(self, *root_args, timeout: Optional[float] = None):
         if self._jitted is not None:
             return self._jitted(*root_args)
         if self._channels is not None:
-            import pickle
+            return self._execute_channels(root_args, timeout)
+        out = self._dag.execute(*root_args)
+        return ray_tpu.get(out, timeout=timeout)
 
-            self._channels[0].write(
-                pickle.dumps(("ok", root_args[0])), timeout=60.0)
-            tag, value = pickle.loads(
-                self._channels[-1].read(timeout=60.0))
+    def _execute_channels(self, root_args: tuple,
+                          timeout: Optional[float]):
+        import pickle
+        import time
+
+        timeout = self._timeout if timeout is None else timeout
+        self._seq += 1
+        seq = self._seq
+        deadline = time.monotonic() + timeout
+        frames: Dict[int, bytes] = {}
+        for idx, ch in self._input_channels:
+            # one pickle per distinct input index, not per consumer edge
+            frame = frames.get(idx)
+            if frame is None:
+                frame = frames[idx] = pickle.dumps(
+                    ("ok", seq, root_args[idx]))
+            ch.write(frame, timeout=max(0.0, deadline - time.monotonic()))
+        results = []
+        for ch in self._output_channels:
+            while True:
+                tag, s, value = pickle.loads(
+                    ch.read(timeout=max(0.0, deadline - time.monotonic())))
+                if s == seq:
+                    break
+                # stale frame from an execution the driver timed out on:
+                # discard — the seq tag is what keeps a slow pipeline
+                # from desynchronizing into returning old results
             if tag == "err":
                 raise ray_tpu.RayTaskError(
                     f"compiled DAG stage failed:\n{value}")
-            return value
-        return ray_tpu.get(self._dag.execute(*root_args))
+            results.append(value)
+        return results[0] if self._single_output else results
 
 
 def jax_stage(fn):
