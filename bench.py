@@ -458,8 +458,12 @@ def bench_control_plane():
         ray_tpu.shutdown()
 
     # -- phase C: actors -------------------------------------------------
-    n_actors = max(1, min(8, ncpu))
-    ray_tpu.init(num_cpus=max(2, n_actors),
+    # reference actor_multi2 shape (`ray_perf.py:222`): cpu_count()//2
+    # actors, 4 caller worker processes — the cluster must actually hold
+    # them all or the callers starve on leases and the row measures the
+    # self-imposed cap instead of the dispatch path
+    n_actors = max(1, ncpu // 2)
+    ray_tpu.init(num_cpus=max(2, n_actors + 6),
                  object_store_memory=256 << 20)
     try:
         @ray_tpu.remote
@@ -511,8 +515,9 @@ def bench_control_plane():
         ray_tpu.shutdown()
 
     # -- phase D: multi-client task submission (reference `multi_task`:
-    # m=4 actor clients each submitting n noop tasks) --------------------
-    ray_tpu.init(num_cpus=max(4, min(12, ncpu)),
+    # m=4 actor clients each submitting n noop tasks, on a cluster with
+    # every core available — the reference baseline ran uncapped) -------
+    ray_tpu.init(num_cpus=max(4, ncpu),
                  object_store_memory=256 << 20)
     try:
         @ray_tpu.remote

@@ -156,7 +156,7 @@ class ObjectStore:
         """Store pre-framed bytes verbatim (used by object transfer)."""
         data = memoryview(data)
         buf = self.create_buffer(object_id, data.nbytes)
-        buf[:] = data
+        serialization._fast_copy(buf, data)
         self.seal(object_id)
         self.release(object_id)
         return data.nbytes

@@ -67,4 +67,11 @@ def load_shm_store() -> ctypes.CDLL:
     lib.ss_detach.restype = ctypes.c_int
     lib.ss_unlink_store.argtypes = [ctypes.c_char_p]
     lib.ss_unlink_store.restype = ctypes.c_int
+    lib.ss_memcpy_mt.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_void_p,
+        ctypes.c_uint64,
+        ctypes.c_int,
+    ]
+    lib.ss_memcpy_mt.restype = None
     return lib

@@ -28,6 +28,8 @@
 #include <cstdint>
 #include <cstring>
 #include <ctime>
+#include <thread>
+#include <vector>
 
 #include <fcntl.h>
 #include <pthread.h>
@@ -537,6 +539,42 @@ void ss_stats(int handle, uint64_t* capacity, uint64_t* allocated,
   *capacity = s->hdr->capacity;
   *allocated = s->hdr->allocated;
   *num_objects = s->hdr->num_objects;
+}
+
+// Parallel memcopy for large object payloads (reference: the plasma
+// client's threaded memcopy, `src/ray/object_manager/plasma/client.cc`
+// memcopy_threads — a single memcpy thread cannot saturate multi-channel
+// DRAM, so big puts fan the copy out over chunks). Chunks are 64-byte
+// aligned so no two threads share a cache line. `threads <= 0` picks
+// a count from the hardware (bounded — put callers may be many
+// concurrent processes, and oversubscribing thrashes).
+void ss_memcpy_mt(void* dst, const void* src, uint64_t n, int threads) {
+  constexpr uint64_t kMinChunk = 4ULL << 20;  // below this, plain memcpy
+  if (threads <= 0) {
+    unsigned hw = std::thread::hardware_concurrency();
+    threads = static_cast<int>(hw > 8 ? 8 : (hw ? hw : 1));
+  }
+  uint64_t want = n / kMinChunk;
+  if (static_cast<uint64_t>(threads) > want) threads = static_cast<int>(want);
+  if (threads <= 1) {
+    memcpy(dst, src, n);
+    return;
+  }
+  // ceil division: floor would drop the tail whenever n/threads is
+  // already 64-aligned and n isn't divisible by threads
+  uint64_t chunk = ((n + threads - 1) / threads + 63) & ~63ULL;
+  std::vector<std::thread> pool;
+  pool.reserve(threads - 1);
+  uint64_t off = chunk;
+  for (int t = 1; t < threads && off < n; ++t, off += chunk) {
+    uint64_t len = off + chunk > n ? n - off : chunk;
+    pool.emplace_back([=] {
+      memcpy(static_cast<uint8_t*>(dst) + off,
+             static_cast<const uint8_t*>(src) + off, len);
+    });
+  }
+  memcpy(dst, src, chunk > n ? n : chunk);  // leader copies chunk 0 inline
+  for (auto& th : pool) th.join();
 }
 
 // Byte offset of the data region from the start of the shm file (so Python
