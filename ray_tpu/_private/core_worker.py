@@ -657,6 +657,16 @@ class CoreWorker:
         store hold more live data than its shm capacity."""
         from ray_tpu._private.object_store import ObjectStoreFullError
 
+        # Grace retries before spilling: a concurrent putter's unpin is
+        # usually in flight (release -> raylet) when the arena looks
+        # full, and a few ms of patience turns a disk spill into an
+        # in-memory eviction. Only after the grace window does the
+        # raylet get asked to spill pinned objects to disk.
+        for delay in (0.002, 0.01):
+            try:
+                return write_fn()
+            except ObjectStoreFullError:
+                time.sleep(delay)
         for _ in range(4):
             try:
                 return write_fn()
