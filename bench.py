@@ -337,6 +337,89 @@ print(json.dumps({
     return json.loads(proc.stdout.strip().splitlines()[-1])
 
 
+def bench_scale_envelope():
+    """Scale-envelope rows (reference `release/benchmarks/README.md`:
+    2k+ nodes / 40k+ actors / 10k+ simultaneous tasks / 1k+ PGs across
+    a 64-node cluster; harnesses `distributed/test_many_{actors,tasks,
+    pgs}.py`). Scaled to one box: the raylets run in virtual-worker
+    mode (`RAY_TPU_VIRTUAL_WORKERS` — in-process stub workers, real
+    GCS/scheduler/gossip/lease machinery, the same trivial workload the
+    reference envelope uses). Sizes scale with the host so the 1-core
+    build box smoke-runs the same phase the driver box runs big."""
+    import ray_tpu
+    from ray_tpu._private.node import Cluster
+
+    ncpu = os.cpu_count() or 1
+    n_raylets = max(8, min(50, 3 * ncpu))
+    n_actors = max(300, min(5000, 100 * ncpu))
+    n_tasks = max(2000, min(20000, 400 * ncpu))
+    n_pgs = max(20, min(200, 4 * ncpu))
+    out = {}
+    os.environ["RAY_TPU_VIRTUAL_WORKERS"] = "1"
+    cluster = None
+    try:
+        cluster = Cluster(head_resources={"CPU": 16.0},
+                          object_store_memory=16 << 20)
+        for _ in range(n_raylets - 1):
+            cluster.add_node({"CPU": 16.0},
+                             object_store_memory=16 << 20)
+        ray_tpu.init(address=cluster.gcs_addr)
+
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            if len([n for n in ray_tpu.nodes() if n["Alive"]]) \
+                    == n_raylets:
+                break
+            time.sleep(0.5)
+        out["scale_num_raylets"] = len(
+            [n for n in ray_tpu.nodes() if n["Alive"]])
+
+        @ray_tpu.remote(num_cpus=0.1)
+        class A:
+            def ping(self):
+                return None
+
+        start = time.perf_counter()
+        actors = [A.remote() for _ in range(n_actors)]
+        ray_tpu.get([a.ping.remote() for a in actors], timeout=900)
+        out["scale_actors_launched_per_sec"] = n_actors / (
+            time.perf_counter() - start)
+        out["scale_num_actors"] = n_actors
+
+        @ray_tpu.remote(num_cpus=1.0)
+        def noop():
+            return None
+
+        start = time.perf_counter()
+        refs = [noop.remote() for _ in range(n_tasks)]
+        ray_tpu.get(refs, timeout=900)
+        out["scale_tasks_per_sec"] = n_tasks / (
+            time.perf_counter() - start)
+        out["scale_num_tasks"] = n_tasks
+
+        start = time.perf_counter()
+        pgs = [ray_tpu.placement_group([{"CPU": 0.5}, {"CPU": 0.5}],
+                                       strategy="PACK")
+               for _ in range(n_pgs)]
+        created = sum(1 for pg in pgs if pg.ready(timeout=300))
+        for pg in pgs:
+            ray_tpu.remove_placement_group(pg)
+        # only PGs that actually reached CREATED count toward the rate
+        out["scale_pgs_per_sec"] = created / (time.perf_counter() - start)
+        out["scale_num_pgs"] = created
+        if created != n_pgs:
+            out["scale_pgs_failed"] = n_pgs - created
+        return out
+    finally:
+        os.environ.pop("RAY_TPU_VIRTUAL_WORKERS", None)
+        try:
+            ray_tpu.shutdown()
+        except Exception:  # noqa: BLE001
+            pass
+        if cluster is not None:
+            cluster.shutdown()
+
+
 def bench_control_plane():
     """Each phase gets an isolated cluster sized to the machine: worker
     processes beyond the core count thrash instead of pipelining, and a
@@ -627,6 +710,17 @@ def main():
             suite["control_plane_error"] = repr(e)[:300]
     else:
         suite["control_plane"] = {"skipped": "budget"}
+
+    if remaining() > 90 or not on_tpu:
+        try:
+            sc = bench_scale_envelope()
+            for k, v in sc.items():
+                suite[k] = {"value": round(v, 2), "vs_baseline": None} \
+                    if isinstance(v, float) else v
+        except Exception as e:  # noqa: BLE001
+            suite["scale_envelope_error"] = repr(e)[:300]
+    else:
+        suite["scale_envelope"] = {"skipped": "budget"}
 
     if "tokens_per_sec_per_chip" in gpt2 and gpt2.get("platform") == "tpu":
         headline = {

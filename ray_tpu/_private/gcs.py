@@ -833,7 +833,13 @@ class GcsServer:
         if addr:
             try:
                 worker = await self.clients.get(addr)
-                await worker.notify("exit_worker", {"reason": reason})
+                # worker_id lets a virtual-worker raylet (which serves
+                # many workers at one address) identify whose lease to
+                # release; real workers ignore the extra field
+                await worker.notify("exit_worker", {
+                    "reason": reason,
+                    "worker_id": info.get("worker_id"),
+                })
             except (ConnectionLost, OSError, RpcError):
                 pass
         await self._publish_actor(actor_id)

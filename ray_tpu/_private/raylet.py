@@ -89,6 +89,11 @@ class Raylet:
         self.config = config or Config.from_env()
         self.node_id = NodeID.from_random()
         self.gcs_addr = gcs_addr
+        # scale-envelope mode: leases satisfied by in-process stub
+        # workers (see the virtual-workers section below)
+        self.virtual_workers = \
+            os.environ.get("RAY_TPU_VIRTUAL_WORKERS") == "1"
+        self._none_frame: bytes | None = None
         self.server = RpcServer(host, port)
         self.clients = ClientPool()
         self.session_dir = session_dir
@@ -922,6 +927,10 @@ class Raylet:
                                runtime_env: dict | None = None):
         job_id, chips = key[0], key[1]
         starting_key = starting_key or key
+        if self.virtual_workers:
+            self._register_virtual_worker(job_id, chips, runtime_env,
+                                          starting_key)
+            return
         try:
             proc = await self._spawn_worker(job_id, chips, runtime_env)
         except Exception as e:
@@ -947,6 +956,86 @@ class Raylet:
                              "error": f"runtime_env setup failed: {e}"})
             return
         self._spawned_procs.append((proc, key, starting_key))
+
+    # ------------------------------------------------------------------
+    # virtual workers (scale-envelope mode)
+    #
+    # RAY_TPU_VIRTUAL_WORKERS=1 makes this raylet satisfy leases with
+    # in-process stub workers instead of spawning real processes: the
+    # raylet itself serves the worker RPC surface (push_task /
+    # push_task_batch) at its own address, replying a packaged None per
+    # return. The control plane — GCS tables, scheduler, gossip,
+    # leases, placement groups — runs exactly as in production, which
+    # is what the reference's scalability envelope measures
+    # (release/benchmarks/README.md: 2k nodes / 40k actors / 10k tasks
+    # with a TRIVIAL workload); only the workload processes are
+    # virtualized so one box can host 50+ raylets and 5k+ actors.
+    # ------------------------------------------------------------------
+
+    def _register_virtual_worker(self, job_id: bytes, chips: tuple,
+                                 runtime_env: dict | None,
+                                 starting_key: tuple):
+        from ray_tpu._private.runtime_env import env_hash as _env_hash
+
+        worker = WorkerHandle(
+            worker_id=os.urandom(16),
+            addr=self.server.address,
+            pid=0,
+            job_id=job_id,
+            tpu_chips=tuple(chips),
+            env_hash=_env_hash(runtime_env),
+        )
+        self._starting[starting_key] = max(
+            0, self._starting.get(starting_key, 0) - 1)
+        key = self._pool_key(worker.job_id, worker.tpu_chips,
+                             worker.env_hash)
+        self._workers[worker.worker_id] = worker
+        self._idle.setdefault(key, []).append(worker)
+        self._dispatch()
+
+    def _virtual_reply(self, spec: task_mod.TaskSpec) -> dict:
+        if self._none_frame is None:
+            from ray_tpu._private import serialization
+
+            pickled, buffers = serialization.serialize(None)
+            self._none_frame = serialization.pack(pickled, buffers)
+        from ray_tpu._private.ids import TaskID
+
+        returns = []
+        for i in range(spec.num_returns):
+            oid = ObjectID.for_task_return(TaskID(spec.task_id), i)
+            returns.append([oid.binary(), "v", self._none_frame])
+        return {"returns": returns}
+
+    async def rpc_push_task(self, req):
+        if not self.virtual_workers:
+            return {"error": True,
+                    "error_msg": "raylet does not execute tasks"}
+        return self._virtual_reply(task_mod.TaskSpec.from_wire(req["spec"]))
+
+    async def rpc_push_task_batch(self, req):
+        if not self.virtual_workers:
+            return [{"error": True,
+                     "error_msg": "raylet does not execute tasks"}
+                    for _ in req["specs"]]
+        return [self._virtual_reply(task_mod.TaskSpec.from_wire(w))
+                for w in req["specs"]]
+
+    async def rpc_exit_worker(self, req):
+        # Virtual workers share the raylet's address, so a kill_actor
+        # notify lands here. There is no process to exit, but the
+        # worker's lease (and any chips it holds) must still be
+        # released or actor kill/create churn leaks node resources.
+        wid = req.get("worker_id")
+        if self.virtual_workers and wid:
+            worker = self._workers.get(wid)
+            if worker is not None:
+                # the GCS initiated this exit and already marked the
+                # actor dead — drop the mapping so the death handler
+                # doesn't re-report it
+                self._actor_workers.pop(wid, None)
+                await self._on_worker_death(worker)
+        return None
 
     def _grant(self, lease: Lease, worker: WorkerHandle):
         lease.worker = worker

@@ -116,13 +116,19 @@ def pick_node(
         and local.utilization() <= spread_threshold
     ):
         return local
-    return _best_fit(nodes, spec_resources)
+    return _best_fit(nodes, spec_resources, rng)
 
 
-def _best_fit(nodes: List[NodeResources], demand: Dict[str, float]):
+def _best_fit(nodes: List[NodeResources], demand: Dict[str, float],
+              rng: random.Random | None = None):
     fitting = [n for n in nodes if n.fits_now(demand)]
     if not fitting:
         return None
+    # Random tiebreak: min() on equal utilizations is stable, which
+    # would pile every weightless placement (actors release their CPU
+    # after creation, so utilization never rises between heartbeats)
+    # onto whichever node happens to list first.
+    (rng or random).shuffle(fitting)
     return min(fitting, key=lambda n: n.utilization())
 
 

@@ -64,13 +64,15 @@ def _pick_block_q(t: int) -> int:
 def _pick_block_k(t: int) -> int:
     """Measured policy (GPT-2 125M on v5e, tok/s, same session):
     at T=1024 whole-KV wins (117.7k vs 108.2k for bk=512 — chunking
-    overhead beats the 25% causal skip at short context); at T=4096
-    bk=2048 wins (74.8k vs 66.4k whole-KV — there the skipped upper
-    triangle dominates). So: whole-KV up to 2048, chunks of 2048 beyond.
+    overhead beats the 25% causal skip at short context); at T=4096 the
+    r5 sweep measured bq=512: bk=1024 74.1k > bk=2048 72.7k > bk=512
+    63.9k — finer chunks skip more of the upper triangle (executed
+    cols 20480 vs 24576 of 18432 useful) until per-chunk overhead wins.
+    So: whole-KV up to 2048, chunks of 1024 beyond.
     """
     if t <= 2048:
         return t
-    for cand in (2048, 1024, 512, 256, 128):
+    for cand in (1024, 512, 256, 128):
         if t % cand == 0:
             return cand
     return 0
@@ -96,6 +98,19 @@ def _chunk_scores(q, k, scale, causal, qi, ki, block_q, block_k):
     return s
 
 
+def _run_causal(run_pred, body):
+    """Run `body(masked=True)` under the chunk-skip predicate. A
+    diagonal/below-diagonal mask split was tried in r5 (mask-free body
+    for chunks strictly below the diagonal): consistently SLOWER
+    end-to-end (73.5k vs 74.7k tok/s at T=4096, A/B in one session) —
+    the duplicated pl.when bodies cost more than the iota+where mask
+    they avoid, so every running chunk takes the masked path."""
+
+    @pl.when(run_pred)
+    def _():
+        body(masked=True)
+
+
 # --------------------------------------------------------------------------
 # forward
 # --------------------------------------------------------------------------
@@ -112,17 +127,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s, *,
         l_s[...] = jnp.zeros_like(l_s)
         acc_s[...] = jnp.zeros_like(acc_s)
 
-    # causal chunk skip: a KV chunk starting past this Q block's last row
-    # is fully masked — no compute (this is where the long-context FLOPs
-    # go from O(T^2) to O(T^2/2))
-    run = (ki * block_k <= qi * block_q + block_q - 1) if causal else True
-
-    @pl.when(run)
-    def _():
+    def body(masked):
         q = q_ref[0, 0, :, :]
         k = k_ref[0, 0, :, :]
         v = v_ref[0, 0, :, :]
-        s = _chunk_scores(q, k, scale, causal, qi, ki, block_q, block_k)
+        s = _chunk_scores(q, k, scale, masked, qi, ki, block_q, block_k)
         m_prev = m_s[:, :1]                                   # [bq, 1]
         m_new = jnp.maximum(m_prev, jnp.max(s, 1, keepdims=True))
         corr = jnp.exp(m_prev - m_new)                        # [bq, 1]
@@ -133,6 +142,15 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s, *,
             preferred_element_type=jnp.float32)               # [bq, d]
         m_s[...] = jnp.broadcast_to(m_new, m_s.shape)
         l_s[...] = jnp.broadcast_to(l_new, l_s.shape)
+
+    if causal:
+        # causal chunk skip: a KV chunk starting past this Q block's
+        # last row is fully masked — no compute (this is where the
+        # long-context FLOPs go from O(T^2) to O(T^2/2))
+        run = ki * block_k <= qi * block_q + block_q - 1
+        _run_causal(run, body)
+    else:
+        body(masked=False)
 
     @pl.when(ki == nk - 1)
     def _():
@@ -277,17 +295,14 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     def _():
         dq_s[...] = jnp.zeros_like(dq_s)
 
-    run = (ki * block_k <= qi * block_q + block_q - 1) if causal else True
-
-    @pl.when(run)
-    def _():
+    def body(masked):
         q = q_ref[0, 0, :, :]
         k = k_ref[0, 0, :, :]
         v = v_ref[0, 0, :, :]
         do = do_ref[0, 0, :, :]
         lse = lse_ref[0, 0, :, :]                             # [bq, 1]
         delta = delta_ref[0, 0, :, :]                         # [bq, 1]
-        s = _chunk_scores(q, k, scale, causal, qi, ki, block_q, block_k)
+        s = _chunk_scores(q, k, scale, masked, qi, ki, block_q, block_k)
         p = jnp.exp(s - lse)                                  # [bq, bk]
         dp = jax.lax.dot_general(
             do.astype(v.dtype), v, (((1,), (1,)), ((), ())),
@@ -296,6 +311,12 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_s[...] += jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32) * scale       # [bq, d]
+
+    if causal:
+        run = ki * block_k <= qi * block_q + block_q - 1
+        _run_causal(run, body)
+    else:
+        body(masked=False)
 
     @pl.when(ki == nk - 1)
     def _():
@@ -318,19 +339,14 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_s[...] = jnp.zeros_like(dk_s)
         dv_s[...] = jnp.zeros_like(dv_s)
 
-    # causal skip (roles swapped): a Q block entirely above this KV
-    # chunk contributes nothing to its dK/dV
-    run = (qi * block_q + block_q - 1 >= ki * block_k) if causal else True
-
-    @pl.when(run)
-    def _():
+    def body(masked):
         q = q_ref[0, 0, :, :]
         k = k_ref[0, 0, :, :]
         v = v_ref[0, 0, :, :]
         do = do_ref[0, 0, :, :]
         lse = lse_ref[0, 0, :, :]
         delta = delta_ref[0, 0, :, :]
-        s = _chunk_scores(q, k, scale, causal, qi, ki, block_q, block_k)
+        s = _chunk_scores(q, k, scale, masked, qi, ki, block_q, block_k)
         p = jnp.exp(s - lse)                                  # [bq, bk]
         dp = jax.lax.dot_general(
             do.astype(v.dtype), v, (((1,), (1,)), ((), ())),
@@ -343,6 +359,14 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             p.astype(do_ref.dtype), do.astype(do_ref.dtype),
             (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)               # [bk, d]
+
+    if causal:
+        # causal skip (roles swapped): a Q block entirely above this KV
+        # chunk contributes nothing to its dK/dV
+        run = qi * block_q + block_q - 1 >= ki * block_k
+        _run_causal(run, body)
+    else:
+        body(masked=False)
 
     @pl.when(jj == nj - 1)
     def _():

@@ -55,6 +55,7 @@ def list_actors(limit: int = 1000) -> List[Dict[str, Any]]:
             "state": a["state"],
             "class_name": a.get("class_name", ""),
             "num_restarts": a.get("num_restarts", 0),
+            "node_id": a["node_id"].hex() if a.get("node_id") else None,
         }
         for a in raw[:limit]
     ]

@@ -1,0 +1,126 @@
+"""Scale-envelope smoke tests: the control plane at many-raylet scale.
+
+Reference: `release/benchmarks/README.md` (2k+ nodes / 40k+ actors /
+10k+ tasks / 1k+ PGs with trivial workloads) and its harnesses
+(`release/benchmarks/distributed/test_many_actors.py`, `test_many_tasks.py`,
+`test_many_pgs.py`). The workload there is trivial by design — the
+envelope measures GCS tables, scheduling, gossip and lease throughput,
+not executor compute — so the raylets run in RAY_TPU_VIRTUAL_WORKERS
+mode: leases are satisfied by in-process stub workers and one box can
+host a whole cluster's control plane. bench.py's scale phase runs the
+same shapes bigger on the driver box; these are the smoke sizes.
+
+Own file: needs its own cluster with the virtual-workers env set before
+any raylet spawns.
+"""
+
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private.node import Cluster
+
+N_RAYLETS = 8
+N_ACTORS = 200
+N_TASKS = 2000
+N_PGS = 20
+
+
+@pytest.fixture(scope="module")
+def virtual_cluster():
+    os.environ["RAY_TPU_VIRTUAL_WORKERS"] = "1"
+    try:
+        cluster = Cluster(head_resources={"CPU": 4.0},
+                          object_store_memory=32 * 1024 * 1024)
+        for _ in range(N_RAYLETS - 1):
+            cluster.add_node({"CPU": 4.0},
+                             object_store_memory=32 * 1024 * 1024)
+        ray_tpu.init(address=cluster.gcs_addr)
+        yield cluster
+        ray_tpu.shutdown()
+        cluster.shutdown()
+    finally:
+        os.environ.pop("RAY_TPU_VIRTUAL_WORKERS", None)
+
+
+def test_gossip_sees_every_raylet(virtual_cluster):
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        nodes = [n for n in ray_tpu.nodes() if n["Alive"]]
+        if len(nodes) == N_RAYLETS:
+            break
+        time.sleep(0.5)
+    assert len([n for n in ray_tpu.nodes() if n["Alive"]]) == N_RAYLETS
+    total = ray_tpu.cluster_resources()
+    assert total.get("CPU") == pytest.approx(4.0 * N_RAYLETS)
+
+
+def test_many_actors_launch_and_call(virtual_cluster):
+    @ray_tpu.remote(num_cpus=0.1)
+    class A:
+        def ping(self):
+            return None
+
+    actors = [A.remote() for _ in range(N_ACTORS)]
+    # every actor landed, was marked ALIVE, and answered one call
+    ray_tpu.get([a.ping.remote() for a in actors], timeout=300)
+    # scheduling spread the fleet across nodes, not one hot raylet
+    from ray_tpu.util.state import list_actors
+
+    infos = [a for a in list_actors(limit=N_ACTORS + 50)
+             if a["state"] == "ALIVE"]
+    nodes = {i["node_id"] for i in infos if i["node_id"]}
+    assert len(nodes) >= N_RAYLETS // 2, nodes
+    # kill/create churn must not leak leases: kill half the fleet and
+    # the freed capacity must come back (virtual exit path)
+    for a in actors[: N_ACTORS // 2]:
+        ray_tpu.kill(a)
+    deadline = time.monotonic() + 60
+    want = 4.0 * N_RAYLETS  # actors hold 0 CPU while alive anyway
+    while time.monotonic() < deadline:
+        if ray_tpu.available_resources().get("CPU", 0) >= want - 1.0:
+            break
+        time.sleep(0.5)
+    assert ray_tpu.available_resources().get("CPU", 0) >= want - 1.0
+
+
+def test_many_queued_tasks_drain(virtual_cluster):
+    @ray_tpu.remote(num_cpus=0.5)
+    def noop():
+        return None
+
+    t0 = time.monotonic()
+    refs = [noop.remote() for _ in range(N_TASKS)]
+    ray_tpu.get(refs, timeout=300)
+    dt = time.monotonic() - t0
+    assert dt < 300
+    # gossip freshness: after the burst, availability converges back
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        avail = ray_tpu.available_resources().get("CPU", 0)
+        if avail >= 4.0 * N_RAYLETS - 1.0:
+            break
+        time.sleep(0.5)
+    assert ray_tpu.available_resources().get("CPU", 0) >= \
+        4.0 * N_RAYLETS - 1.0
+
+
+def test_many_placement_groups(virtual_cluster):
+    pgs = [ray_tpu.placement_group([{"CPU": 0.5}, {"CPU": 0.5}],
+                                   strategy="PACK")
+           for _ in range(N_PGS)]
+    for pg in pgs:
+        assert pg.ready(timeout=120)
+    for pg in pgs:
+        ray_tpu.remove_placement_group(pg)
+    # removal returns the bundles' resources to the pool
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        if ray_tpu.available_resources().get("CPU", 0) >= \
+                4.0 * N_RAYLETS - 1.0:
+            break
+        time.sleep(0.5)
+    assert ray_tpu.available_resources().get("CPU", 0) >= \
+        4.0 * N_RAYLETS - 1.0
