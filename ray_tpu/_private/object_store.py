@@ -205,11 +205,17 @@ class ObjectStore:
         cap = ctypes.c_uint64()
         alloc = ctypes.c_uint64()
         n = ctypes.c_uint32()
-        self._lib.ss_stats(
-            self._h, ctypes.byref(cap), ctypes.byref(alloc), ctypes.byref(n)
+        ref = ctypes.c_uint64()
+        self._lib.ss_stats2(
+            self._h, ctypes.byref(cap), ctypes.byref(alloc),
+            ctypes.byref(n), ctypes.byref(ref)
         )
         return {
             "capacity": cap.value,
             "allocated": alloc.value,
             "num_objects": n.value,
+            # bytes a create CANNOT reclaim (unsealed or still
+            # referenced); `allocated` additionally counts evictable
+            # garbage — use `referenced` for backpressure
+            "referenced": ref.value,
         }

@@ -91,6 +91,12 @@ def read_tfrecords(paths, parallelism: Optional[int] = None) -> Dataset:
     return read_datasource(TFRecordDatasource(paths), parallelism)
 
 
+def read_webdataset(paths, parallelism: Optional[int] = None) -> Dataset:
+    from ray_tpu.data.datasource import WebDatasetDatasource
+
+    return read_datasource(WebDatasetDatasource(paths), parallelism)
+
+
 def read_sql(sql: str, connection_factory,
              parallelism: Optional[int] = None) -> Dataset:
     return read_datasource(SQLDatasource(sql, connection_factory),
@@ -131,4 +137,5 @@ __all__ = [
     "read_sql",
     "read_text",
     "read_tfrecords",
+    "read_webdataset",
 ]

@@ -18,6 +18,14 @@ Block = Dict[str, np.ndarray]
 
 
 def _as_array(values: List[Any]) -> np.ndarray:
+    # bytes columns must stay object-dtype: np.asarray would coerce
+    # equal-length bytes to a fixed-width 'S' dtype, which silently
+    # strips trailing NUL bytes on read-back — fatal for binary
+    # payloads (encoded images etc.)
+    if any(isinstance(v, bytes) for v in values):
+        out = np.empty(len(values), dtype=object)
+        out[:] = values
+        return out
     try:
         return np.asarray(values)
     except ValueError:
@@ -39,7 +47,14 @@ class BlockAccessor:
     def from_rows(rows: List[Dict[str, Any]]) -> Block:
         if not rows:
             return {}
-        cols: Dict[str, List[Any]] = {k: [] for k in rows[0]}
+        # union of every row's keys (first-seen order): heterogeneous
+        # rows (routine in e.g. webdataset shards) must not silently
+        # drop columns absent from the first row; missing values are
+        # None
+        cols: Dict[str, List[Any]] = {}
+        for r in rows:
+            for k in r:
+                cols.setdefault(k, [])
         for r in rows:
             for k in cols:
                 cols[k].append(r.get(k))

@@ -31,6 +31,9 @@ class DataContext:
     use_push_based_shuffle: bool = True
     shuffle_merge_factor: int = 8
     eager_free: bool = True
+    # trace of the most recent actor-pool map stage's autoscaling
+    # decisions ({"peak", "grows", "shrinks"}), written by the executor
+    last_actor_pool_stats: Optional[dict] = None
 
     _instance = None
     _lock = threading.Lock()

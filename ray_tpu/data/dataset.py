@@ -322,6 +322,11 @@ class Dataset:
     def write_tfrecords(self, path: str) -> List[str]:
         return self._write(path, "tfrecords", write_block_tfrecords)
 
+    def write_webdataset(self, path: str) -> List[str]:
+        from ray_tpu.data.datasource import write_block_webdataset
+
+        return self._write(path, "tar", write_block_webdataset)
+
     def __repr__(self) -> str:
         return f"Dataset(op={self._op.name})"
 

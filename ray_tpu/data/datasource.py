@@ -300,3 +300,70 @@ class ArrowDatasource(Datasource):
             part = table.slice(lo, hi - lo)  # capture only the slice
             tasks.append(lambda part=part: BlockAccessor.from_arrow(part))
         return tasks
+
+
+class WebDatasetDatasource(_FileDatasource):
+    """WebDataset-style tar shards (reference:
+    `datasource/webdataset_datasource.py`): each .tar member is named
+    `<key>.<ext>`; members sharing a key form one sample, with columns
+    named by extension. Pure-stdlib tarfile — no webdataset dependency.
+    Text-ish extensions decode to str, `.json` parses, `.cls`/`.id`
+    parse to int when possible; everything else stays bytes (encoded
+    images etc. must not be UTF-8-decoded)."""
+
+    _TEXT_EXTS = {"txt", "text", "caption", "transcript"}
+    _INT_EXTS = {"cls", "id", "label", "index"}
+
+    def _read_file(self, path: str) -> Block:
+        import tarfile
+        from collections import OrderedDict
+
+        samples: "OrderedDict[str, dict]" = OrderedDict()
+        with tarfile.open(path) as tar:
+            for member in tar:
+                if not member.isfile():
+                    continue
+                base = os.path.basename(member.name)
+                key, _, ext = base.partition(".")
+                data = tar.extractfile(member).read()
+                row = samples.setdefault(key, {"__key__": key})
+                ext = ext.lower()
+                if ext == "json":
+                    row[ext] = json.loads(data)
+                elif ext in self._TEXT_EXTS:
+                    row[ext] = data.decode("utf-8")
+                elif ext in self._INT_EXTS:
+                    try:
+                        row[ext] = int(data.decode("utf-8").strip())
+                    except ValueError:
+                        row[ext] = data
+                else:
+                    row[ext] = data
+        return BlockAccessor.from_rows(list(samples.values()))
+
+
+def write_block_webdataset(block: Block, path: str) -> None:
+    """One tar shard per block: each row becomes `<key>.<column>`
+    members (key = row's __key__ or its index)."""
+    import io
+    import tarfile
+
+    acc = BlockAccessor(block)
+    with tarfile.open(path, "w") as tar:
+        for i in range(acc.num_rows()):
+            row = acc.row(i)
+            key = str(row.get("__key__", i))
+            for col, value in row.items():
+                if col == "__key__":
+                    continue
+                if isinstance(value, bytes):
+                    payload = value
+                elif isinstance(value, str):
+                    payload = value.encode("utf-8")
+                elif isinstance(value, (dict, list)):
+                    payload = json.dumps(value).encode("utf-8")
+                else:
+                    payload = str(value).encode("utf-8")
+                info = tarfile.TarInfo(name=f"{key}.{col}")
+                info.size = len(payload)
+                tar.addfile(info, io.BytesIO(payload))

@@ -35,6 +35,15 @@ def _run_transform(transform, block: Block, idx: int = 0) -> Block:
     return transform(block, idx)
 
 
+def _run_read_fused(read_task, transforms, idx: int) -> Block:
+    """Read + fused map chain in ONE task: the intermediate blocks stay
+    in this process (zero copies, no store round-trip)."""
+    block = read_task()
+    for t in transforms:
+        block = t(block, idx)
+    return block
+
+
 def _count_rows(block: Block) -> int:
     return BlockAccessor(block).num_rows()
 
@@ -224,7 +233,11 @@ class _ResourceBudget:
             if cw is not None and cw.store is not None:
                 st = cw.store.stats()
                 if st["capacity"]:
-                    frac = st["allocated"] / st["capacity"]
+                    # referenced (unevictable) bytes, not allocated: the
+                    # arena may be full of evictable garbage a create
+                    # would reclaim — stalling on that is a false stall
+                    used = st.get("referenced", st["allocated"])
+                    frac = used / st["capacity"]
                     self._occ_high = \
                         frac > self.ctx.store_backpressure_fraction
         except Exception:
@@ -315,6 +328,13 @@ class StreamingExecutor:
             rf = self._remote.get(_run_read)
             yield from self._windowed_iter(
                 (lambda t=t: rf.remote(t)) for t in tasks)
+        elif isinstance(op, L.FusedRead):
+            tasks = op.datasource.get_read_tasks(op.parallelism)
+            rf = self._remote.get(_run_read_fused)
+            transforms = op.transforms
+            yield from self._windowed_iter(
+                (lambda t=t, i=i: rf.remote(t, transforms, i))
+                for i, t in enumerate(tasks))
         elif isinstance(op, L.AbstractMap) and op.compute is None:
             transform = op.make_transform()
             rf = self._remote.get(_run_transform)
@@ -335,6 +355,13 @@ class StreamingExecutor:
             rf = self._remote.get(_run_read)
             return self._windowed([
                 (lambda t=t: rf.remote(t)) for t in tasks])
+        if isinstance(op, L.FusedRead):
+            tasks = op.datasource.get_read_tasks(op.parallelism)
+            rf = self._remote.get(_run_read_fused)
+            transforms = op.transforms
+            return self._windowed([
+                (lambda t=t, i=i: rf.remote(t, transforms, i))
+                for i, t in enumerate(tasks)])
         if isinstance(op, L.AbstractMap):
             inputs = self._exec(op.input_op)
             if op.compute is not None:
@@ -404,10 +431,16 @@ class StreamingExecutor:
         load: Dict[int, int] = {}
         ref_actor: Dict[Any, int] = {}
         next_i = 0
+        # autoscaling trace, observable via the DataContext singleton
+        # (the GCS-side ALIVE view lags worker spawn latency)
+        stats = {"peak": 0, "grows": 0, "shrinks": 0}
+        self.ctx.last_actor_pool_stats = stats
+        killed: set = set()
         try:
             actors.extend(actor_cls.remote(factory)
                           for _ in range(min(min_size, len(inputs))))
             load.update({j: 0 for j in range(len(actors))})
+            stats["peak"] = len(load)
             # block until at least one worker built its UDF state — a
             # broken constructor should fail the stage here, not
             # per-block (and the finally reaps the spawned pool)
@@ -422,6 +455,8 @@ class StreamingExecutor:
                             # backlog with every actor saturated: scale up
                             actors.append(actor_cls.remote(factory))
                             load[len(actors) - 1] = 0
+                            stats["grows"] += 1
+                            stats["peak"] = max(stats["peak"], len(load))
                             continue
                         break
                     ref = actors[j].apply.remote(inputs[next_i], next_i)
@@ -436,8 +471,33 @@ class StreamingExecutor:
                         j = ref_actor.pop(r, None)
                         if j is not None:
                             load[j] -= 1
+                # scale down: an idle actor whose capacity the remaining
+                # backlog no longer needs is released immediately
+                # (reference `default_autoscaler.py` downscaling)
+                remaining = (len(inputs) - next_i) + len(ref_actor)
+                while len(load) > min_size:
+                    idle = [j for j, n in load.items() if n == 0]
+                    if not idle or remaining > (len(load) - 1) * per_actor:
+                        break
+                    # reap the NEWEST idle actor: it is the least warm,
+                    # and on slow-spawning hosts may not even have
+                    # scheduled yet — killing the oldest would discard a
+                    # warm UDF while keeping a cold one
+                    j = max(idle)
+                    load.pop(j)
+                    killed.add(j)
+                    stats["shrinks"] += 1
+                    try:
+                        ray_tpu.kill(actors[j])
+                    except Exception:
+                        pass
         finally:
-            for a in actors:
+            # every spawned actor dies here, including ones spawned
+            # before `load` was populated (a failed spawn loop must not
+            # leak the warm UDF actors already created)
+            for j, a in enumerate(actors):
+                if j in killed:
+                    continue
                 try:
                     ray_tpu.kill(a)
                 except Exception:

@@ -276,6 +276,28 @@ class FusedMap(AbstractMap):
         return transform
 
 
+class FusedRead(LogicalOp):
+    """Read fused with downstream map transforms: each read task
+    produces its block AND runs the transform chain in the SAME task,
+    so intermediate blocks never round-trip through the object store
+    (reference `rules/zero_copy_map_fusion.py` + read-op fusion in
+    `rules/operator_fusion.py` — one task wave instead of one per
+    stage)."""
+
+    def __init__(self, read: "Read",
+                 transforms: List[Callable[[Block, int], Block]],
+                 fused_names: List[str]):
+        super().__init__(None)
+        self.datasource = read.datasource
+        self.parallelism = read.parallelism
+        self.transforms = transforms
+        self.fused_names = fused_names
+
+    @property
+    def name(self) -> str:
+        return "Read->" + "->".join(self.fused_names)
+
+
 class Limit(LogicalOp):
     def __init__(self, input_op, n: int):
         super().__init__(input_op)
@@ -407,9 +429,24 @@ def _optimize(op: LogicalOp) -> LogicalOp:
                             else [child.make_transform()])
         child_names = (child.fused_names if isinstance(child, FusedMap)
                        else [child.name])
-        return FusedMap(
+        op = FusedMap(
             child.input_op,
             child_transforms + [op.make_transform()],
             child_names + [op.name],
         )
+    if isinstance(op, AbstractMap) and op.compute is None:
+        # read fusion: the whole read->map chain becomes one task wave
+        transforms = (op.transforms if isinstance(op, FusedMap)
+                      else [op.make_transform()])
+        names = (op.fused_names if isinstance(op, FusedMap)
+                 else [op.name])
+        child = op.input_op
+        if isinstance(child, Read) and child.limit_rows is None:
+            return FusedRead(child, transforms, names)
+        if isinstance(child, FusedRead):
+            # the input already fused into its read (bottom-up order);
+            # append — the plan is a private clone, mutation is safe
+            child.transforms = child.transforms + transforms
+            child.fused_names = child.fused_names + names
+            return child
     return op

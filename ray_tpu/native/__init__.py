@@ -67,6 +67,14 @@ def load_shm_store() -> ctypes.CDLL:
     lib.ss_detach.restype = ctypes.c_int
     lib.ss_unlink_store.argtypes = [ctypes.c_char_p]
     lib.ss_unlink_store.restype = ctypes.c_int
+    lib.ss_stats2.argtypes = [
+        ctypes.c_int,
+        ctypes.POINTER(ctypes.c_uint64),
+        ctypes.POINTER(ctypes.c_uint64),
+        ctypes.POINTER(ctypes.c_uint32),
+        ctypes.POINTER(ctypes.c_uint64),
+    ]
+    lib.ss_stats2.restype = None
     lib.ss_memcpy_mt.argtypes = [
         ctypes.c_void_p,
         ctypes.c_void_p,

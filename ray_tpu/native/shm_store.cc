@@ -541,6 +541,32 @@ void ss_stats(int handle, uint64_t* capacity, uint64_t* allocated,
   *num_objects = s->hdr->num_objects;
 }
 
+// ss_stats plus the UNEVICTABLE byte count: bytes in unsealed objects
+// or sealed objects some client still references. `allocated` includes
+// evictable garbage a later create would reclaim, so backpressure
+// decisions must look at `referenced` instead (allocated-based
+// throttling stalls on space that is actually free).
+void ss_stats2(int handle, uint64_t* capacity, uint64_t* allocated,
+               uint32_t* num_objects, uint64_t* referenced) {
+  Store* s = get_store(handle);
+  if (!s) { *capacity = *allocated = *referenced = 0; *num_objects = 0;
+            return; }
+  Guard g(s->hdr);
+  *capacity = s->hdr->capacity;
+  *allocated = s->hdr->allocated;
+  *num_objects = s->hdr->num_objects;
+  uint64_t ref = 0;
+  uint32_t cap = s->hdr->table_cap;
+  for (uint32_t i = 0; i < cap; ++i) {
+    Slot* sl = &s->slots[i];
+    if (sl->state == CREATED ||
+        (sl->state == SEALED && sl->refcount > 0)) {
+      ref += sl->alloc_size;
+    }
+  }
+  *referenced = ref;
+}
+
 // Parallel memcopy for large object payloads (reference: the plasma
 // client's threaded memcopy, `src/ray/object_manager/plasma/client.cc`
 // memcopy_threads — a single memcpy thread cannot saturate multi-channel

@@ -35,7 +35,8 @@ def test_map_chain_fuses_and_computes():
           .map_batches(lambda b: {"x": b["x"], "y": b["x"] + 1}))
     from ray_tpu.data import logical as L
     optimized = L.optimize(ds._op)
-    assert isinstance(optimized, L.FusedMap)
+    # the map chain fuses, then fuses INTO the read: one task wave
+    assert isinstance(optimized, L.FusedRead)
     assert len(optimized.transforms) == 3
     rows = ds.take_all()
     xs = sorted(r["x"] for r in rows)
@@ -507,12 +508,14 @@ def test_limit_pushdown_plan():
     assert isinstance(inner.input_op, L.Read)
     assert inner.input_op.limit_rows == 40
 
-    # filter blocks pushdown (changes cardinality)
+    # filter blocks pushdown (changes cardinality); the filter itself
+    # fuses into the read, with the limit staying on top
     ds2 = rd.range(100, parallelism=4).filter(
         lambda r: r["id"] % 2 == 0).limit(10)
     op2 = L.optimize(ds2._op)
     assert isinstance(op2, L.Limit)
-    assert isinstance(op2.input_op, L.Filter)
+    assert isinstance(op2.input_op, L.FusedRead)
+    assert "Filter" in op2.input_op.name
 
 
 def test_limit_pushdown_reads_fewer_tasks(tmp_path):
@@ -627,3 +630,128 @@ def test_optimize_does_not_mutate_shared_plan():
     assert ds.count() == 500  # parent plan untouched
     assert ds.limit(25).count() == 25
     assert ds.count() == 500
+
+
+def test_read_map_fusion_single_task_wave():
+    """VERDICT r5 item 8: a read->map->map pipeline executes as ONE task
+    wave — intermediate blocks never round-trip through the store
+    (reference `rules/zero_copy_map_fusion.py` + read fusion)."""
+    import time as time_mod
+
+    from ray_tpu.util.state import summarize_tasks
+
+    def quiesced_summary():
+        # task events flush to the GCS asynchronously; wait until the
+        # stream settles so earlier tests' in-flight events don't
+        # pollute the before/after diff
+        prev = summarize_tasks()
+        deadline = time_mod.monotonic() + 30
+        while time_mod.monotonic() < deadline:
+            time_mod.sleep(1.0)
+            cur = summarize_tasks()
+            if cur == prev:
+                return cur
+            prev = cur
+        return prev
+
+    before = quiesced_summary()
+
+    ds = (rd.range(64, parallelism=4)
+          .map_batches(lambda b: {"x": b["id"] * 2})
+          .map_batches(lambda b: {"x": b["x"] + 1}))
+    rows = sorted(r["x"] for r in ds.take_all())
+    assert rows == [i * 2 + 1 for i in range(64)]
+
+    def delta(after, name):
+        b = sum(before.get(name, {}).values())
+        a = sum(after.get(name, {}).values())
+        return a - b
+
+    after = quiesced_summary()
+
+    # one wave: one task per block, nothing per stage
+    assert delta(after, "_run_read_fused") == 4, after.get("_run_read_fused")
+    assert delta(after, "_run_read") == 0
+    assert delta(after, "_run_transform") == 0
+
+
+def test_actor_pool_grows_and_shrinks():
+    """VERDICT r5 item 8: the actor-compute pool scales with queue depth
+    both ways — grows while every actor is saturated with backlog,
+    releases idle actors once the tail no longer needs them (reference
+    `execution/autoscaler/default_autoscaler.py`). Asserted on the
+    executor's own autoscaling trace: the GCS ALIVE view lags worker
+    spawn by seconds on slow hosts, which is scheduler latency, not
+    pool policy."""
+    import time as time_mod
+
+    from ray_tpu.data.context import DataContext
+
+    class Slow:
+        def __call__(self, batch):
+            # the last block is much slower: during its tail the idle
+            # surplus actors must be released while the stage still runs
+            time_mod.sleep(1.5 if int(batch["id"][0]) >= 150 else 0.2)
+            return batch
+
+    ds = rd.range(160, parallelism=16).map_batches(
+        Slow, compute=rd.ActorPoolStrategy(
+            min_size=1, max_size=4, max_tasks_in_flight_per_actor=2),
+        batch_size=10)
+    assert ds.count() == 160
+
+    stats = DataContext.get_current().last_actor_pool_stats
+    assert stats is not None
+    assert stats["peak"] == 4, stats       # grew to max under backlog
+    assert stats["grows"] == 3, stats
+    assert stats["shrinks"] >= 1, stats    # released idle tail capacity
+
+
+def test_webdataset_roundtrip(tmp_path):
+    """write_webdataset -> read_webdataset round-trips tar shards of
+    keyed samples (reference `datasource/webdataset_datasource.py`,
+    here dependency-free via stdlib tarfile)."""
+    ds = rd.from_items([
+        {"__key__": f"s{i:03d}", "txt": f"caption {i}", "cls": i % 3,
+         "bin": bytes([i, i + 1])}
+        for i in range(12)
+    ], parallelism=2)
+    out = str(tmp_path / "wds")
+    os.makedirs(out, exist_ok=True)
+    files = ds.write_webdataset(out)
+    assert len(files) == 2 and all(f.endswith(".tar") for f in files)
+
+    back = sorted(rd.read_webdataset(out).take_all(),
+                  key=lambda r: r["__key__"])
+    assert len(back) == 12
+    assert back[4]["txt"] == "caption 4"
+    assert back[4]["cls"] == 1
+    assert back[4]["bin"] == bytes([4, 5])
+
+
+def test_webdataset_binary_and_heterogeneous(tmp_path):
+    """Binary payloads with trailing NULs survive (bytes stay
+    object-dtype, never fixed-width 'S'), and samples with differing
+    member sets keep the union of columns."""
+    import tarfile
+    import io
+
+    out = tmp_path / "shard.tar"
+    with tarfile.open(out, "w") as tar:
+        def add(name, payload):
+            info = tarfile.TarInfo(name=name)
+            info.size = len(payload)
+            tar.addfile(info, io.BytesIO(payload))
+
+        add("a.txt", b"first")          # no .cls member
+        add("b.txt", b"second")
+        add("b.cls", b"7")
+        add("a.bin", b"\x04\x00")       # trailing NUL
+        add("b.bin", b"\x05\x06")
+
+    rows = sorted(rd.read_webdataset(str(out)).take_all(),
+                  key=lambda r: r["__key__"])
+    assert rows[0]["bin"] == b"\x04\x00"
+    assert rows[1]["bin"] == b"\x05\x06"
+    assert rows[0]["cls"] is None       # union schema, missing -> None
+    assert rows[1]["cls"] == 7

@@ -1,0 +1,188 @@
+"""Elastic multislice recovery: slice loss -> replacement -> re-formation.
+
+SURVEY §7.3's hard part, VERDICT r4 item 7: a multislice training run
+loses an entire slice (its NODE dies, not just a worker process), a
+replacement slice joins, the jax.distributed world re-forms on a fresh
+coordinator, and training resumes from the latest complete sharded
+checkpoint bit-identically.
+
+Reference analogues: Train FailureConfig restart
+(`python/ray/air/config.py:395`) + worker-group teardown/rebuild
+(`python/ray/train/_internal/backend_executor.py:124`); slice loss is
+the TPU-flavored node failure.
+
+Own file: needs its own cluster (node kill + replacement mid-test).
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import train
+from ray_tpu.air import FailureConfig, RunConfig, ScalingConfig
+from ray_tpu._private.node import Cluster
+from ray_tpu.train.backend import JaxConfig
+
+STEPS = 4
+CRASH_STEP = 2
+
+
+@pytest.fixture(scope="module")
+def slice_cluster():
+    # head holds the trial controller; each "slice" is one 1-CPU node so
+    # every slice gang lands on its own node
+    cluster = Cluster(head_resources={"CPU": 2.0},
+                      object_store_memory=64 * 1024 * 1024)
+    cluster.add_node({"CPU": 1.0})
+    cluster.add_node({"CPU": 1.0})
+    ray_tpu.init(address=cluster.gcs_addr)
+    yield cluster
+    ray_tpu.shutdown()
+    cluster.shutdown()
+
+
+def _make_loop(info_dir):
+    def loop(config):
+        import json
+        import os as os_mod
+
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        from ray_tpu import train as train_mod
+        from ray_tpu.train import array_checkpoint as ac
+
+        ctx = train_mod.get_context()
+        rank = ctx.get_world_rank()
+        devs = jax.devices()  # 2 procs x 2 devices: 2 virtual slices
+        mesh = Mesh(np.array(devs).reshape(2, 2), ("dcn", "fsdp"))
+        w0 = np.arange(32, dtype=np.float32).reshape(8, 4)
+        state = {
+            "w": jax.make_array_from_callback(
+                (8, 4), NamedSharding(mesh, P(("dcn", "fsdp"))),
+                lambda idx: w0[idx]),
+            "step": jax.make_array_from_callback(
+                (), NamedSharding(mesh, P()),
+                lambda idx: np.zeros((), np.int32)),
+        }
+        start = 0
+        ckpt = train_mod.get_checkpoint()
+        if ckpt is not None and ac.is_sharded_checkpoint(ckpt):
+            state = ac.restore_sharded(ckpt, state)
+            start = int(np.asarray(
+                state["step"].addressable_shards[0].data))
+
+        @jax.jit
+        def update(s):
+            return {"w": s["w"] * 2.0 + 1.0, "step": s["step"] + 1}
+
+        with open(os_mod.path.join(
+                info_dir, f"attempt_{start}_{rank}.json"), "w") as f:
+            json.dump({"rank": rank, "start": start,
+                       "slice_rank": ctx.get_slice_rank(),
+                       "node": os_mod.environ.get("RAY_TPU_NODE_ID")}, f)
+
+        for i in range(start, STEPS):
+            state = update(state)
+            fp = float(sum(np.asarray(s.data).sum()
+                           for s in state["w"].addressable_shards
+                           if s.replica_id == 0))
+            train_mod.report(
+                {"step": i + 1, "fp": fp, "resumed_from": start,
+                 "rank": rank},
+                checkpoint=ac.save_to_checkpoint(state))
+            if start == 0 and i + 1 >= CRASH_STEP:
+                # first attempt: idle after the crash-step checkpoint so
+                # the test can kill slice 1's node at a known point
+                import time as time_mod
+
+                time_mod.sleep(600)
+
+    return loop
+
+
+def test_slice_loss_replacement_resume(slice_cluster, tmp_path):
+    info_dir = tmp_path / "info"
+    info_dir.mkdir()
+    trainer = train.JaxTrainer(
+        _make_loop(str(info_dir)),
+        backend_config=JaxConfig(
+            distributed="on", platform="cpu",
+            xla_flags="--xla_force_host_platform_device_count=2"),
+        scaling_config=ScalingConfig(num_workers=2, num_slices=2),
+        run_config=RunConfig(
+            storage_path=str(tmp_path), name="elastic_ms",
+            failure_config=FailureConfig(max_failures=2)),
+    )
+    out: dict = {}
+
+    def run():
+        try:
+            out["result"] = trainer.fit()
+        except BaseException as e:  # noqa: BLE001
+            out["error"] = e
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+
+    # wait until the first attempt has both ranks' step-2 checkpoint
+    # persisted (both workers idle afterwards), then kill slice 1's node
+    deadline = time.monotonic() + 240
+    seen = set()
+    while time.monotonic() < deadline:
+        seen = {f for f in os.listdir(info_dir)
+                if f.startswith("attempt_0_")}
+        trial_dirs = []
+        for root, dirs, _files in os.walk(tmp_path):
+            trial_dirs += [os.path.join(root, d) for d in dirs
+                           if d.startswith(f"checkpoint_{CRASH_STEP-1:06d}")]
+        from ray_tpu.train import array_checkpoint as ac
+        complete = [d for d in trial_dirs if not d.endswith("_shards")
+                    and ac.is_usable(d)]
+        if len(seen) == 2 and complete:
+            break
+        time.sleep(1.0)
+    assert len(seen) == 2, seen
+
+    # find which node hosts rank 1 (slice 1) and kill that raylet
+    import json as json_mod
+
+    recs = {}
+    for f in os.listdir(info_dir):
+        if f.startswith("attempt_0_"):
+            rec = json_mod.loads((info_dir / f).read_text())
+            recs[rec["rank"]] = rec
+    victim_node = recs[1]["node"]
+    assert recs[1]["slice_rank"] == 1
+    victim = next(n for n in slice_cluster.nodes
+                  if n.node_id_hex == victim_node)
+    slice_cluster.remove_node(victim)
+    # replacement slice joins (the autoscaler's replace-broken-slice
+    # behavior, driven explicitly here; autoscaler-driven replacement is
+    # covered by tests/test_autoscaler.py)
+    slice_cluster.add_node({"CPU": 1.0})
+
+    t.join(timeout=420)
+    assert not t.is_alive(), "trainer did not finish after slice loss"
+    assert "error" not in out, out.get("error")
+    result = out["result"]
+    # the retried run restored from the step-2 sharded checkpoint on a
+    # RE-FORMED world and ran to completion
+    assert result.metrics["step"] == STEPS
+    assert result.metrics["resumed_from"] == CRASH_STEP
+    # bit-identical: w_i = 2*w_{i-1} + 1 from arange(32); rank 0 holds
+    # the first half of the flattened (dcn, fsdp) sharding
+    w = np.arange(32, dtype=np.float32).reshape(8, 4)
+    for _ in range(STEPS):
+        w = w * 2.0 + 1.0
+    assert result.metrics["fp"] == pytest.approx(float(w[:4].sum()),
+                                                 abs=0.0)
+    # the second attempt actually re-formed: fresh session files exist
+    retry = {f for f in os.listdir(info_dir)
+             if f.startswith(f"attempt_{CRASH_STEP}_")}
+    assert len(retry) == 2, retry
