@@ -56,6 +56,10 @@ class Config:
     idle_lease_keepalive_s: float = 0.2
     # Max workers a raylet will fork per node by default: num_cpus.
     maximum_startup_concurrency: int = 8
+    # consecutive pre-registration worker deaths for one pool key before
+    # the raylet stops respawning and fails the waiting leases (a broken
+    # runtime-env interpreter would otherwise crash-loop forever)
+    max_worker_startup_failures: int = 5
     # Worker pool: keep this many idle workers warm.
     num_prestart_workers: int = 0
     worker_register_timeout_s: float = 30.0

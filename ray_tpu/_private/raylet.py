@@ -150,6 +150,8 @@ class Raylet:
         self.view = ClusterView()
         self._bg: list = []
         self._spawned_procs: List[tuple] = []  # (proc, pool_key) pre-register
+        # pool key -> consecutive deaths before registration (breaker)
+        self._startup_failures: Dict[tuple, int] = {}
         self._pulls_inflight: Dict[bytes, asyncio.Future] = {}
         self._pinned: Dict[bytes, object] = {}  # oid -> held PlasmaBuffer
         # Disk spilling (reference: local_object_manager.h spill/restore):
@@ -406,6 +408,22 @@ class Raylet:
                     self._starting[starting_key] = max(
                         0, self._starting.get(starting_key, 0) - 1)
                     self.unassigned_chips.extend(key[1])
+                    # Crash-loop breaker: a pool whose workers keep dying
+                    # BEFORE registering (broken interpreter/runtime env)
+                    # must not respawn forever — after a few consecutive
+                    # startup deaths, fail the leases waiting on this key
+                    # so callers see the error instead of a hang. Counted
+                    # on starting_key, which for TPU pools is
+                    # ("tpu", n_chips) — the CONCRETE chip tuple rotates
+                    # between respawns and would dilute the count.
+                    n = self._startup_failures.get(starting_key, 0) + 1
+                    self._startup_failures[starting_key] = n
+                    if n >= self.config.max_worker_startup_failures:
+                        self._fail_leases_for_key(
+                            starting_key,
+                            f"worker startup crash-looped ({n} "
+                            f"consecutive deaths before registration; "
+                            f"see worker logs in the session dir)")
                     self._dispatch()
 
     # ------------------------------------------------------------------
@@ -613,6 +631,11 @@ class Raylet:
             from ray_tpu._private import runtime_env as renv_mod
             python_exe = await asyncio.get_running_loop().run_in_executor(
                 None, renv_mod.ensure_pip_env, runtime_env["pip"])
+        elif runtime_env and runtime_env.get("conda"):
+            # same off-loop treatment: conda env create can take minutes
+            from ray_tpu._private import runtime_env as renv_mod
+            python_exe = await asyncio.get_running_loop().run_in_executor(
+                None, renv_mod.ensure_conda_env, runtime_env["conda"])
         env = dict(os.environ)
         env["RAY_TPU_NODE_ID"] = self.node_id.hex()
         if runtime_env and runtime_env.get("env_vars"):
@@ -680,6 +703,12 @@ class Raylet:
                              worker.env_hash)
         self._workers[worker.worker_id] = worker
         self._idle.setdefault(key, []).append(worker)
+        # pool is healthy: reset the breaker under its counting key
+        self._startup_failures.pop(
+            self._pool_key(worker.job_id,
+                           ("tpu", len(worker.tpu_chips))
+                           if worker.tpu_chips else (),
+                           worker.env_hash), None)
         self._match_worker_procs(worker)
         self._dispatch()
         return {"node_id": self.node_id.binary(), "store_name": self.store_name}
@@ -938,22 +967,12 @@ class Raylet:
             self._starting[starting_key] = max(
                 0, self._starting.get(starting_key, 0) - 1)
             self.unassigned_chips.extend(chips)
-            from ray_tpu._private.runtime_env import (
-                RuntimeEnvSetupError, env_hash as _env_hash)
+            from ray_tpu._private.runtime_env import RuntimeEnvSetupError
             if isinstance(e, RuntimeEnvSetupError):
                 # a broken env spec fails deterministically: error out the
                 # leases waiting on this env instead of respawning forever
-                ehash = _env_hash(runtime_env)
-                for lease in list(self._pending):
-                    if _env_hash(lease.spec.runtime_env) != ehash:
-                        continue
-                    self._pending.remove(lease)
-                    self._release_resources(lease)
-                    self._leases.pop(lease.lease_id, None)
-                    if not lease.reply_fut.done():
-                        lease.reply_fut.set_result(
-                            {"granted": False,
-                             "error": f"runtime_env setup failed: {e}"})
+                self._fail_leases_for_key(
+                    key, f"runtime_env setup failed: {e}")
             return
         self._spawned_procs.append((proc, key, starting_key))
 
@@ -1036,6 +1055,37 @@ class Raylet:
                 self._actor_workers.pop(wid, None)
                 await self._on_worker_death(worker)
         return None
+
+    def _fail_leases_for_key(self, key: tuple, msg: str) -> None:
+        """Error out every pending lease whose (job, runtime env, chip
+        demand) maps to this pool key — terminal action for the
+        crash-loop breaker and for deterministic env-setup failures.
+        Chip-scoped: a broken TPU pool must not fail the same job's
+        healthy CPU leases (or vice versa)."""
+        from ray_tpu._private.runtime_env import env_hash as _env_hash
+
+        job_id = key[0]
+        chips_key = key[1] if len(key) > 1 else ()
+        ehash = key[2] if len(key) > 2 else ""
+        if len(chips_key) == 2 and chips_key[0] == "tpu":
+            want_tpu = int(chips_key[1])
+        else:
+            want_tpu = len(chips_key)
+        for lease in list(self._pending):
+            if lease.spec.job_id != job_id:
+                continue
+            if _env_hash(lease.spec.runtime_env) != ehash:
+                continue
+            if int(lease.resources.get("TPU", 0) or 0) != want_tpu:
+                continue
+            self._pending.remove(lease)
+            self._release_resources(lease)
+            self._leases.pop(lease.lease_id, None)
+            if not lease.reply_fut.done():
+                lease.reply_fut.set_result(
+                    {"granted": False, "error": msg})
+        # reset: a later, fixed env spec with the same key may succeed
+        self._startup_failures.pop(key, None)
 
     def _grant(self, lease: Lease, worker: WorkerHandle):
         lease.worker = worker

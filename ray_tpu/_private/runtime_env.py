@@ -367,9 +367,16 @@ def prepare(cw, runtime_env: Dict) -> Dict:
         ]
     if runtime_env.get("pip"):
         wire["pip"] = normalize_pip(runtime_env["pip"])
+    if runtime_env.get("conda"):
+        if runtime_env.get("pip"):
+            raise ValueError(
+                "runtime_env cannot set both pip and conda (reference "
+                "semantics: pip installs INTO a conda env via the "
+                "spec's own pip section)")
+        wire["conda"] = normalize_conda(runtime_env["conda"])
     _load_env_plugins()
     unknown = set(runtime_env) - {"env_vars", "working_dir", "py_modules",
-                                  "pip"}
+                                  "pip", "conda"}
     for field_name in sorted(unknown):
         plugin = _plugins.get(field_name)
         if plugin is None:
@@ -473,7 +480,10 @@ def materialize(cw, wire: Dict, target_root: str) -> None:
             raise RuntimeError(f"runtime_env payload {key} missing")
         return reply["value"]
 
-    builtin = {"env_vars", "working_dir", "py_modules", "pip", "_hash"}
+    # pip and conda are applied at SPAWN time (the raylet launches the
+    # worker from the env's interpreter) — nothing to materialize here
+    builtin = {"env_vars", "working_dir", "py_modules", "pip", "conda",
+               "_hash"}
     for field_name in wire:
         if field_name in builtin:
             continue
@@ -489,3 +499,221 @@ def materialize(cw, wire: Dict, target_root: str) -> None:
                 f"plugin in this worker (set RAY_TPU_RUNTIME_ENV_PLUGINS "
                 f"in env_vars and ship the module via py_modules)")
         plugin.materialize(wire[field_name], fetch, target_root)
+
+
+# ---------------------------------------------------------------------------
+# conda env isolation (reference python/ray/_private/runtime_env/conda.py:
+# per-spec conda envs created by the agent, cached and reused). The worker
+# interpreter comes FROM the env, so this is a native field like pip —
+# the plugin seam cannot swap an already-running interpreter.
+# ---------------------------------------------------------------------------
+
+
+def normalize_conda(conda) -> Dict:
+    """Driver-side normalization: an existing env NAME, a path to an
+    environment.yml, or an inline spec dict (the yml's content)."""
+    if isinstance(conda, str):
+        if conda.endswith((".yml", ".yaml")):
+            import yaml
+
+            with open(conda) as f:
+                spec = yaml.safe_load(f)
+            if not isinstance(spec, dict):
+                raise ValueError(f"malformed conda spec file {conda!r}")
+            return {"spec": spec}
+        return {"name": conda}
+    if isinstance(conda, dict):
+        return {"spec": conda}
+    raise TypeError(
+        f"runtime_env conda must be an env name, a spec file path, or a "
+        f"spec dict, got {conda!r}")
+
+
+def conda_env_cache_root() -> str:
+    return os.environ.get("RAY_TPU_CONDA_ENV_CACHE",
+                          "/tmp/ray_tpu/conda_envs")
+
+
+def _conda_exe() -> str:
+    exe = os.environ.get("RAY_TPU_CONDA_EXE") or shutil.which("conda")
+    if not exe or not (os.path.isfile(exe) and os.access(exe, os.X_OK)):
+        # deterministic failure — a missing binary must fail the waiting
+        # leases, not leave the raylet respawning/hanging
+        raise RuntimeEnvSetupError(
+            "runtime_env requests a conda env but no usable conda "
+            f"executable is available on this node (looked at {exe!r}; "
+            "install conda or set RAY_TPU_CONDA_EXE)")
+    return exe
+
+
+_conda_build_lock = _threading.Lock()
+_conda_key_locks: Dict[str, _threading.Lock] = {}
+_conda_failed: Dict[str, str] = {}
+
+
+_conda_named_cache: Dict[str, str] = {}
+
+
+def ensure_conda_env(conda_wire: Dict) -> str:
+    """Resolve (building if needed) the conda env for a wire spec;
+    returns the env's python interpreter path. Spec envs are
+    content-addressed by the normalized spec and cached like pip venvs;
+    named envs resolve through `conda run` (once per name — the mapping
+    is stable for the node's lifetime, and a per-spawn subprocess would
+    tax every worker of the pool)."""
+    exe = _conda_exe()
+    if conda_wire.get("name"):
+        name = conda_wire["name"]
+        cached = _conda_named_cache.get(name)
+        if cached:
+            return cached
+        try:
+            out = subprocess.run(
+                [exe, "run", "-n", name, "python", "-c",
+                 "import sys; print(sys.executable)"],
+                check=True, capture_output=True, text=True, timeout=120)
+        except (subprocess.CalledProcessError,
+                subprocess.TimeoutExpired, OSError) as e:
+            stderr = getattr(e, "stderr", "") or ""
+            raise RuntimeEnvSetupError(
+                f"conda env {name!r} not usable: "
+                f"{stderr[-500:] or e}") from e
+        py = out.stdout.strip().splitlines()[-1]
+        _conda_named_cache[name] = py
+        return py
+    spec = conda_wire["spec"]
+    key = hashlib.sha1(json.dumps(
+        spec, sort_keys=True).encode()).hexdigest()[:20]
+    dest = os.path.join(conda_env_cache_root(), key)
+    py = os.path.join(dest, "bin", "python")
+    ready = os.path.join(dest, ".ready")
+    with _conda_build_lock:
+        key_lock = _conda_key_locks.setdefault(key, _threading.Lock())
+    with key_lock:
+        if key in _conda_failed:
+            raise RuntimeEnvSetupError(_conda_failed[key])
+        if os.path.exists(ready):
+            try:
+                os.utime(ready)
+            except OSError:
+                pass
+            return py
+        try:
+            return _build_conda_env(exe, spec, dest, py, ready)
+        except RuntimeEnvSetupError as e:
+            _conda_failed[key] = str(e)
+            raise
+
+
+def _build_conda_env(exe: str, spec: Dict, dest: str, py: str,
+                     ready: str) -> str:
+    import yaml
+
+    os.makedirs(conda_env_cache_root(), exist_ok=True)
+    tmp = f"{dest}.tmp.{os.getpid()}.{os.urandom(4).hex()}"
+    spec_path = f"{tmp}.yml"
+    with open(spec_path, "w") as f:
+        yaml.safe_dump(spec, f)
+    try:
+        try:
+            proc = subprocess.run(
+                [exe, "env", "create", "-p", tmp, "-f", spec_path],
+                capture_output=True, text=True, timeout=1800)
+        except subprocess.TimeoutExpired as e:
+            # deterministic-failure class: without this, the raylet
+            # treats the raw TimeoutExpired as transient and re-runs the
+            # 30-minute build forever while callers hang
+            raise RuntimeEnvSetupError(
+                "conda env create timed out after 1800s") from e
+        except OSError as e:
+            raise RuntimeEnvSetupError(
+                f"conda executable failed to run: {e}") from e
+        if proc.returncode != 0:
+            raise RuntimeEnvSetupError(
+                f"conda env create failed (rc={proc.returncode}):\n"
+                f"{proc.stderr[-2000:]}")
+        if not os.path.exists(os.path.join(tmp, "bin", "python")):
+            raise RuntimeEnvSetupError(
+                "conda env create produced no python interpreter "
+                f"under {tmp}")
+        # Inject the running framework into the env (reference conda.py
+        # injects ray + its deps the same way): a .pth appending the
+        # builder's site dirs AFTER the env's own site-packages, so the
+        # env's packages shadow them but ray_tpu stays importable.
+        import glob as _glob
+        import site as _site
+
+        env_sites = _glob.glob(
+            os.path.join(tmp, "lib", "python*", "site-packages"))
+        if env_sites:
+            repo_root = os.path.dirname(os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__))))
+            with open(os.path.join(env_sites[0], "_ray_tpu_base.pth"),
+                      "w") as f:
+                f.write(repo_root + "\n")
+                for p in _site.getsitepackages():
+                    f.write(p + "\n")
+        with open(os.path.join(tmp, ".ready"), "w"):
+            pass
+        try:
+            os.replace(tmp, dest)  # first builder wins
+        except OSError:
+            if not os.path.exists(ready):
+                raise
+        # same LRU cap as the pip venv cache — conda envs are even
+        # bigger, and nothing else bounds the cache directory
+        _evict_pip_cache(conda_env_cache_root(),
+                         keep=os.path.basename(dest))
+        return py
+    finally:
+        try:
+            os.unlink(spec_path)
+        except OSError:
+            pass
+        # failure (or a lost rename race) must not leak the
+        # multi-hundred-MB partial env; on success tmp no longer exists
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+# ---------------------------------------------------------------------------
+# container stand-in: local overlay images (reference
+# python/ray/_private/runtime_env/{container,image_uri}.py runs workers in
+# podman; a zero-egress single-box deployment has no registry or container
+# runtime, so the shipped plugin applies a LOCAL image directory as a
+# userspace overlay — `<image>/site-packages` prepends sys.path,
+# `<image>/bin` prepends PATH. The plugin seam accepts a real podman
+# backend where one exists.)
+# ---------------------------------------------------------------------------
+
+
+class LocalImagePlugin(RuntimeEnvPlugin):
+    name = "container"
+
+    def prepare(self, value, upload) -> Any:
+        if not isinstance(value, dict) or "image" not in value:
+            raise ValueError(
+                'runtime_env container must be {"image": <local overlay '
+                'dir>} (zero-egress stand-in for the reference\'s podman '
+                "images)")
+        unknown = set(value) - {"image"}
+        if unknown:
+            raise ValueError(
+                f"unsupported container fields: {sorted(unknown)}")
+        return {"image": str(value["image"])}
+
+    def materialize(self, value, fetch, target_root: str) -> None:
+        image = value["image"]
+        if not os.path.isdir(image):
+            raise RuntimeError(
+                f"container image dir {image!r} does not exist on this "
+                f"node (images are node-local, like pulled containers)")
+        site = os.path.join(image, "site-packages")
+        if os.path.isdir(site) and site not in sys.path:
+            sys.path.insert(0, site)
+        bindir = os.path.join(image, "bin")
+        if os.path.isdir(bindir):
+            os.environ["PATH"] = (
+                bindir + os.pathsep + os.environ.get("PATH", ""))
+
+
+register_plugin(LocalImagePlugin())

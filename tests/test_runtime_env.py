@@ -94,7 +94,7 @@ def test_py_modules(tmp_path):
 
 
 def test_unsupported_field_rejected():
-    @ray_tpu.remote(runtime_env={"conda": "some-env"})
+    @ray_tpu.remote(runtime_env={"no_such_backend": "x"})
     def nope():
         return 1
 
@@ -222,3 +222,45 @@ def test_runtime_env_plugin_seam(tmp_path):
     finally:
         sys.path.remove(str(tmp_path))
         renv._plugins.pop("touch_file", None)
+
+
+def test_container_image_overlay(tmp_path):
+    """`container` runtime env (reference `runtime_env/container.py`,
+    podman): the zero-egress stand-in applies a LOCAL overlay image dir
+    — site-packages onto sys.path, bin onto PATH — via the shipped
+    LocalImagePlugin."""
+    image = tmp_path / "image"
+    (image / "site-packages").mkdir(parents=True)
+    (image / "bin").mkdir()
+    (image / "site-packages" / "img_probe_mod.py").write_text(
+        "LAYER = 'overlay-42'\n")
+    (image / "bin" / "imgtool").write_text("#!/bin/sh\necho tool\n")
+    os.chmod(image / "bin" / "imgtool", 0o755)
+
+    @ray_tpu.remote(runtime_env={"container": {"image": str(image)}})
+    def probe():
+        import shutil
+
+        import img_probe_mod
+
+        return img_probe_mod.LAYER, shutil.which("imgtool") is not None
+
+    layer, has_tool = ray_tpu.get(probe.remote(), timeout=120)
+    assert layer == "overlay-42"
+    assert has_tool
+
+    @ray_tpu.remote
+    def base():
+        import importlib.util
+        return importlib.util.find_spec("img_probe_mod") is not None
+
+    assert ray_tpu.get(base.remote(), timeout=60) is False
+
+
+def test_container_image_rejects_bad_value():
+    with pytest.raises(ValueError, match="container"):
+        @ray_tpu.remote(runtime_env={"container": "not-a-dict"})
+        def f():
+            pass
+
+        f.remote()

@@ -1,0 +1,116 @@
+"""Conda runtime-env backend (reference
+`python/ray/_private/runtime_env/conda.py`): per-spec envs created by
+the node, content-addressed and cached; the worker interpreter comes
+from the env. Driven against a stub `conda` executable (the zero-egress
+box carries no conda), which builds the env as a venv — the framework
+code paths (normalization, cache, raylet spawn hook) are identical.
+
+Own file: the RAYLET must see RAY_TPU_CONDA_EXE at daemon spawn.
+"""
+
+import os
+import stat
+import time
+
+import pytest
+
+import ray_tpu
+
+_STUB = """#!/bin/bash
+# test stub for the conda CLI
+if [ "$1" = "env" ] && [ "$2" = "create" ]; then
+  shift 2
+  while [ $# -gt 0 ]; do
+    case "$1" in
+      -p) path="$2"; shift 2;;
+      -f) file="$2"; shift 2;;
+      *) shift;;
+    esac
+  done
+  {python} -m venv --system-site-packages "$path" || exit 1
+  cp "$file" "$path/spec.yml"
+  exit 0
+fi
+if [ "$1" = "run" ]; then
+  shift
+  if [ "$1" = "-n" ]; then
+    name="$2"; shift 2
+    if [ "$name" != "present-env" ]; then exit 1; fi
+  fi
+  exec "$@"
+fi
+exit 2
+"""
+
+
+@pytest.fixture(scope="module", autouse=True)
+def cluster(tmp_path_factory):
+    import sys
+
+    base = tmp_path_factory.mktemp("conda")
+    stub = base / "conda"
+    stub.write_text(_STUB.replace("{python}", sys.executable))
+    os.chmod(stub, os.stat(stub).st_mode | stat.S_IEXEC)
+    os.environ["RAY_TPU_CONDA_EXE"] = str(stub)
+    os.environ["RAY_TPU_CONDA_ENV_CACHE"] = str(base / "envs")
+    try:
+        ray_tpu.init(num_cpus=4, object_store_memory=128 * 1024 * 1024)
+        yield
+        ray_tpu.shutdown()
+    finally:
+        os.environ.pop("RAY_TPU_CONDA_EXE", None)
+        os.environ.pop("RAY_TPU_CONDA_ENV_CACHE", None)
+
+
+def test_conda_spec_env_runs_worker_from_env():
+    spec = {"name": "probe", "dependencies": ["python"]}
+
+    @ray_tpu.remote(runtime_env={"conda": spec})
+    def where():
+        import sys
+        return sys.executable
+
+    exe = ray_tpu.get(where.remote(), timeout=180)
+    cache = os.environ["RAY_TPU_CONDA_ENV_CACHE"]
+    assert exe.startswith(cache), exe
+    # the stub recorded the spec it was given, next to the interpreter
+    env_dir = os.path.dirname(os.path.dirname(exe))
+    assert os.path.exists(os.path.join(env_dir, "spec.yml"))
+
+
+def test_conda_env_is_cached():
+    from ray_tpu._private.runtime_env import (ensure_conda_env,
+                                              normalize_conda)
+
+    wire = normalize_conda({"name": "cached", "dependencies": ["python"]})
+    t0 = time.monotonic()
+    py1 = ensure_conda_env(wire)
+    first = time.monotonic() - t0
+    t1 = time.monotonic()
+    py2 = ensure_conda_env(wire)
+    second = time.monotonic() - t1
+    assert py1 == py2 and os.path.exists(py1)
+    assert second < first / 5
+
+
+def test_conda_named_env_resolves():
+    from ray_tpu._private.runtime_env import (ensure_conda_env,
+                                              normalize_conda)
+    import sys
+
+    wire = normalize_conda("present-env")
+    assert wire == {"name": "present-env"}
+    assert ensure_conda_env(wire) == sys.executable
+
+    with pytest.raises(Exception, match="not usable"):
+        ensure_conda_env(normalize_conda("missing-env"))
+
+
+def test_conda_and_pip_are_exclusive():
+    with pytest.raises(ValueError, match="both pip and conda"):
+        @ray_tpu.remote(runtime_env={"conda": {"dependencies": []},
+                                     "pip": ["x"]})
+        def f():
+            pass
+
+        f.remote()
