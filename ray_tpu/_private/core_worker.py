@@ -1383,17 +1383,25 @@ class CoreWorker:
                     "spec": spec.to_wire(),
                     "no_spillback": no_spillback,
                 }, timeout=300.0)
-            except (ConnectionLost, RpcError, OSError) as e:
-                if (spec.strategy == task_mod.STRATEGY_NODE_AFFINITY
-                        and spec.soft and addr != self.raylet_addr
+            except RpcError as e:
+                # the peer is ALIVE and replied with an error — never a
+                # connectivity retry case
+                return {"granted": False, "error": str(e)}
+            except (ConnectionLost, OSError) as e:
+                if (spec.placement_group_id is None
+                        and addr != self.raylet_addr
                         and conn_retries < 15):
-                    # soft affinity to a dead/unreachable node: wait for
-                    # the GCS to prune it from the view, then re-route
-                    # from the local raylet (which will fall back to the
-                    # normal policy once the target is gone). Each cycle
-                    # resets the hop budget — the reroute itself consumes
-                    # local->target hops and would otherwise exhaust
-                    # max_hops before the ~5s prune window elapses.
+                    # A dead/unreachable REMOTE hop (spillback target or
+                    # soft-affinity node that died between the scheduling
+                    # decision and the lease): wait for the GCS to prune
+                    # it from the view, then re-route from the local
+                    # raylet — failing the task here would turn a node
+                    # death into a permanent task error even though
+                    # other capacity exists (lineage reconstruction hits
+                    # exactly this window). Each cycle resets the hop
+                    # budget — the reroute itself consumes local->target
+                    # hops. PG-targeted leases are excluded: their
+                    # bundle's death is the PG machinery's to handle.
                     conn_retries += 1
                     hops = 0
                     addr = self.raylet_addr

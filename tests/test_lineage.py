@@ -91,7 +91,6 @@ def test_lineage_reconstruction_recovers_value():
     back is re-created by re-executing its task, exactly once per loss."""
     cluster = Cluster()
     cluster.add_node({"CPU": 4.0})
-    victim = cluster.add_node({"CPU": 2.0, "scratch": 1.0})
     ray_tpu.init(address=cluster.gcs_addr)
     try:
         @ray_tpu.remote
@@ -106,9 +105,14 @@ def test_lineage_reconstruction_recovers_value():
             def get(self):
                 return self.n
 
+        # The counter must SURVIVE the victim-node kill below, so it is
+        # created before the victim exists (the scheduler legitimately
+        # tiebreaks equal nodes at random — r5 — and must not be
+        # assumed to avoid the victim).
         counter = Counter.options(name="exec_counter").remote()
         ray_tpu.get(counter.bump.remote())  # ensure alive
         ray_tpu.get(counter.bump.remote())
+        victim = cluster.add_node({"CPU": 2.0, "scratch": 1.0})
 
         @ray_tpu.remote(resources={"scratch": 1.0}, num_cpus=0,
                         scheduling_strategy="SPREAD")
