@@ -30,8 +30,10 @@ import traceback
 from collections import OrderedDict, deque
 from concurrent.futures import Future as SyncFuture
 from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import TimeoutError as SyncTimeoutError
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
+from ray_tpu._private import fault_injection as _fi
 from ray_tpu._private import serialization
 from ray_tpu._private import task as task_mod
 from ray_tpu._private.config import Config
@@ -802,7 +804,8 @@ class CoreWorker:
             t = None if deadline is None else max(0.0, deadline - time.monotonic())
             try:
                 waiter.result(t)
-            except TimeoutError:
+            except (SyncTimeoutError, TimeoutError):
+                # distinct types before 3.11 (bpo-44793 unified them)
                 raise GetTimeoutError(f"get timed out: {ref}")
         return CoreWorker._FAST_MISS
 
@@ -868,6 +871,8 @@ class CoreWorker:
             raise GetTimeoutError(f"get timed out: {ref}")
 
     async def _pull_via_raylet(self, ref: ObjectRef):
+        if _fi._PLAN is not None:
+            await _fi._PLAN.object_pull()
         raylet = await self._clients.get(self.raylet_addr)
         await raylet.call("pull_object", {
             "object_id": ref.binary(),

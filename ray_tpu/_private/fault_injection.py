@@ -1,0 +1,362 @@
+"""Deterministic fault-injection plane (the chaos plane).
+
+Failure handling must be *provable*, not incidental: instead of ad-hoc
+SIGKILLs scattered through tests, a seeded `FaultPlan` drives named
+injection points threaded through the layers where faults actually land —
+
+  * ``rpc.send`` / ``rpc.recv``   — ClientPool frame loss, delay,
+    duplication (`rpc.py` RpcClient)
+  * ``gcs.heartbeat``             — delayed / swallowed heartbeat handling
+  * ``gcs.health``                — stalled health-check cycles
+  * ``raylet.spawn``              — worker spawn failures (first k spawns
+    raise a non-RuntimeEnvSetupError, exercising the crash-loop breaker)
+  * ``raylet.lease``              — delayed lease dispatch
+  * ``raylet.kill_node``          — abrupt node death after N heartbeats
+  * ``core_worker.pull``          — delayed object pulls
+  * ``train.pre_commit``          — kill a train rank in the window between
+    its own shard persist and the gang checkpoint commit
+
+Activation: the ``RAY_TPU_CHAOS`` env var, parsed once per process at
+import (each daemon is its own process and reads its own env — a test can
+scope a fault to one node by setting the var only around that node's
+spawn), or programmatically via :func:`install`. With no plan active every
+injection point is a single ``_PLAN is not None`` global check — the
+module global stays ``None`` and the hot paths (``RpcClient.call_nowait``,
+lease dispatch) pay one attribute load.
+
+Determinism: every probabilistic site draws from its OWN
+``random.Random`` stream seeded by ``(seed, site)``, so the decision
+sequence at a site depends only on the seed and that site's draw count —
+never on how sites interleave across the event loop. The decisions are
+recorded in :attr:`FaultPlan.schedule` (capped), so the same seed replays
+the identical fault schedule and any chaos failure reproduces exactly.
+
+Reference ground: the reference's chaos utilities
+(`python/ray/_private/test_utils.py` WorkerKillerActor,
+`python/ray/tests/test_chaos.py`) are cadence-based and unseeded; this
+plane makes the schedule a first-class, replayable artifact.
+
+Grammar (``;``-separated ``key=value`` pairs)::
+
+    RAY_TPU_CHAOS="seed=7;rpc_drop=0.05;rpc_delay=0.2:0.01;rpc_dup=0.1;
+                   rpc_match=heartbeat|pull_object;
+                   heartbeat_delay=0.5;heartbeat_drop=0.2;health_delay=0.1;
+                   spawn_fail=2;lease_delay=0.5:0.02;pull_delay=0.3:0.01;
+                   kill_node=heartbeats:6;commit_kill=1:1"
+
+  - probabilities are plain floats in [0, 1]
+  - delay values are ``p:seconds`` (probability p, fixed delay) or bare
+    ``seconds`` (always)
+  - ``rpc_match`` scopes every rpc_* fault to methods containing any of
+    the ``|``-separated substrings (default: all methods)
+  - ``spawn_fail=k`` fails the first k worker spawns of the process
+  - ``kill_node=heartbeats:N`` makes the raylet ``os._exit(1)`` after its
+    N-th successful heartbeat
+  - ``commit_kill=rank:index`` kills a train worker whose session has no
+    restore checkpoint (i.e. the first attempt) right after it persisted
+    its shard for report ``index`` — inside the gang-commit window
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import random
+from typing import Dict, List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+ENV_VAR = "RAY_TPU_CHAOS"
+_LOG_CAP = 8192
+
+
+class ChaosError(RuntimeError):
+    """An injected fault (deliberately NOT a RuntimeEnvSetupError: spawn
+    chaos must exercise the generic spawn-failure path, including the
+    crash-loop breaker's non-deterministic-exception counting)."""
+
+
+def _parse_prob(value: str, key: str) -> float:
+    p = float(value)
+    if not 0.0 <= p <= 1.0:
+        raise ValueError(f"{key}: probability {p} outside [0, 1]")
+    return p
+
+
+def _parse_delay(value: str, key: str) -> Tuple[float, float]:
+    """'p:seconds' or bare 'seconds' (p=1)."""
+    if ":" in value:
+        p_str, s_str = value.split(":", 1)
+        return _parse_prob(p_str, key), float(s_str)
+    return 1.0, float(value)
+
+
+class FaultPlan:
+    """A parsed, seeded fault schedule. Immutable configuration +
+    per-site deterministic RNG streams and draw counters."""
+
+    def __init__(self, spec: str = ""):
+        self.spec = spec
+        self.seed = 0
+        self.rpc_drop = 0.0
+        self.rpc_dup = 0.0
+        self.rpc_delay: Tuple[float, float] = (0.0, 0.0)
+        self.rpc_recv_drop = 0.0
+        self.rpc_recv_delay: Tuple[float, float] = (0.0, 0.0)
+        self.rpc_match: Optional[Tuple[str, ...]] = None
+        self.heartbeat_delay = 0.0
+        self.heartbeat_drop = 0.0
+        self.health_delay = 0.0
+        self.spawn_fail = 0
+        self.lease_delay: Tuple[float, float] = (0.0, 0.0)
+        self.pull_delay: Tuple[float, float] = (0.0, 0.0)
+        self.kill_node: Optional[Tuple[str, int]] = None
+        self.commit_kill: Optional[Tuple[int, int]] = None
+        for part in filter(None, (p.strip() for p in spec.split(";"))):
+            if "=" not in part:
+                raise ValueError(f"chaos spec entry {part!r} is not key=value")
+            key, value = part.split("=", 1)
+            key, value = key.strip(), value.strip()
+            if key == "seed":
+                self.seed = int(value)
+            elif key == "rpc_drop":
+                self.rpc_drop = _parse_prob(value, key)
+            elif key == "rpc_dup":
+                self.rpc_dup = _parse_prob(value, key)
+            elif key == "rpc_delay":
+                self.rpc_delay = _parse_delay(value, key)
+            elif key == "rpc_recv_drop":
+                self.rpc_recv_drop = _parse_prob(value, key)
+            elif key == "rpc_recv_delay":
+                self.rpc_recv_delay = _parse_delay(value, key)
+            elif key == "rpc_match":
+                self.rpc_match = tuple(
+                    m for m in value.split("|") if m) or None
+            elif key == "heartbeat_delay":
+                self.heartbeat_delay = float(value)
+            elif key == "heartbeat_drop":
+                self.heartbeat_drop = _parse_prob(value, key)
+            elif key == "health_delay":
+                self.health_delay = float(value)
+            elif key == "spawn_fail":
+                self.spawn_fail = int(value)
+            elif key == "lease_delay":
+                self.lease_delay = _parse_delay(value, key)
+            elif key == "pull_delay":
+                self.pull_delay = _parse_delay(value, key)
+            elif key == "kill_node":
+                if ":" in value:
+                    unit, n = value.split(":", 1)
+                else:
+                    unit, n = "heartbeats", value
+                if unit != "heartbeats":
+                    raise ValueError(
+                        f"kill_node: unknown trigger {unit!r} "
+                        f"(supported: heartbeats:N)")
+                self.kill_node = (unit, int(n))
+            elif key == "commit_kill":
+                rank, index = value.split(":", 1)
+                self.commit_kill = (int(rank), int(index))
+            else:
+                raise ValueError(f"unknown chaos key {key!r}")
+        self._send_active = (self.rpc_drop > 0 or self.rpc_dup > 0
+                             or self.rpc_delay[0] > 0)
+        self._recv_active = (self.rpc_recv_drop > 0
+                             or self.rpc_recv_delay[0] > 0)
+        self._rngs: Dict[str, random.Random] = {}
+        self._counts: Dict[str, int] = {}
+        # the replayable artifact: (site, draw_seq, decision)
+        self.schedule: List[Tuple[str, int, str]] = []
+        self._spawn_attempts = 0
+        self._heartbeats_sent = 0
+
+    # -- deterministic draw machinery -----------------------------------
+
+    def _rng(self, site: str) -> random.Random:
+        rng = self._rngs.get(site)
+        if rng is None:
+            # per-site stream: the decision sequence at one site is a pure
+            # function of (seed, site, draw index) — event-loop interleaving
+            # across sites cannot perturb it, which is what makes a chaos
+            # failure replay exactly under the same seed
+            rng = self._rngs[site] = random.Random(f"{self.seed}:{site}")
+        return rng
+
+    def _record(self, site: str, decision: str) -> None:
+        n = self._counts.get(site, 0)
+        self._counts[site] = n + 1
+        if len(self.schedule) < _LOG_CAP:
+            self.schedule.append((site, n, decision))
+
+    def _hit(self, site: str, p: float) -> bool:
+        if p >= 1.0:
+            return True
+        return self._rng(site).random() < p
+
+    # -- rpc (ClientPool send/recv) -------------------------------------
+
+    def _rpc_matches(self, method: str) -> bool:
+        if self.rpc_match is None:
+            return True
+        return any(m in method for m in self.rpc_match)
+
+    def rpc_send(self, method: str
+                 ) -> Optional[Tuple[bool, bool, float]]:
+        """(drop, dup, delay_s) for one outgoing frame, or None when no
+        send faults apply to this method."""
+        if not self._send_active or not self._rpc_matches(method):
+            return None
+        drop = self.rpc_drop > 0 and self._hit("rpc.send.drop", self.rpc_drop)
+        dup = (not drop and self.rpc_dup > 0
+               and self._hit("rpc.send.dup", self.rpc_dup))
+        delay = 0.0
+        dp, ds = self.rpc_delay
+        if dp > 0 and self._hit("rpc.send.delay", dp):
+            delay = ds
+        if drop or dup or delay:
+            self._record("rpc.send",
+                         f"{method}:{'drop' if drop else ''}"
+                         f"{'dup' if dup else ''}"
+                         f"{f'delay={delay}' if delay else ''}")
+            return (drop, dup, delay)
+        return None
+
+    def rpc_recv(self, method: str) -> Optional[Tuple[bool, float]]:
+        """(drop, delay_s) for one incoming reply frame, or None."""
+        if not self._recv_active or not self._rpc_matches(method):
+            return None
+        drop = (self.rpc_recv_drop > 0
+                and self._hit("rpc.recv.drop", self.rpc_recv_drop))
+        delay = 0.0
+        dp, ds = self.rpc_recv_delay
+        if dp > 0 and self._hit("rpc.recv.delay", dp):
+            delay = ds
+        if drop or delay:
+            self._record("rpc.recv",
+                         f"{method}:{'drop' if drop else ''}"
+                         f"{f'delay={delay}' if delay else ''}")
+            return (drop, delay)
+        return None
+
+    # -- gcs -------------------------------------------------------------
+
+    async def gcs_heartbeat(self) -> bool:
+        """Delay and/or swallow one heartbeat at the GCS handler. True
+        means the heartbeat is dropped (handler must return without
+        touching liveness state)."""
+        if self.heartbeat_delay > 0:
+            self._record("gcs.heartbeat", f"delay={self.heartbeat_delay}")
+            await asyncio.sleep(self.heartbeat_delay)
+        if self.heartbeat_drop > 0 and self._hit("gcs.heartbeat.drop",
+                                                 self.heartbeat_drop):
+            self._record("gcs.heartbeat", "drop")
+            return True
+        return False
+
+    async def gcs_health_tick(self) -> None:
+        """Stall one health-check cycle (models a wedged health checker:
+        dead nodes detected late)."""
+        if self.health_delay > 0:
+            self._record("gcs.health", f"delay={self.health_delay}")
+            await asyncio.sleep(self.health_delay)
+
+    # -- raylet ----------------------------------------------------------
+
+    def spawn_attempt(self) -> None:
+        """Raise ChaosError for the first `spawn_fail` worker spawns of
+        this raylet process."""
+        if self.spawn_fail <= 0:
+            return
+        self._spawn_attempts += 1
+        if self._spawn_attempts <= self.spawn_fail:
+            self._record("raylet.spawn",
+                         f"fail#{self._spawn_attempts}")
+            raise ChaosError(
+                f"chaos: injected worker spawn failure "
+                f"{self._spawn_attempts}/{self.spawn_fail}")
+
+    async def lease_request(self) -> None:
+        dp, ds = self.lease_delay
+        if dp > 0 and self._hit("raylet.lease", dp):
+            self._record("raylet.lease", f"delay={ds}")
+            await asyncio.sleep(ds)
+
+    def node_heartbeat_sent(self) -> None:
+        """Abrupt node death: the raylet exits without any cleanup after
+        its N-th successful heartbeat (models hardware loss — workers
+        orphaned, arena left behind, GCS learns via missed heartbeats)."""
+        if self.kill_node is None:
+            return
+        self._heartbeats_sent += 1
+        if self._heartbeats_sent >= self.kill_node[1]:
+            self._record("raylet.kill_node",
+                         f"heartbeat#{self._heartbeats_sent}")
+            logger.warning("chaos: killing node after %d heartbeats",
+                           self._heartbeats_sent)
+            os._exit(1)
+
+    # -- core worker -----------------------------------------------------
+
+    async def object_pull(self) -> None:
+        dp, ds = self.pull_delay
+        if dp > 0 and self._hit("core_worker.pull", dp):
+            self._record("core_worker.pull", f"delay={ds}")
+            await asyncio.sleep(ds)
+
+    # -- train session ---------------------------------------------------
+
+    def train_pre_commit(self, world_rank: int, report_index: int,
+                         fresh: bool) -> None:
+        """Kill this rank between its own shard persist and the gang
+        commit. Fires only on a session with no restore checkpoint
+        (`fresh`), so the retried attempt survives the same plan."""
+        if self.commit_kill is None or not fresh:
+            return
+        rank, index = self.commit_kill
+        if world_rank == rank and report_index == index:
+            self._record("train.pre_commit",
+                         f"kill rank={rank} index={index}")
+            logger.warning("chaos: killing rank %d before gang commit of "
+                           "report %d", rank, index)
+            os._exit(1)
+
+
+# ---------------------------------------------------------------------------
+# process-global plan
+# ---------------------------------------------------------------------------
+
+_PLAN: Optional[FaultPlan] = None
+
+
+def plan() -> Optional[FaultPlan]:
+    return _PLAN
+
+
+def active() -> bool:
+    return _PLAN is not None
+
+
+def install(p: FaultPlan) -> FaultPlan:
+    global _PLAN
+    _PLAN = p
+    logger.warning("chaos plane active: %s", p.spec or "<programmatic>")
+    return p
+
+
+def uninstall() -> None:
+    global _PLAN
+    _PLAN = None
+
+
+def init_from_env() -> Optional[FaultPlan]:
+    """(Re)read RAY_TPU_CHAOS. Called at import; tests may call it again
+    after mutating the environment."""
+    spec = os.environ.get(ENV_VAR, "")
+    if spec:
+        return install(FaultPlan(spec))
+    uninstall()
+    return None
+
+
+init_from_env()

@@ -22,6 +22,7 @@ from collections import OrderedDict
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional
 
+from ray_tpu._private import fault_injection as _fi
 from ray_tpu._private import task as task_mod
 from ray_tpu._private.config import Config
 from ray_tpu.util import events as export_events
@@ -362,6 +363,12 @@ class GcsServer:
         return {"ok": True}
 
     async def rpc_heartbeat(self, req):
+        if _fi._PLAN is not None:
+            # chaos: delayed handling stalls liveness bookkeeping (the
+            # health-check loop may mark the node dead meanwhile); a
+            # dropped heartbeat never touches state at all
+            if await _fi._PLAN.gcs_heartbeat():
+                return {"ok": True}
         node_id = req["node_id"]
         node = self.nodes.get(node_id)
         if node is None or not node["alive"]:
@@ -511,6 +518,8 @@ class GcsServer:
         threshold = self.config.health_check_failure_threshold
         while True:
             await asyncio.sleep(period)
+            if _fi._PLAN is not None:
+                await _fi._PLAN.gcs_health_tick()
             now = time.monotonic()
             for node_id, node in list(self.nodes.items()):
                 if not node["alive"]:

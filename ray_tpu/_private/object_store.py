@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import mmap
 import os
+import sys
 
 from ray_tpu._private import serialization
 from ray_tpu._private.ids import ObjectID
@@ -43,12 +44,18 @@ class ObjectTimeoutError(ObjectStoreError):
 class PlasmaBuffer:
     """Holds one store reference for the lifetime of its zero-copy views.
 
-    Views are exported through the PEP-688 buffer protocol, so any memoryview
-    slice (and any numpy array reconstructed from one by pickle5) keeps this
-    object alive; when the last view is garbage-collected, __del__ drops the
-    store refcount and the object becomes evictable again. This mirrors the
-    reference's plasma client Buffer semantics
+    Views are exported through the PEP-688 buffer protocol on 3.12+, so any
+    memoryview slice (and any numpy array reconstructed from one by pickle5)
+    keeps this object alive; when the last view is garbage-collected, __del__
+    drops the store refcount and the object becomes evictable again. This
+    mirrors the reference's plasma client Buffer semantics
     (src/ray/object_manager/plasma/client.cc — release-on-buffer-destruction).
+
+    Interpreters older than 3.12 cannot export a buffer from pure Python
+    (`__buffer__` is ignored and memoryview(self) raises TypeError), so
+    `export()` re-exports the view through a ctypes array: the array pins the
+    underlying view, derived memoryviews pin the array, and an attribute on
+    the array pins this object — the same release-on-last-view lifetime.
     """
 
     __slots__ = ("_store", "_id_bytes", "_view", "__weakref__")
@@ -60,6 +67,14 @@ class PlasmaBuffer:
 
     def __buffer__(self, flags: int) -> memoryview:
         return self._view
+
+    def export(self) -> memoryview:
+        """A memoryview over the object's bytes that holds the store ref."""
+        if sys.version_info >= (3, 12):
+            return memoryview(self)
+        arr = (ctypes.c_char * self._view.nbytes).from_buffer(self._view)
+        arr._plasma_ref = self  # released when the last derived view dies
+        return memoryview(arr)
 
     @property
     def nbytes(self) -> int:
@@ -181,7 +196,7 @@ class ObjectStore:
         if off < 0:
             raise ObjectStoreError(f"get failed: {off}")
         raw = self._slice(off, size.value)
-        return memoryview(PlasmaBuffer(self, object_id.binary(), raw))
+        return PlasmaBuffer(self, object_id.binary(), raw).export()
 
     def get(self, object_id: ObjectID, timeout: float | None = -1):
         buf = self.get_buffer(object_id, timeout)

@@ -28,6 +28,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
 from ray_tpu._private import accelerators
+from ray_tpu._private import fault_injection as _fi
 from ray_tpu._private import task as task_mod
 from ray_tpu._private.config import Config
 from ray_tpu._private.ids import NodeID, ObjectID
@@ -318,6 +319,8 @@ class Raylet:
                     "busy_workers": len(self._workers) - sum(
                         len(p) for p in self._idle.values()),
                 }, timeout=5.0)
+                if _fi._PLAN is not None:
+                    _fi._PLAN.node_heartbeat_sent()  # may os._exit(1)
                 self._freed_since_heartbeat = False
                 if reply.get("reregister"):
                     await self.gcs.call("register_node", {
@@ -726,6 +729,8 @@ class Raylet:
     # ------------------------------------------------------------------
 
     async def rpc_request_worker_lease(self, req):
+        if _fi._PLAN is not None:
+            await _fi._PLAN.lease_request()
         spec = task_mod.TaskSpec.from_wire(req["spec"])
         dedicated = bool(req.get("dedicated")) or \
             spec.task_type == task_mod.ACTOR_CREATION_TASK
@@ -961,6 +966,8 @@ class Raylet:
                                           starting_key)
             return
         try:
+            if _fi._PLAN is not None:
+                _fi._PLAN.spawn_attempt()
             proc = await self._spawn_worker(job_id, chips, runtime_env)
         except Exception as e:
             logger.exception("worker spawn failed")
@@ -973,6 +980,23 @@ class Raylet:
                 # leases waiting on this env instead of respawning forever
                 self._fail_leases_for_key(
                     key, f"runtime_env setup failed: {e}")
+                return
+            # Spawn-time exceptions that are NOT deterministic env errors
+            # (transient OSError, unexpected backend failures, injected
+            # chaos) feed the same crash-loop breaker as pre-registration
+            # worker deaths: without this a persistently failing spawn
+            # path would stall its leases until some unrelated event
+            # re-triggered _dispatch, and a permanently failing one would
+            # retry forever.
+            n = self._startup_failures.get(starting_key, 0) + 1
+            self._startup_failures[starting_key] = n
+            if n >= self.config.max_worker_startup_failures:
+                self._fail_leases_for_key(
+                    starting_key,
+                    f"worker spawn crash-looped ({n} consecutive spawn "
+                    f"failures; last: {e})")
+            else:
+                self._dispatch()  # re-drive the shortfall spawn now
             return
         self._spawned_procs.append((proc, key, starting_key))
 

@@ -22,6 +22,8 @@ from typing import Any, Awaitable, Callable, Dict, Optional
 
 import msgpack
 
+from ray_tpu._private import fault_injection as _fi
+
 logger = logging.getLogger(__name__)
 
 REQUEST, REPLY_OK, REPLY_ERR, NOTIFY = 0, 1, 2, 3
@@ -172,6 +174,13 @@ class RpcClient:
         try:
             while True:
                 msgid, kind, method, payload = await _read_frame(self._reader)
+                if _fi._PLAN is not None:
+                    act = _fi._PLAN.rpc_recv(method)
+                    if act is not None:
+                        if act[1]:
+                            await asyncio.sleep(act[1])  # delayed delivery
+                        if act[0]:
+                            continue  # reply lost on the wire
                 fut = self._pending.pop(msgid, None)
                 if fut is None or fut.done():
                     continue
@@ -199,9 +208,22 @@ class RpcClient:
         fut = asyncio.get_event_loop().create_future()
         self._pending[msgid] = fut
         frame = _pack([msgid, REQUEST, method, payload])
-        async with self._lock:
-            self._writer.write(frame)
-            await self._writer.drain()
+        if _fi._PLAN is not None:
+            act = _fi._PLAN.rpc_send(method)
+            if act is not None:
+                drop, dup, delay = act
+                if delay:
+                    await asyncio.sleep(delay)
+                if drop:
+                    frame = b""  # request lost: the pending future only
+                    # resolves via the caller's timeout / retry machinery
+                elif dup:
+                    frame = frame + frame  # at-least-once duplication;
+                    # the second reply's msgid is already popped, ignored
+        if frame:
+            async with self._lock:
+                self._writer.write(frame)
+                await self._writer.drain()
         if timeout is None:
             return await fut
         return await asyncio.wait_for(fut, timeout)
@@ -215,13 +237,39 @@ class RpcClient:
         msgid = next(self._msgid)
         fut = asyncio.get_event_loop().create_future()
         self._pending[msgid] = fut
-        self._writer.write(_pack([msgid, REQUEST, method, payload]))
+        frame = _pack([msgid, REQUEST, method, payload])
+        if _fi._PLAN is not None:
+            act = _fi._PLAN.rpc_send(method)
+            if act is not None:
+                drop, dup, delay = act
+                if drop:
+                    return fut  # lost: resolves via caller timeout/retry
+                if dup:
+                    frame = frame + frame
+                if delay:
+                    # sync fast path cannot await: reschedule the write
+                    def _late_write(w=self._writer, f=frame):
+                        if not w.is_closing():
+                            w.write(f)
+                    asyncio.get_event_loop().call_later(delay, _late_write)
+                    return fut
+        self._writer.write(frame)
         return fut
 
     async def notify(self, method: str, payload: Any = None):
         if self._writer is None or self._dead:
             raise ConnectionLost(f"not connected: {self.address}")
         frame = _pack([0, NOTIFY, method, payload])
+        if _fi._PLAN is not None:
+            act = _fi._PLAN.rpc_send(method)
+            if act is not None:
+                drop, dup, delay = act
+                if delay:
+                    await asyncio.sleep(delay)
+                if drop:
+                    return  # fire-and-forget frame lost entirely
+                if dup:
+                    frame = frame + frame
         async with self._lock:
             self._writer.write(frame)
             await self._writer.drain()

@@ -408,6 +408,16 @@ def merge_wire(base: Dict, override: Dict) -> Dict:
             merged[k] = ev
         else:
             merged[k] = v
+    if merged.get("pip") and merged.get("conda"):
+        # prepare() validates single env dicts only; the merge can still
+        # combine a job-level conda with a per-actor pip (or vice versa),
+        # and the raylet's spawn path would silently prefer pip. The
+        # reference raises on the combination — so do we.
+        raise ValueError(
+            "merged runtime_env cannot set both pip and conda (job-level "
+            "and per-actor/task envs combined to a pip+conda env; "
+            "reference semantics: pip installs INTO a conda env via the "
+            "spec's own pip section)")
     merged["_hash"] = hashlib.sha1(
         json.dumps(merged, sort_keys=True).encode()).hexdigest()[:16]
     return merged
@@ -578,7 +588,16 @@ def ensure_conda_env(conda_wire: Dict) -> str:
             raise RuntimeEnvSetupError(
                 f"conda env {name!r} not usable: "
                 f"{stderr[-500:] or e}") from e
-        py = out.stdout.strip().splitlines()[-1]
+        lines = out.stdout.strip().splitlines()
+        if not lines:
+            # `conda run` exiting 0 with empty stdout must be a
+            # deterministic setup failure: anything else (IndexError)
+            # reads as transient, and the raylet would respawn forever
+            # while the waiting leases hang
+            raise RuntimeEnvSetupError(
+                f"conda env {name!r}: `conda run` produced no interpreter "
+                f"path (stderr: {(out.stderr or '').strip()[-500:] or 'empty'})")
+        py = lines[-1]
         _conda_named_cache[name] = py
         return py
     spec = conda_wire["spec"]

@@ -15,10 +15,13 @@ interface is the seam where an actual Redis/etcd client would slot in
 
 from __future__ import annotations
 
+import logging
 import os
 import pickle
 from typing import Any, Dict, Optional
 from urllib.parse import quote, unquote
+
+logger = logging.getLogger(__name__)
 
 
 class StoreClient:
@@ -83,8 +86,36 @@ class FileStoreClient(StoreClient):
             if canon != name:
                 src = os.path.join(root, name)
                 dst = os.path.join(root, canon)
-                if os.path.isdir(src) and not os.path.exists(dst):
+                if not os.path.isdir(src):
+                    continue
+                if not os.path.exists(dst):
                     os.replace(src, dst)
+                    continue
+                # Mixed-version writes left BOTH dirs: merge the legacy
+                # dir's key files into the canonical one (existing keys
+                # win — they were written by the newer GCS) instead of
+                # silently orphaning the legacy keys on restore.
+                merged = 0
+                for key_name in os.listdir(src):
+                    path = os.path.join(src, key_name)
+                    target = os.path.join(dst, key_name)
+                    if (".tmp." in key_name or os.path.exists(target)):
+                        # torn leftover, or superseded by a newer write
+                        # in the canonical dir — either way dead data;
+                        # removing it lets the legacy dir go away (a
+                        # lingering dir would double-list the table)
+                        os.unlink(path)
+                        continue
+                    os.replace(path, target)
+                    merged += 1
+                logger.warning(
+                    "FileStoreClient: merged %d legacy key file(s) from "
+                    "%r into %r (keys already present in the canonical "
+                    "dir were kept)", merged, name, canon)
+                try:
+                    os.rmdir(src)
+                except OSError:
+                    pass
 
     def _table_dir(self, table: str) -> str:
         # Reversible path-safe encoding: tables() reconstructs kv

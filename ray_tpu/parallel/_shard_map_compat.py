@@ -1,0 +1,25 @@
+"""`shard_map` import shim across the jax API move.
+
+jax exports `shard_map` at top level from ~0.6 with the `check_vma`
+kwarg; before that it lives in `jax.experimental.shard_map` and the same
+knob is spelled `check_rep`. All ray_tpu call sites use the new spelling
+and import from here.
+"""
+
+from __future__ import annotations
+
+try:
+    from jax import shard_map  # jax >= 0.6
+except ImportError:  # pragma: no cover - exercised on jax 0.4.x boxes
+    import functools
+
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    @functools.wraps(_shard_map)
+    def shard_map(*args, **kwargs):
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _shard_map(*args, **kwargs)
+
+
+__all__ = ["shard_map"]

@@ -46,6 +46,8 @@ class BackendExecutor:
         self.pgs: list = []
         self._finished_workers: set[int] = set()
         self._errors: Dict[int, str] = {}
+        # ranks whose last report awaits the gang-commit ack: (rank, index)
+        self._pending_commit: list[tuple[int, int]] = []
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -122,6 +124,7 @@ class BackendExecutor:
                 trial_id=self.trial_id,
                 trial_dir=trial_dir,
                 checkpoint=checkpoint,
+                gang_commit=True,
             )
             init_refs.append((w, cfg))
         total_local = dict(local_counts)
@@ -140,6 +143,7 @@ class BackendExecutor:
                      for w in wg.workers], timeout=60)
         self._finished_workers = set()
         self._errors = {}
+        self._pending_commit = []
 
     def _assign_dataset_shards(self, datasets: Dict[str, Any]) -> None:
         """Split each dataset across workers.
@@ -205,6 +209,12 @@ class BackendExecutor:
                             f"train worker rank={i} failed:\n{item['_error']}")
                 else:
                     results[i] = item
+                    if item.get("gang_commit"):
+                        # the rank is now blocked in report()'s commit
+                        # barrier; released by commit_gang_checkpoint()
+                        # once the controller registered the checkpoint
+                        self._pending_commit.append(
+                            (i, item["report_index"]))
             pending = still
             if results and all(
                 (i in results or i in self._finished_workers)
@@ -215,10 +225,39 @@ class BackendExecutor:
             return None
         return [results[i] for i in sorted(results)]
 
+    def commit_gang_checkpoint(self, timeout: float = 60.0) -> None:
+        """Second half of the gang-durable commit: release every rank
+        blocked in report()'s barrier. Called by the controller AFTER it
+        registered the checkpoint — at that point every rank's shard is
+        durable (each rank persists before enqueueing its report, and
+        the barrier only arms once get_next_results collected the report
+        from every live rank), so report() may return everywhere. No-op
+        when no checkpoint report is pending."""
+        pending, self._pending_commit = self._pending_commit, []
+        if not pending or self.worker_group is None:
+            return
+        wg = self.worker_group
+        refs = [wg.workers[i].ack_commit.remote(idx) for i, idx in pending]
+        for ref in refs:
+            try:
+                ray_tpu.get(ref, timeout=timeout)
+            except Exception:  # noqa: BLE001 — ack delivery is best-effort
+                # The ack released the rank BEFORE its reply frame went
+                # out, so a rank that exits immediately after resuming
+                # (elastic tests, real preemption) can die mid-reply.
+                # The checkpoint is already registered — the commit
+                # happened — and worker death is adjudicated by the next
+                # get_next_results poll; surfacing the delivery error
+                # here would turn a committed step into a spurious
+                # trial-level failure that skips the train-level
+                # walk-back.
+                continue
+
     def pause_reporting(self) -> None:
         pass
 
     def shutdown(self) -> None:
+        self._pending_commit = []
         if self.worker_group is not None:
             try:
                 self.backend.on_shutdown(self.worker_group,

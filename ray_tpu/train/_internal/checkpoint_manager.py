@@ -14,6 +14,12 @@ from ray_tpu.air.checkpoint import Checkpoint
 from ray_tpu.air.config import CheckpointConfig
 
 
+class IncompleteCheckpointError(RuntimeError):
+    """A checkpoint offered for registration is missing shard
+    contributions — registering it would make an unusable checkpoint a
+    resume candidate."""
+
+
 class _TrackedCheckpoint:
     def __init__(self, checkpoint: Checkpoint, metrics: Dict[str, Any],
                  index: int):
@@ -29,7 +35,23 @@ class CheckpointManager:
         self._index = 0
 
     def register_checkpoint(self, checkpoint: Checkpoint,
-                            metrics: Dict[str, Any]) -> None:
+                            metrics: Dict[str, Any],
+                            require_usable: bool = False) -> None:
+        if require_usable:
+            # Gang-durable commit gate: a sharded checkpoint is only
+            # committed when every process's contribution is present and
+            # readable. In the barrier protocol this should always hold
+            # (each rank persists before reporting), so tripping here
+            # means a shard went missing between persist and commit —
+            # fail the step rather than ack a checkpoint that cannot be
+            # restored.
+            from ray_tpu.train import array_checkpoint
+
+            if not array_checkpoint.is_usable(checkpoint):
+                raise IncompleteCheckpointError(
+                    f"checkpoint {checkpoint.path!r} is missing shard "
+                    f"contributions; refusing to register it as a resume "
+                    f"candidate")
         self._checkpoints.append(
             _TrackedCheckpoint(checkpoint, dict(metrics), self._index))
         self._index += 1

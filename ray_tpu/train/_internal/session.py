@@ -6,6 +6,15 @@ user's `train_loop_per_worker` on a background thread inside the train
 worker actor; `report()` synchronizes with the controller by blocking until
 the controller has consumed the previous result (queue of size 1, matching
 the reference's back-to-back report semantics).
+
+Checkpoint reports are additionally a GANG BARRIER when the session is
+configured with `gang_commit` (Train worker sessions): `report(checkpoint=)`
+does not return on any rank until every rank's shard contribution is
+durable and the controller has registered the checkpoint — the
+persist-before-return semantics of the reference's
+`StorageContext.persist_current_checkpoint`
+(`python/ray/train/_internal/storage.py:349`), extended across the gang so
+elastic walk-back always lands on a checkpoint the whole gang committed.
 """
 
 from __future__ import annotations
@@ -17,6 +26,7 @@ import shutil
 import threading
 from typing import Any, Dict, Optional
 
+from ray_tpu._private import fault_injection as _fi
 from ray_tpu.air.checkpoint import Checkpoint
 
 
@@ -37,6 +47,10 @@ class SessionConfig:
     trial_dir: str = ""        # {storage_path}/{trial_id}
     checkpoint: Optional[Checkpoint] = None   # restore-from
     metadata: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    # Gang-durable commit: report(checkpoint=) blocks until the controller
+    # has registered the checkpoint and acked every rank (Train worker
+    # sessions; Tune trial sessions keep per-worker semantics).
+    gang_commit: bool = False
 
 
 class _TrainSession:
@@ -48,12 +62,18 @@ class _TrainSession:
         self._report_index = 0
         self._last_checkpoint = config.checkpoint
         self.datasets: Dict[str, Any] = {}
+        # gang-commit barrier state: highest report index the controller
+        # has acked as registered; abort releases blocked reporters
+        self._commit_cond = threading.Condition()
+        self._commit_index = -1
+        self._commit_abort: Optional[str] = None
         os.makedirs(config.trial_dir, exist_ok=True)
 
     # called from the user's train-fn thread
     def report(self, metrics: Dict[str, Any],
                checkpoint: Optional[Checkpoint] = None) -> None:
         persisted_path = None
+        index = self._report_index
         if checkpoint is not None:
             if getattr(checkpoint, "_persisted", False):
                 # Already in durable trial storage (e.g. Train's controller
@@ -63,16 +83,61 @@ class _TrainSession:
             else:
                 persisted_path = self._persist_checkpoint(checkpoint)
             self._last_checkpoint = Checkpoint(persisted_path)
+            if _fi._PLAN is not None:
+                # chaos window: this rank's shard is durable, the gang
+                # commit has not happened — the exact interval the
+                # gang-durable guarantee exists to survive
+                _fi._PLAN.train_pre_commit(
+                    self.config.world_rank, index,
+                    fresh=self.config.checkpoint is None)
+        needs_commit = checkpoint is not None and self.config.gang_commit
         item = {
             "metrics": dict(metrics),
             "checkpoint_path": persisted_path,
-            "report_index": self._report_index,
+            "report_index": index,
             "world_rank": self.config.world_rank,
         }
+        if needs_commit:
+            item["gang_commit"] = True
         self._report_index += 1
         # Blocks until the controller drained the previous report — keeps
         # workers in lockstep the way the reference's session does.
         self.result_queue.put(item)
+        if needs_commit:
+            # Gang-durable commit (reference semantics: persist-before-
+            # return, `python/ray/train/_internal/storage.py:349`): do not
+            # return on ANY rank until every rank's shard contribution is
+            # durable and the controller has registered the checkpoint.
+            # The controller only acks after it has collected this report
+            # index from every live rank (each rank persists before
+            # enqueueing, so collection implies durability) and put the
+            # checkpoint in its CheckpointManager — a rank that dies
+            # after this point can no longer strand a checkpoint the gang
+            # believed committed.
+            self._await_commit(index)
+
+    def _await_commit(self, index: int) -> None:
+        with self._commit_cond:
+            while self._commit_index < index and self._commit_abort is None:
+                self._commit_cond.wait(timeout=1.0)
+            if self._commit_index < index:
+                raise RuntimeError(
+                    f"gang checkpoint commit aborted: {self._commit_abort}")
+
+    def ack_commit(self, index: int) -> None:
+        """Controller-side ack: the checkpoint of report `index` is
+        registered; release the reporter."""
+        with self._commit_cond:
+            if index > self._commit_index:
+                self._commit_index = index
+            self._commit_cond.notify_all()
+
+    def abort_commit(self, reason: str) -> None:
+        """Release a blocked reporter with an error (session shutdown /
+        gang teardown) instead of leaving the train thread wedged."""
+        with self._commit_cond:
+            self._commit_abort = reason
+            self._commit_cond.notify_all()
 
     def get_checkpoint(self) -> Optional[Checkpoint]:
         return self._last_checkpoint
@@ -121,6 +186,8 @@ def get_session() -> Optional[_TrainSession]:
 def shutdown_session() -> None:
     global _session
     with _session_lock:
+        if _session is not None:
+            _session.abort_commit("session shutdown")
         _session = None
 
 

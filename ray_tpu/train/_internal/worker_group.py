@@ -103,6 +103,12 @@ class TrainWorker:
             return {"_finished": True}
         return None
 
+    def ack_commit(self, report_index: int) -> None:
+        """Gang-commit ack from the controller: the checkpoint of
+        `report_index` is registered — release report()'s barrier."""
+        assert self._session is not None
+        self._session.ack_commit(report_index)
+
     def shutdown_session(self) -> None:
         session_mod.shutdown_session()
         self._session = None

@@ -93,6 +93,17 @@ def _worker_jax_platform() -> str:
 def _init_jax_distributed(coordinator: str, num_processes: int,
                           process_id: int) -> str:
     import jax
+    if (os.environ.get("JAX_PLATFORMS") or "").startswith("cpu"):
+        # A multi-process gang on the CPU backend needs a cross-process
+        # collectives implementation or XLA refuses every computation on
+        # non-fully-addressable arrays ("Multiprocess computations aren't
+        # implemented on the CPU backend"). Must land before the CPU
+        # client is instantiated; harmless when the jax build lacks the
+        # flag (TPU workers never take this branch).
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:
+            pass
     try:
         jax.distributed.initialize(
             coordinator_address=coordinator,

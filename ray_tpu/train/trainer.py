@@ -30,7 +30,10 @@ from ray_tpu.train._internal.backend_executor import (
     BackendExecutor,
     TrainingFailedError,
 )
-from ray_tpu.train._internal.checkpoint_manager import CheckpointManager
+from ray_tpu.train._internal.checkpoint_manager import (
+    CheckpointManager,
+    IncompleteCheckpointError,
+)
 from ray_tpu.train.backend import BackendConfig, JaxConfig
 
 
@@ -196,8 +199,19 @@ class DataParallelTrainer(BaseTrainer):
                         # copy would double disk use and escape the
                         # CheckpointManager's num_to_keep eviction).
                         checkpoint._persisted = True
-                        ckpt_manager.register_checkpoint(
-                            checkpoint, last_metrics)
+                        try:
+                            ckpt_manager.register_checkpoint(
+                                checkpoint, last_metrics,
+                                require_usable=True)
+                        except IncompleteCheckpointError as e:
+                            raise TrainingFailedError(str(e)) from e
+                    # Gang-durable commit: the checkpoint is registered;
+                    # release every rank blocked in report()'s barrier.
+                    # Unconditional — when rank 0 has already finished,
+                    # later ranks' checkpoint reports still hold the
+                    # barrier and must be released even though nothing
+                    # was registered for them.
+                    executor.commit_gang_checkpoint()
                     if report_fn is not None:
                         report_fn(last_metrics, checkpoint=checkpoint)
                 executor.shutdown()

@@ -161,11 +161,14 @@ def _make_elastic_loop():
         rank = train_mod.get_context().get_world_rank()
         for i in range(start, 4):
             if i == 2 and rank == 1 and start == 0:
-                # Simulated hardware loss, first attempt only. The extra
-                # report makes the kill deterministic for the assertion:
-                # its enqueue (queue size 1) can only complete after the
-                # controller drained — and registered — the step-2
-                # checkpoint, so the resume point is always step 2.
+                # Simulated hardware loss, first attempt only. The kill is
+                # deterministic because checkpoint reports are a gang
+                # barrier: the step-2 report (i == 1) did not return on
+                # THIS rank until every rank's shard was durable and the
+                # controller registered the checkpoint
+                # (session.report gang_commit + ack_commit), so reaching
+                # this line proves step 2 is gang-committed and the
+                # walk-back can only land there.
                 train_mod.report({"step": i, "pre_crash": True})
                 os_mod._exit(1)
             state = update(state)

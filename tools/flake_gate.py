@@ -1,0 +1,71 @@
+#!/usr/bin/env python
+"""Flake gate: prove a test is deterministic by running it N times solo.
+
+The gang-durable commit turned `test_elastic_restore_bit_identical`'s
+`resumed_from == 2` assertion from a ~50% race into a guarantee; this
+gate keeps it that way. Any non-deterministic failure across the runs
+fails the gate and leaves the failing run's full pytest output in the
+log directory for replay.
+
+Usage:
+    python tools/flake_gate.py                      # default target, 20 runs
+    python tools/flake_gate.py -n 5 tests/test_chaos.py::test_commit_kill_walks_back_to_gang_durable
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+DEFAULT_TARGET = (
+    "tests/test_sharded_checkpoint.py::test_elastic_restore_bit_identical")
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("target", nargs="?", default=DEFAULT_TARGET)
+    parser.add_argument("-n", "--runs", type=int, default=20)
+    parser.add_argument("--timeout", type=float, default=300.0,
+                        help="per-run timeout in seconds")
+    args = parser.parse_args()
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    log_dir = tempfile.mkdtemp(prefix="flake_gate_")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    failures = []
+    for i in range(1, args.runs + 1):
+        log_path = os.path.join(log_dir, f"run_{i:02d}.log")
+        start = time.monotonic()
+        with open(log_path, "wb") as log:
+            try:
+                proc = subprocess.run(
+                    [sys.executable, "-m", "pytest", args.target, "-q",
+                     "-p", "no:cacheprovider", "-p", "no:randomly"],
+                    cwd=repo_root, env=env, stdout=log,
+                    stderr=subprocess.STDOUT, timeout=args.timeout)
+                rc = proc.returncode
+            except subprocess.TimeoutExpired:
+                rc = -1
+        took = time.monotonic() - start
+        status = "ok" if rc == 0 else f"FAIL rc={rc}"
+        print(f"[flake-gate] run {i:2d}/{args.runs}: {status} "
+              f"({took:.1f}s)", flush=True)
+        if rc != 0:
+            failures.append((i, log_path))
+    if failures:
+        print(f"[flake-gate] {len(failures)}/{args.runs} runs failed — "
+              f"the test is non-deterministic. Failing logs:")
+        for i, path in failures:
+            print(f"  run {i}: {path}")
+        return 1
+    print(f"[flake-gate] {args.runs}/{args.runs} green — deterministic. "
+          f"Logs: {log_dir}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
