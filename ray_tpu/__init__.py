@@ -7,6 +7,13 @@ collectives over ICI instead of NCCL, and a native shared-memory object
 store + asyncio control plane for the runtime.
 """
 
+# Lockdep must see every lock the runtime creates, so it installs before
+# any other ray_tpu module is imported (worker daemons spawned with
+# RAY_TPU_LOCKDEP=1 in their environment self-install the same way).
+from ray_tpu._private import lockdep as _lockdep
+
+_lockdep.init_from_env()
+
 from ray_tpu._private.core_worker import (
     ActorDiedError,
     GetTimeoutError,

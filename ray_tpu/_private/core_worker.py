@@ -133,7 +133,10 @@ class _MemoryStore:
 
     def register_thread_waiter(self, oid: bytes) -> None:
         """Mark oid as a pending owned result (cheap sentinel form)."""
-        self.thread_waiters[oid] = None
+        # Sentinel store from the single submit thread before any getter
+        # can observe the oid — part of the documented lock-free protocol
+        # above (only the upgrade path needs _arm_lock).
+        self.thread_waiters[oid] = None  # raylint: disable=lock-discipline
 
     def arm_thread_waiter(self, oid: bytes) -> Optional[SyncFuture]:
         """Caller-thread: upgrade the sentinel to a blockable Future.
@@ -158,6 +161,8 @@ class _MemoryStore:
         # thread may have grabbed this same future in the meantime and
         # would otherwise block on it forever.
         if self.ready(oid):
+            # loop-thread-style pop, deliberately outside _arm_lock (see
+            # ordering comment above) # raylint: disable=lock-discipline
             w = self.thread_waiters.pop(oid, None)
             if w is not None and not w.done():
                 w.set_result(True)
@@ -168,6 +173,8 @@ class _MemoryStore:
         ev = self._events.pop(oid, None)
         if ev is not None:
             ev.set()
+        # loop thread is the sole popper; armed futures are resolved, not
+        # mutated, so no lock is needed # raylint: disable=lock-discipline
         waiter = self.thread_waiters.pop(oid, None)
         if waiter is not None and not waiter.done():
             waiter.set_result(True)

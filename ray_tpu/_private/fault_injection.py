@@ -194,6 +194,14 @@ class FaultPlan:
             return True
         return self._rng(site).random() < p
 
+    def rng_for(self, site: str) -> random.Random:
+        """Public per-site seeded stream for OTHER runtime randomness
+        (scheduling tiebreaks, backoff jitter, …). Routing every
+        probabilistic decision through a named site keeps the whole run
+        a pure function of the seed — raylint's seeded-rng checker flags
+        bare `random.*` in `_private/` for exactly this reason."""
+        return self._rng(site)
+
     # -- rpc (ClientPool send/recv) -------------------------------------
 
     def _rpc_matches(self, method: str) -> bool:

@@ -250,6 +250,9 @@ def ensure_pip_env(pip_wire: Dict) -> str:
                 pass
             return py
         try:
+            # holding key_lock across the build is the point: it is the
+            # per-env stripe that makes concurrent requesters wait for
+            # one builder # raylint: disable=blocking-under-lock
             return _build_pip_env(pip_wire, root, dest, py, ready)
         except RuntimeEnvSetupError as e:
             _pip_failed[key] = str(e)
@@ -618,6 +621,9 @@ def ensure_conda_env(conda_wire: Dict) -> str:
                 pass
             return py
         try:
+            # per-env stripe held across the build by design (one
+            # builder, everyone else waits)
+            # raylint: disable=blocking-under-lock
             return _build_conda_env(exe, spec, dest, py, ready)
         except RuntimeEnvSetupError as e:
             _conda_failed[key] = str(e)

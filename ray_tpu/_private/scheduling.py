@@ -13,7 +13,22 @@ import random
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
+from ray_tpu._private import fault_injection as _fi
 from ray_tpu._private import task as task_mod
+
+# Placement tiebreaks draw from a dedicated stream, never the global
+# `random` module: under RAY_TPU_CHAOS the stream comes from the
+# FaultPlan's per-site seeded streams so the scheduling decision sequence
+# replays identically with the fault schedule; without a plan it is an
+# ordinary process-local stream.
+_DEFAULT_RNG = random.Random()
+
+
+def _tiebreak_rng() -> random.Random:
+    plan = _fi.plan()
+    if plan is not None:
+        return plan.rng_for("scheduling.tiebreak")
+    return _DEFAULT_RNG
 
 
 @dataclass
@@ -99,7 +114,7 @@ def pick_node(
         if not fitting:
             return None
         # Least-utilized first; random tiebreak for even spread.
-        (rng or random).shuffle(fitting)
+        (rng or _tiebreak_rng()).shuffle(fitting)
         return min(fitting, key=lambda n: n.utilization())
 
     # DEFAULT hybrid policy: prefer the local node while it is under the
@@ -128,7 +143,7 @@ def _best_fit(nodes: List[NodeResources], demand: Dict[str, float],
     # would pile every weightless placement (actors release their CPU
     # after creation, so utilization never rises between heartbeats)
     # onto whichever node happens to list first.
-    (rng or random).shuffle(fitting)
+    (rng or _tiebreak_rng()).shuffle(fitting)
     return min(fitting, key=lambda n: n.utilization())
 
 

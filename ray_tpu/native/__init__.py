@@ -27,6 +27,8 @@ def load_shm_store() -> ctypes.CDLL:
         if not os.path.exists(_SO) or (
             os.path.exists(src) and os.path.getmtime(src) > os.path.getmtime(_SO)
         ):
+            # _build_lock exists precisely to serialize this make
+            # invocation # raylint: disable=blocking-under-lock
             _build()
     lib = ctypes.CDLL(_SO)
     lib.ss_create_store.argtypes = [ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint32]

@@ -30,19 +30,23 @@ class _Batcher:
     def submit(self, instance, item: Any) -> Any:
         entry = {"item": item, "event": threading.Event(),
                  "result": None, "error": None}
-        run_now = False
+        batch: List[dict] = []
+        timer = None
         with self._lock:
             self._queue.append(entry)
             if len(self._queue) >= self.max_batch_size:
-                batch = self._drain()
-                run_now = True
+                batch, self._queue = self._queue, []
+                self._flush_scheduled = False
             elif not self._flush_scheduled:
                 self._flush_scheduled = True
                 timer = threading.Timer(
                     self.timeout, self._flush_timer, args=(instance,))
                 timer.daemon = True
-                timer.start()
-        if run_now:
+        # thread spawn and the batched call both stay outside the
+        # critical section: the lock only guards the queue swap
+        if timer is not None:
+            timer.start()
+        if batch:
             self._run(instance, batch)
         if not entry["event"].wait(timeout=600.0):
             raise TimeoutError(
@@ -51,14 +55,10 @@ class _Batcher:
             raise entry["error"]
         return entry["result"]
 
-    def _drain(self) -> List[dict]:
-        batch, self._queue = self._queue, []
-        self._flush_scheduled = False
-        return batch
-
     def _flush_timer(self, instance):
         with self._lock:
-            batch = self._drain()
+            batch, self._queue = self._queue, []
+            self._flush_scheduled = False
         if batch:
             self._run(instance, batch)
 

@@ -9,8 +9,12 @@ autoscaled deployments from polled replica metrics.
 
 Concurrency: the controller actor runs with max_concurrency > 1 (the
 control loop occupies one slot forever), so all state mutation happens
-under one lock. Replica polls (one combined metrics/health RPC per
-replica per tick) are fired concurrently and gathered once.
+under one lock — but the lock only ever guards *state*, never I/O. Every
+blocking operation (replica spawn, get_metrics polls, kills) runs outside
+the critical section on a snapshot, and the mutation is committed
+afterwards under the lock with a staleness check (the deployment may have
+been deleted or replaced while the RPCs were in flight). raylint's
+blocking-under-lock checker gates this property.
 """
 
 from __future__ import annotations
@@ -41,6 +45,9 @@ class _DeploymentState:
             if config.autoscaling_config else config.num_replicas)
         self.replicas: List[Any] = []
         self.version = 0
+        # True while one caller is spawning replicas outside the lock —
+        # keeps a concurrent reconcile tick from double-provisioning
+        self.scaling = False
         # autoscaling: scale only after the condition holds continuously
         # for the configured delay (reference autoscaling semantics)
         self.upscale_pending_since: Optional[float] = None
@@ -82,17 +89,19 @@ class ServeController:
                 # Old replicas leave routing now (the bumped version makes
                 # routers drop them) but keep serving in-flight requests
                 # until drained — no hard cutover failures.
-                self._start_drain(existing.replicas,
-                                  existing.config.graceful_shutdown_timeout_s)
+                self._start_drain_locked(
+                    existing.replicas,
+                    existing.config.graceful_shutdown_timeout_s)
             self._deployments[name] = st
-            self._reconcile_one(st)
+        # replica spawn is RPC — always outside the lock
+        self._scale_to_target(name, st)
 
     def delete_deployment(self, name: str) -> None:
         with self._lock:
             st = self._deployments.pop(name, None)
-            if st:
-                for r in st.replicas:
-                    self._kill(r)
+            victims = list(st.replicas) if st else []
+        for r in victims:
+            self._kill(r)
 
     def get_replicas(self, name: str) -> Dict[str, Any]:
         with self._lock:
@@ -129,14 +138,16 @@ class ServeController:
     def shutdown(self) -> None:
         self._running = False
         with self._lock:
-            for name in list(self._deployments):
-                self.delete_deployment(name)
-            for r, _ in self._draining:
-                self._kill(r)
+            victims: List[Any] = []
+            for st in self._deployments.values():
+                victims.extend(st.replicas)
+            self._deployments.clear()
+            victims.extend(r for r, _ in self._draining)
             self._draining = []
-            for p in self._proxies:
-                self._kill(p)
+            victims.extend(self._proxies)
             self._proxies = []
+        for v in victims:
+            self._kill(v)
 
     # -- reconciliation ----------------------------------------------------
 
@@ -152,7 +163,9 @@ class ServeController:
                 return
             time.sleep(period_s)
 
-    def _start_drain(self, replicas: List[Any], timeout_s: float) -> None:
+    def _start_drain_locked(self, replicas: List[Any],
+                            timeout_s: float) -> None:
+        """Move replicas into the draining set. Caller holds self._lock."""
         deadline = time.monotonic() + max(timeout_s, 0.0)
         self._draining.extend((r, deadline) for r in replicas)
 
@@ -160,30 +173,31 @@ class ServeController:
         with self._lock:
             entries, self._draining = self._draining, []
         keep: List[Tuple[Any, float]] = []
+        victims: List[Any] = []
         now = time.monotonic()
         # One concurrent poll round, same shape as _poll_replicas.
         polls = [(r, deadline, r.get_metrics.remote())
                  for r, deadline in entries if now < deadline]
-        for r, deadline in entries:
-            if now >= deadline:
-                self._kill(r)
+        victims.extend(r for r, deadline in entries if now >= deadline)
         for r, deadline, ref in polls:
             try:
                 m = ray_tpu.get(ref, timeout=10)
                 if m["ongoing"] <= 0:
-                    self._kill(r)
+                    victims.append(r)
                 else:
                     keep.append((r, deadline))
             except Exception:
-                self._kill(r)
+                victims.append(r)
+        stranded: List[Any] = []
         with self._lock:
             self._draining = keep + self._draining
             if not self._running:
                 # shutdown() ran while we were polling: nothing will call
                 # this again, so don't strand the survivors.
-                for r, _ in self._draining:
-                    self._kill(r)
+                stranded = [r for r, _ in self._draining]
                 self._draining = []
+        for r in victims + stranded:
+            self._kill(r)
 
     def reconcile_now(self) -> None:
         self._process_draining()
@@ -192,22 +206,35 @@ class ServeController:
         for name in names:
             with self._lock:
                 st = self._deployments.get(name)
-                if st is None:
-                    continue
-                try:
-                    alive, total_ongoing = self._poll_replicas(st)
-                    st.replicas = alive
+                replicas = list(st.replicas) if st is not None else []
+            if st is None:
+                continue
+            try:
+                # liveness + load polls on the snapshot, outside the lock
+                alive, dead, total_ongoing = self._poll_replicas(replicas)
+                for r in dead:
+                    self._kill(r)
+                with self._lock:
+                    if self._deployments.get(name) is not st:
+                        continue  # deleted/replaced while polling
+                    dead_ids = {id(r) for r in dead}
+                    st.replicas = [r for r in st.replicas
+                                   if id(r) not in dead_ids]
                     self._autoscale(st, total_ongoing)
-                    self._reconcile_one(st)
-                except Exception:
-                    pass
+                self._scale_to_target(name, st)
+            except Exception:
+                pass
 
-    def _poll_replicas(self, st: _DeploymentState
-                       ) -> Tuple[List[Any], float]:
-        """One concurrent get_metrics round: liveness + load in one RPC.
-        Dead (or unresponsive) replicas are killed so they can't leak."""
-        refs = [(r, r.get_metrics.remote()) for r in st.replicas]
+    @staticmethod
+    def _poll_replicas(replicas: List[Any]
+                       ) -> Tuple[List[Any], List[Any], float]:
+        """One concurrent get_metrics round over a snapshot: liveness +
+        load in one RPC. Returns (alive, dead, total_ongoing); dead (or
+        unresponsive) replicas are killed by the caller so they can't
+        leak. Never called with a lock held."""
+        refs = [(r, r.get_metrics.remote()) for r in replicas]
         alive: List[Any] = []
+        dead: List[Any] = []
         total_ongoing = 0.0
         for r, ref in refs:
             try:
@@ -215,28 +242,53 @@ class ServeController:
                 alive.append(r)
                 total_ongoing += m["ongoing"]
             except Exception:
-                self._kill(r)
-        return alive, total_ongoing
+                dead.append(r)
+        return alive, dead, total_ongoing
 
-    def _reconcile_one(self, st: _DeploymentState) -> None:
-        changed = False
-        while len(st.replicas) < st.target_replicas:
+    def _scale_to_target(self, name: str, st: _DeploymentState) -> None:
+        """Converge replica count to st.target_replicas. State deltas are
+        computed and committed under the lock; the spawns themselves (RPC)
+        happen outside it, guarded by st.scaling so concurrent callers
+        can't double-provision."""
+        with self._lock:
+            if self._deployments.get(name) is not st or st.scaling:
+                return
+            excess: List[Any] = []
+            while len(st.replicas) > st.target_replicas:
+                excess.append(st.replicas.pop())
+            if excess:
+                self._start_drain_locked(
+                    excess, st.config.graceful_shutdown_timeout_s)
+                st.version += 1
+            to_start = st.target_replicas - len(st.replicas)
+            if to_start <= 0:
+                return
+            st.scaling = True
             opts = dict(st.config.ray_actor_options or {})
             # reserve slots beyond user requests so control RPCs
             # (get_metrics) still answer when the replica is saturated
             opts.setdefault("max_concurrency",
                             st.config.max_ongoing_requests + 2)
-            r = self._replica_cls.options(**opts).remote(
-                st.func_or_class, st.init_args, st.init_kwargs,
-                st.config.user_config)
-            st.replicas.append(r)
-            changed = True
-        while len(st.replicas) > st.target_replicas:
-            self._start_drain([st.replicas.pop()],
-                              st.config.graceful_shutdown_timeout_s)
-            changed = True
-        if changed:
-            st.version += 1
+        started: List[Any] = []
+        try:
+            for _ in range(to_start):
+                started.append(self._replica_cls.options(**opts).remote(
+                    st.func_or_class, st.init_args, st.init_kwargs,
+                    st.config.user_config))
+        finally:
+            orphans: List[Any] = []
+            with self._lock:
+                st.scaling = False
+                if self._deployments.get(name) is st:
+                    if started:
+                        st.replicas.extend(started)
+                        st.version += 1
+                else:
+                    # deployment deleted/replaced mid-spawn: the new
+                    # replicas belong to nobody
+                    orphans = started
+            for r in orphans:
+                self._kill(r)
 
     def _autoscale(self, st: _DeploymentState,
                    total_ongoing: float) -> None:

@@ -102,11 +102,14 @@ class Histogram(Metric):
                  boundaries: Sequence[float] = (0.01, 0.1, 1, 10),
                  tag_keys: Sequence[str] = (),
                  registry: Optional[_Registry] = None):
-        super().__init__(name, description, tag_keys, registry)
+        # Bucket state must exist BEFORE super().__init__ registers this
+        # metric: registration publishes the object to the registry, and
+        # a concurrent /metrics scrape calls samples() on it immediately.
         self.boundaries = sorted(boundaries)
         self._buckets: Dict[Tuple, List[int]] = {}
         self._sums: Dict[Tuple, float] = {}
         self._counts: Dict[Tuple, int] = {}
+        super().__init__(name, description, tag_keys, registry)
 
     def observe(self, value: float,
                 tags: Optional[Dict[str, str]] = None):

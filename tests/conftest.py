@@ -24,6 +24,38 @@ jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
+# Suites exercising the lock-heavy planes run under the runtime lock-order
+# validator (ray_tpu/_private/lockdep.py): every Lock/RLock created during
+# the test joins the order graph, and any A→B / B→A inversion fails the
+# test with both witness stacks. Record-only in-process (raise_on_cycle
+# off) so the failure is attributed at teardown instead of perturbing
+# control flow mid-test; worker daemons self-install via RAY_TPU_LOCKDEP=1
+# in their inherited environment and raise in-daemon.
+_LOCKDEP_SUITES = ("test_chaos", "test_object_store")
+
+
+@pytest.fixture(autouse=True)
+def _lockdep_gate(request):
+    if request.module.__name__ not in _LOCKDEP_SUITES:
+        yield
+        return
+    from ray_tpu._private import lockdep
+
+    already = lockdep.enabled()
+    if not already:
+        lockdep.install(raise_on_cycle=False)
+    os.environ[lockdep.ENV_VAR] = "1"
+    try:
+        yield
+    finally:
+        reports = lockdep.cycle_reports()
+        os.environ.pop(lockdep.ENV_VAR, None)
+        if not already:
+            lockdep.uninstall()
+        assert not reports, (
+            "lockdep: lock-order cycle(s) detected:\n\n"
+            + "\n\n".join(reports))
+
 
 @pytest.fixture
 def shm_store():

@@ -1,0 +1,594 @@
+"""raylint checker fixtures + the tier-1 repo gate + runtime lockdep.
+
+Each checker gets a known-bad snippet (must be detected) and a known-good
+twin (must stay silent) so the analysis can't rot in either direction.
+The repo gate (marked `lint`) runs the real CLI over `ray_tpu/` against
+the committed baseline — any new violation fails tier-1.
+"""
+
+import os
+import textwrap
+import threading
+import time
+
+import pytest
+
+from tools.raylint import analyze_source
+from tools.raylint.__main__ import main as raylint_main
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run(src, relpath="ray_tpu/serve/fake.py", checks=None):
+    kwargs = {"checks": checks} if checks else {}
+    return analyze_source(textwrap.dedent(src), relpath, **kwargs)
+
+
+def checks_of(findings):
+    return {f.check for f in findings}
+
+
+# ---------------------------------------------------------------------------
+# checker 1: lock-discipline
+# ---------------------------------------------------------------------------
+
+class TestLockDiscipline:
+    BAD = """
+        import threading
+
+        class Router:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._replicas = []
+
+            def add(self, r):
+                with self._lock:
+                    self._replicas.append(r)
+
+            def reset(self):
+                self._replicas = []          # write outside the lock
+    """
+
+    def test_unguarded_write_detected(self):
+        findings = run(self.BAD)
+        assert any(f.check == "lock-discipline"
+                   and f.detail == "attr:_replicas"
+                   and f.scope == "Router.reset" for f in findings), findings
+
+    def test_guarded_write_ok(self):
+        findings = run("""
+            import threading
+
+            class Router:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._replicas = []
+
+                def add(self, r):
+                    with self._lock:
+                        self._replicas.append(r)
+
+                def reset(self):
+                    with self._lock:
+                        self._replicas = []
+        """)
+        assert "lock-discipline" not in checks_of(findings)
+
+    def test_mutator_call_is_a_write(self):
+        findings = run("""
+            import threading
+
+            class Q:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._q = []
+
+                def put(self, x):
+                    with self._lock:
+                        self._q.append(x)
+
+                def drop(self):
+                    self._q.clear()
+        """)
+        assert any(f.detail == "attr:_q" and f.scope == "Q.drop"
+                   for f in findings), findings
+
+    def test_init_exempt_until_self_escapes(self):
+        src = """
+            import threading
+
+            def register(obj):
+                pass
+
+            class M:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._state = {}         # fine: pre-publication
+                    register(self)           # self escapes here
+                    self._state = {"x": 1}   # visible to other threads
+                def touch(self):
+                    with self._lock:
+                        self._state = {}
+        """
+        findings = run(src)
+        bad = [f for f in findings if f.check == "lock-discipline"]
+        assert len(bad) == 1 and bad[0].scope == "M.__init__", findings
+
+    def test_locked_suffix_contract_exempt(self):
+        findings = run("""
+            import threading
+
+            class S:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._n = 0
+
+                def bump(self):
+                    with self._lock:
+                        self._n += 1
+
+                def _bump_locked(self):
+                    self._n += 1
+        """)
+        assert "lock-discipline" not in checks_of(findings)
+
+    def test_module_global_guarded(self):
+        findings = run("""
+            import threading
+
+            _LOCK = threading.Lock()
+            _CACHE = {}
+
+            def put(k, v):
+                with _LOCK:
+                    _CACHE[k] = v
+
+            def clear():
+                _CACHE = {}
+        """)
+        # clear() rebinds a local, not the global — but a global statement
+        # or subscript write outside the lock must flag
+        findings = run("""
+            import threading
+
+            _LOCK = threading.Lock()
+            _CACHE = {}
+
+            def put(k, v):
+                with _LOCK:
+                    _CACHE[k] = v
+
+            def poison(k):
+                _CACHE[k] = None
+        """)
+        assert any(f.detail == "global:_CACHE" and f.scope == "poison"
+                   for f in findings), findings
+
+
+# ---------------------------------------------------------------------------
+# checker 2: blocking-under-lock
+# ---------------------------------------------------------------------------
+
+class TestBlockingUnderLock:
+    def test_sleep_under_lock(self):
+        findings = run("""
+            import threading
+            import time
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def spin(self):
+                    with self._lock:
+                        time.sleep(1)
+        """)
+        assert any(f.check == "blocking-under-lock"
+                   and f.detail == "time.sleep" for f in findings), findings
+
+    def test_transitive_chain_reported(self):
+        findings = run("""
+            import threading
+            import subprocess
+
+            class B:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def _build(self):
+                    subprocess.run(["make"])
+
+                def ensure(self):
+                    with self._lock:
+                        self._build()
+        """)
+        hit = [f for f in findings if f.check == "blocking-under-lock"
+               and f.scope == "B.ensure"]
+        assert hit and "B._build" in hit[0].message, findings
+
+    def test_rpc_and_result_under_lock(self):
+        findings = run("""
+            import threading
+            import ray_tpu
+
+            class P:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def poll(self, actor, fut):
+                    with self._lock:
+                        ref = actor.get_metrics.remote()
+                        out = ray_tpu.get(ref)
+                        val = fut.result()
+        """)
+        details = {f.detail for f in findings
+                   if f.check == "blocking-under-lock"}
+        assert {".remote() [RPC send]", "ray_tpu.get",
+                ".result()"} <= details, findings
+
+    def test_condition_wait_on_held_lock_ok(self):
+        findings = run("""
+            import threading
+
+            class W:
+                def __init__(self):
+                    self._cond = threading.Condition()
+
+                def wait(self):
+                    with self._cond:
+                        self._cond.wait(1.0)
+        """)
+        assert "blocking-under-lock" not in checks_of(findings)
+
+    def test_nested_function_body_not_under_lock(self):
+        # a closure defined under a lock runs later (often another
+        # thread): its body is not a held-lock region
+        findings = run("""
+            import threading
+            import time
+
+            class D:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def arm(self):
+                    with self._lock:
+                        def later():
+                            time.sleep(5)
+                        return later
+        """)
+        assert "blocking-under-lock" not in checks_of(findings)
+
+
+# ---------------------------------------------------------------------------
+# checker 3: jit-purity
+# ---------------------------------------------------------------------------
+
+class TestJitPurity:
+    def test_print_in_decorated_jit(self):
+        findings = run("""
+            import jax
+
+            @jax.jit
+            def step(x):
+                print("tracing", x)
+                return x * 2
+        """)
+        assert any(f.check == "jit-purity" and f.detail == "print"
+                   for f in findings), findings
+
+    def test_time_and_rng_in_scan_body(self):
+        findings = run("""
+            import time
+            import numpy as np
+            from jax import lax
+
+            def roll(carry, x):
+                t = time.time()
+                noise = np.random.normal()
+                return carry, x
+
+            def run(xs):
+                return lax.scan(roll, 0.0, xs)
+        """)
+        details = {f.detail for f in findings if f.check == "jit-purity"}
+        assert "time.time" in details and "np.random.normal" in details, \
+            findings
+
+    def test_tracer_escape_via_self_store(self):
+        findings = run("""
+            import jax
+
+            class Model:
+                def update(self, x):
+                    self.last = x        # leaks a tracer
+                    return x + 1
+
+                def jitted(self):
+                    return jax.jit(self.update)
+        """)
+        assert any(f.detail == "self-store:last" for f in findings), findings
+
+    def test_logging_in_partial_jit(self):
+        findings = run("""
+            import functools
+            import jax
+            import logging
+
+            logger = logging.getLogger(__name__)
+
+            @functools.partial(jax.jit, static_argnums=0)
+            def fwd(n, x):
+                logger.info("fwd %s", n)
+                return x
+        """)
+        assert any(f.detail == "logging" for f in findings), findings
+
+    def test_jax_debug_print_sanctioned(self):
+        findings = run("""
+            import jax
+
+            @jax.jit
+            def step(x):
+                jax.debug.print("x={x}", x=x)
+                return x * 2
+        """)
+        assert "jit-purity" not in checks_of(findings)
+
+    def test_unstaged_function_untouched(self):
+        findings = run("""
+            def helper(x):
+                print(x)
+                return x
+        """)
+        assert "jit-purity" not in checks_of(findings)
+
+
+# ---------------------------------------------------------------------------
+# checker 4: seeded-rng
+# ---------------------------------------------------------------------------
+
+class TestSeededRng:
+    BAD = """
+        import random
+
+        def jitter():
+            return random.random() * 0.1
+    """
+
+    def test_bare_random_in_private_flagged(self):
+        findings = run(self.BAD, relpath="ray_tpu/_private/fake.py")
+        assert any(f.check == "seeded-rng" and f.detail == "random.random"
+                   for f in findings), findings
+
+    def test_outside_private_not_flagged(self):
+        findings = run(self.BAD, relpath="ray_tpu/serve/fake.py")
+        assert "seeded-rng" not in checks_of(findings)
+
+    def test_np_random_flagged(self):
+        findings = run("""
+            import numpy as np
+
+            def pick(n):
+                return np.random.randint(n)
+        """, relpath="ray_tpu/_private/fake.py")
+        assert any(f.check == "seeded-rng" for f in findings), findings
+
+    def test_seeded_stream_construction_ok(self):
+        findings = run("""
+            import random
+
+            def stream(seed):
+                rng = random.Random(seed)
+                return rng.random()
+        """, relpath="ray_tpu/_private/fake.py")
+        assert "seeded-rng" not in checks_of(findings)
+
+
+# ---------------------------------------------------------------------------
+# suppression + baseline
+# ---------------------------------------------------------------------------
+
+class TestSuppressionAndBaseline:
+    BAD = """
+        import threading
+        import time
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def spin(self):
+                with self._lock:
+                    time.sleep(1)
+    """
+
+    def test_inline_suppression(self):
+        src = self.BAD.replace(
+            "time.sleep(1)",
+            "time.sleep(1)  # raylint: disable=blocking-under-lock")
+        assert run(src) == []
+
+    def test_suppression_line_above(self):
+        src = self.BAD.replace(
+            "time.sleep(1)",
+            "# raylint: disable=all\n                    time.sleep(1)")
+        assert run(src) == []
+
+    def test_wrong_check_does_not_suppress(self):
+        src = self.BAD.replace(
+            "time.sleep(1)",
+            "time.sleep(1)  # raylint: disable=jit-purity")
+        assert run(src) != []
+
+    def test_baseline_freezes_then_gates(self, tmp_path, capsys):
+        mod = tmp_path / "mod.py"
+        base = tmp_path / "baseline.txt"
+        mod.write_text(textwrap.dedent(self.BAD))
+        args = [str(mod), "--root", str(tmp_path),
+                "--baseline", str(base)]
+        # new finding, no baseline: gate fails
+        assert raylint_main(args) == 1
+        # freeze, then the same finding passes
+        assert raylint_main(args + ["--write-baseline"]) == 0
+        assert raylint_main(args) == 0
+        # a NEW violation on top of the frozen one fails again
+        mod.write_text(mod.read_text().replace(
+            "time.sleep(1)", "time.sleep(1)\n                fut.result()"))
+        assert raylint_main(args) == 1
+        # fixing everything reports the stale entries but stays green
+        mod.write_text("x = 1\n")
+        capsys.readouterr()
+        assert raylint_main(args) == 0
+        assert "stale" in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 repo gate
+# ---------------------------------------------------------------------------
+
+@pytest.mark.lint
+def test_ray_tpu_clean_against_baseline():
+    """`python -m tools.raylint ray_tpu/` must exit 0: every finding is
+    either fixed, inline-suppressed with a justification, or frozen in
+    tools/raylint/baseline.txt. New violations fail tier-1 here."""
+    rc = raylint_main([os.path.join(ROOT, "ray_tpu"), "--root", ROOT])
+    assert rc == 0, "raylint found new violations (see captured output)"
+
+
+@pytest.mark.lint
+def test_burned_down_files_stay_clean():
+    """The burn-down targets must never re-enter the baseline."""
+    with open(os.path.join(ROOT, "tools", "raylint", "baseline.txt")) as fh:
+        entries = [ln for ln in fh
+                   if ln.strip() and not ln.startswith("#")]
+    for banned in ("serve/batching.py", "serve/controller.py",
+                   "util/metrics.py"):
+        assert not any(banned in e for e in entries), entries
+
+
+# ---------------------------------------------------------------------------
+# runtime lockdep
+# ---------------------------------------------------------------------------
+
+class TestLockdep:
+    @pytest.fixture(autouse=True)
+    def _installed(self):
+        from ray_tpu._private import lockdep
+        was = lockdep.enabled()
+        if not was:
+            lockdep.install()
+        yield lockdep
+        if not was:
+            lockdep.uninstall()
+
+    def test_abba_cycle_reported_with_both_stacks(self, _installed):
+        lockdep = _installed
+        a = threading.Lock()
+        b = threading.Lock()
+
+        def ab():
+            with a:
+                with b:
+                    pass
+
+        t = threading.Thread(target=ab)
+        t.start()
+        t.join()
+
+        caught = []
+
+        def ba():
+            try:
+                with b:
+                    with a:
+                        pass
+            except lockdep.LockOrderError as e:
+                caught.append(str(e))
+
+        t = threading.Thread(target=ba)
+        t.start()
+        t.join()
+        assert caught, "B->A after A->B must raise LockOrderError"
+        report = caught[0]
+        assert "cycle" in report
+        # both witness stacks: the new B->A acquisition and the prior A->B
+        assert report.count("acquired here") >= 2, report
+        assert "in ab" in report and "in ba" in report, report
+        assert lockdep.cycle_reports(), "report must also be recorded"
+
+    def test_consistent_order_is_clean(self, _installed):
+        lockdep = _installed
+        before = len(lockdep.cycle_reports())
+        a = threading.Lock()
+        b = threading.Lock()
+        for _ in range(3):
+            with a:
+                with b:
+                    pass
+        assert len(lockdep.cycle_reports()) == before
+
+    def test_rlock_reentrancy_is_not_an_edge(self, _installed):
+        lockdep = _installed
+        r = threading.RLock()
+        edges = lockdep.edge_count()
+        with r:
+            with r:      # re-entrant: no self edge, no crash
+                pass
+        assert lockdep.edge_count() == edges
+
+    def test_condition_wait_keeps_bookkeeping(self, _installed):
+        cond = threading.Condition()
+        done = []
+
+        def waiter():
+            with cond:
+                cond.wait(timeout=5)
+                done.append(True)
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        time.sleep(0.1)
+        with cond:
+            cond.notify()
+        t.join(timeout=5)
+        assert done == [True]
+
+    def test_env_install(self):
+        import subprocess
+        import sys
+        code = ("import ray_tpu; from ray_tpu._private import lockdep; "
+                "assert lockdep.enabled(); print('installed')")
+        out = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            env={**os.environ, "RAY_TPU_LOCKDEP": "1",
+                 "JAX_PLATFORMS": "cpu"}, cwd=ROOT, timeout=120)
+        assert out.returncode == 0, out.stderr[-2000:]
+        assert "installed" in out.stdout
+
+
+def test_record_only_mode():
+    """The conftest gate installs with raise_on_cycle=False: cycles are
+    recorded for the teardown assert instead of raised mid-test."""
+    from ray_tpu._private import lockdep
+    if lockdep.enabled():
+        pytest.skip("lockdep already active in raising mode")
+    lockdep.install(raise_on_cycle=False)
+    try:
+        a = threading.Lock()
+        b = threading.Lock()
+
+        def run_order(x, y):
+            def go():
+                with x:
+                    with y:
+                        pass
+            t = threading.Thread(target=go)
+            t.start()
+            t.join()
+
+        run_order(a, b)
+        run_order(b, a)   # must record, not raise
+        assert lockdep.cycle_reports()
+    finally:
+        lockdep.uninstall()

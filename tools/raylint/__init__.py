@@ -1,0 +1,15 @@
+"""raylint — concurrency + jit-boundary static analysis for ray_tpu.
+
+Usage: ``python -m tools.raylint ray_tpu/`` (see ``--help``). The four
+checkers, the baseline-burndown workflow, and inline suppression are
+documented in ``tools/raylint/core.py`` and README "Static analysis
+gates".
+"""
+
+from tools.raylint.core import (  # noqa: F401
+    CHECKS,
+    Finding,
+    analyze_file,
+    analyze_paths,
+    analyze_source,
+)

@@ -1,0 +1,71 @@
+"""CLI: ``python -m tools.raylint ray_tpu/``.
+
+Exit codes: 0 — clean against the baseline; 1 — new findings; 2 — usage
+error. ``--write-baseline`` refreshes the frozen set (burn-down commits
+run it after fixing violations).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from tools.raylint import baseline as baseline_mod
+from tools.raylint.core import CHECKS, analyze_paths
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.raylint",
+        description="concurrency + jit-boundary static analysis")
+    parser.add_argument("paths", nargs="+", help="files or directories")
+    parser.add_argument("--baseline", default=baseline_mod.DEFAULT_BASELINE,
+                        help="baseline file (default: committed baseline)")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="report every finding, ignore the baseline")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="freeze the current findings as the baseline")
+    parser.add_argument("--select", default=",".join(CHECKS),
+                        help="comma-separated checks to run "
+                             f"(default: all of {', '.join(CHECKS)})")
+    parser.add_argument("--root", default=os.getcwd(),
+                        help="path findings are reported relative to")
+    args = parser.parse_args(argv)
+
+    checks = tuple(c.strip() for c in args.select.split(",") if c.strip())
+    unknown = [c for c in checks if c not in CHECKS]
+    if unknown:
+        parser.error(f"unknown checks: {', '.join(unknown)}")
+
+    findings = analyze_paths(args.paths, root=args.root, checks=checks)
+
+    if args.write_baseline:
+        baseline_mod.save(findings, args.baseline)
+        print(f"wrote {len(findings)} finding(s) to {args.baseline}")
+        return 0
+
+    if args.no_baseline:
+        for f in findings:
+            print(f.render())
+        print(f"{len(findings)} finding(s)")
+        return 1 if findings else 0
+
+    base = baseline_mod.load(args.baseline)
+    new, stale = baseline_mod.compare(findings, base)
+    for f in new:
+        print(f.render())
+    for key in stale:
+        print(f"stale baseline entry (violation fixed — run "
+              f"--write-baseline to burn down): {key}")
+    if new:
+        print(f"{len(new)} new finding(s) "
+              f"({len(findings)} total, {sum(base.values())} baselined)")
+        return 1
+    print(f"clean: {len(findings)} finding(s), all baselined "
+          f"({len(stale)} stale)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,63 @@
+"""Baseline (burn-down) file handling.
+
+The baseline freezes pre-existing findings so only *new* violations fail
+the gate. Keys are line-number-free (`path::check::scope::detail`) so
+unrelated edits don't churn the file; identical findings in one scope are
+compared as a multiset (a second `ray_tpu.get` under the same lock in the
+same method is a new finding). Fixing a violation leaves a stale entry —
+the CLI reports it and `--write-baseline` burns it down.
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+from typing import Counter, Dict, List, Sequence, Tuple
+
+from tools.raylint.core import Finding
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.txt")
+
+_HEADER = """\
+# raylint baseline — frozen pre-existing findings (one key per line).
+# A finding listed here is tolerated; anything new fails the gate.
+# Burn entries down by fixing the violation and running:
+#   python -m tools.raylint ray_tpu/ --write-baseline
+"""
+
+
+def load(path: str = DEFAULT_BASELINE) -> Counter[str]:
+    counts: Counter[str] = collections.Counter()
+    if not os.path.exists(path):
+        return counts
+    with open(path, encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if line and not line.startswith("#"):
+                counts[line] += 1
+    return counts
+
+
+def save(findings: Sequence[Finding], path: str = DEFAULT_BASELINE) -> None:
+    keys = sorted(f.key() for f in findings)
+    with open(path, "w", encoding="utf-8") as fh:
+        fh.write(_HEADER)
+        for key in keys:
+            fh.write(key + "\n")
+
+
+def compare(findings: Sequence[Finding], baseline: Counter[str]
+            ) -> Tuple[List[Finding], List[str]]:
+    """(new_findings, stale_keys): findings beyond the baselined count
+    for their key, and baseline keys with no live finding left."""
+    live: Counter[str] = collections.Counter(f.key() for f in findings)
+    budget: Dict[str, int] = dict(baseline)
+    new: List[Finding] = []
+    for f in findings:
+        k = f.key()
+        if budget.get(k, 0) > 0:
+            budget[k] -= 1
+        else:
+            new.append(f)
+    stale = sorted(k for k, n in baseline.items() if live.get(k, 0) < n)
+    return new, stale

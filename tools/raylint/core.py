@@ -1,0 +1,960 @@
+"""raylint core — AST-based concurrency + jit-boundary analysis.
+
+Four checkers over ``ray_tpu/`` source (see ISSUE/COVERAGE "Static
+analysis gates"):
+
+``lock-discipline``
+    Compositional guard inference in the spirit of RacerD: per class,
+    an instance attribute is *guarded* when some method writes it while
+    holding a ``with self.<lock>:`` region. Any write to a guarded
+    attribute outside a held-lock region is flagged. ``__init__`` writes
+    are exempt up to the point where ``self`` escapes (is passed to a
+    call — e.g. a registry publishing the half-built object to other
+    threads); escape through ``super().__init__`` is resolved one level
+    within the module. Methods named ``*_locked`` assert
+    "caller holds the lock" and are exempt. The same inference runs at
+    module level for globals written under a module-level lock.
+
+``blocking-under-lock``
+    Flags blocking operations inside a held-lock region: ``time.sleep``,
+    ``subprocess.*``, ``.result()``, RPC sends (``.remote()``),
+    ``ray_tpu.get/wait/kill`` and bare ``.join()``. Summaries are
+    compositional: a call under a lock to a same-module function or
+    same-class method that (transitively) blocks is flagged with the
+    call chain. Calls on the held lock object itself (``cond.wait()``)
+    are the condition-variable pattern and exempt.
+
+``jit-purity``
+    Finds functions staged by ``jax.jit`` / ``pjit`` / ``shard_map`` /
+    ``lax.scan`` (decorator, ``functools.partial`` decorator, or direct
+    call on a module/local function, lambda, or ``self.<method>``) and
+    flags host side effects inside them: ``print``, ``logging``/logger
+    calls, wall-clock reads (``time.time`` etc.), host RNG
+    (``random.*``, ``np.random.*``), and tracer escape via ``self.<x> =``
+    stores. ``jax.debug.print``/``jax.debug.callback`` are the
+    sanctioned escape hatches and are not flagged.
+
+``seeded-rng``
+    In ``_private/`` runtime paths, bare ``random.*`` / ``np.random.*``
+    calls are flagged: chaos schedules (``RAY_TPU_CHAOS``) are replayable
+    only when every probabilistic decision routes through the FaultPlan's
+    per-site seeded streams (``FaultPlan.rng_for``). Constructing a
+    seeded ``random.Random(...)`` stream is the sanctioned form and is
+    not flagged.
+
+Suppression: append ``# raylint: disable=<check>`` (or ``disable=all``)
+to the flagged line, or put it on a comment line directly above.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+CHECKS = ("lock-discipline", "blocking-under-lock", "jit-purity",
+          "seeded-rng")
+
+_LOCKISH_NAME = re.compile(r"lock|mutex|cond", re.IGNORECASE)
+_LOCK_FACTORIES = {
+    "Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore",
+    "allocate_lock",
+}
+# container/ordered-dict mutators that count as writes to the container
+_MUTATORS = {
+    "append", "extend", "insert", "remove", "pop", "popitem", "clear",
+    "update", "setdefault", "add", "discard", "appendleft", "popleft",
+    "move_to_end", "sort", "reverse",
+}
+_SUPPRESS_RE = re.compile(r"#\s*raylint:\s*disable=([\w,\-]+)")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    path: str      # repo-relative posix path
+    check: str     # one of CHECKS
+    scope: str     # Class.method, function name, or <module>
+    detail: str    # stable detail, e.g. "attr:_queue" or "ray_tpu.get"
+    line: int      # 1-based line (display only — not part of the key)
+    message: str
+
+    def key(self) -> str:
+        """Line-number-free identity used for the baseline (stable
+        across unrelated edits)."""
+        return f"{self.path}::{self.check}::{self.scope}::{self.detail}"
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}: [{self.check}] {self.scope}: "
+                f"{self.message}")
+
+
+# ---------------------------------------------------------------------------
+# small AST helpers
+# ---------------------------------------------------------------------------
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """Best-effort dotted name of an expression ('self._lock',
+    'ray_tpu.get'). None for anything non-name-shaped."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = dotted(node.value)
+        return f"{base}.{node.attr}" if base else None
+    return None
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """Attr name if node is ``self.<attr>``."""
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _written_self_attrs(target: ast.AST) -> List[str]:
+    """Self attrs written by an assignment target (incl. subscript
+    stores — writing ``self.x[k]`` mutates the object behind ``x``)."""
+    out: List[str] = []
+    attr = _self_attr(target)
+    if attr is not None:
+        out.append(attr)
+    elif isinstance(target, ast.Subscript):
+        attr = _self_attr(target.value)
+        if attr is not None:
+            out.append(attr)
+    elif isinstance(target, (ast.Tuple, ast.List)):
+        for el in target.elts:
+            out.extend(_written_self_attrs(el))
+    elif isinstance(target, ast.Starred):
+        out.extend(_written_self_attrs(target.value))
+    return out
+
+
+def _written_globals(target: ast.AST, global_names: Set[str]) -> List[str]:
+    out: List[str] = []
+    if isinstance(target, ast.Name) and target.id in global_names:
+        out.append(target.id)
+    elif (isinstance(target, ast.Subscript)
+          and isinstance(target.value, ast.Name)
+          and target.value.id in global_names):
+        out.append(target.value.id)
+    elif isinstance(target, (ast.Tuple, ast.List)):
+        for el in target.elts:
+            out.extend(_written_globals(el, global_names))
+    return out
+
+
+def _iter_func_nodes(tree: ast.Module):
+    """Yield (classname_or_None, funcdef) for every module-level function
+    and every method of every class (nested classes included)."""
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield None, node
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            for item in node.body:
+                if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    yield node.name, item
+
+
+def _scan_held(nodes: Iterable[ast.stmt], held: Tuple[str, ...],
+               nested: bool, lock_test):
+    """Depth-first walk of statements yielding ``(node, held, nested)``
+    for every AST node, where ``held`` is the tuple of lock names whose
+    ``with`` region lexically encloses the node. Nested function/lambda
+    bodies run at another time (often another thread): they are walked
+    with an empty held set and ``nested=True``."""
+    for node in nodes:
+        yield from _scan_node(node, held, nested, lock_test)
+
+
+def _scan_node(node: ast.AST, held: Tuple[str, ...], nested: bool,
+               lock_test):
+    yield node, held, nested
+    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        for d in node.decorator_list:
+            yield from _scan_node(d, held, nested, lock_test)
+        yield from _scan_held(node.body, (), True, lock_test)
+        return
+    if isinstance(node, ast.Lambda):
+        yield from _scan_node(node.body, (), True, lock_test)
+        return
+    if isinstance(node, (ast.With, ast.AsyncWith)):
+        locks: List[str] = []
+        for item in node.items:
+            name = lock_test(item.context_expr)
+            if name:
+                locks.append(name)
+            yield from _scan_node(item.context_expr, held, nested,
+                                  lock_test)
+        yield from _scan_held(node.body, held + tuple(locks), nested,
+                              lock_test)
+        return
+    for child in ast.iter_child_nodes(node):
+        yield from _scan_node(child, held, nested, lock_test)
+
+
+# ---------------------------------------------------------------------------
+# per-module context
+# ---------------------------------------------------------------------------
+
+class ModuleContext:
+    """Parsed module plus the facts the checkers share: lock attrs per
+    class, module-level lock globals, class bases, import aliases."""
+
+    def __init__(self, path: str, relpath: str, source: str):
+        self.path = path
+        self.relpath = relpath
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        self.classes: Dict[str, ast.ClassDef] = {}
+        self.module_funcs: Dict[str, ast.AST] = {}
+        self.class_bases: Dict[str, List[str]] = {}
+        self.lock_attrs: Dict[str, Set[str]] = {}   # class -> lock attrs
+        self.module_lock_globals: Set[str] = set()
+        self.random_aliases: Set[str] = set()
+        self.numpy_aliases: Set[str] = set()
+        self._collect()
+
+    # -- fact collection -------------------------------------------------
+
+    def _collect(self) -> None:
+        for node in self.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.module_funcs[node.name] = node
+            elif isinstance(node, ast.Assign):
+                if self._is_lock_factory_call(node.value):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            self.module_lock_globals.add(t.id)
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.ClassDef):
+                self.classes[node.name] = node
+                self.class_bases[node.name] = [
+                    b for b in (dotted(base) for base in node.bases) if b]
+                attrs: Set[str] = set()
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Assign) and \
+                            self._is_lock_factory_call(sub.value):
+                        for t in sub.targets:
+                            a = _self_attr(t)
+                            if a:
+                                attrs.add(a)
+                self.lock_attrs[node.name] = attrs
+            elif isinstance(node, ast.Import):
+                for alias in node.names:
+                    bound = alias.asname or alias.name
+                    if alias.name == "random":
+                        self.random_aliases.add(bound)
+                    elif alias.name in ("numpy", "numpy.random"):
+                        self.numpy_aliases.add(bound.split(".")[0])
+            elif isinstance(node, ast.ImportFrom):
+                if node.module == "numpy":
+                    for alias in node.names:
+                        if alias.name == "random":
+                            # `from numpy import random as npr` — treat the
+                            # bound name as a numpy.random module ref
+                            self.random_aliases.discard(
+                                alias.asname or alias.name)
+                            self.numpy_aliases.add("__from_numpy__")
+
+    @staticmethod
+    def _is_lock_factory_call(value: ast.AST) -> bool:
+        if not isinstance(value, ast.Call):
+            return False
+        name = dotted(value.func)
+        if not name:
+            return False
+        return name.split(".")[-1] in _LOCK_FACTORIES
+
+    # -- lock expression tests -------------------------------------------
+
+    def lock_test_for_class(self, classname: Optional[str]):
+        """Return lock_test(expr) -> canonical-name-or-None for with
+        items, valid inside the given class (or module scope)."""
+        lock_attrs = self.lock_attrs.get(classname or "", set())
+
+        def test(expr: ast.AST) -> Optional[str]:
+            name = dotted(expr)
+            if not name:
+                return None
+            if name.startswith("self."):
+                attr = name[5:]
+                if attr in lock_attrs or _LOCKISH_NAME.search(attr):
+                    return name
+                return None
+            if name in self.module_lock_globals:
+                return name
+            if "." not in name and _LOCKISH_NAME.search(name):
+                # local variable holding a lock (e.g. key_lock)
+                return name
+            return None
+
+        return test
+
+    # -- misc -------------------------------------------------------------
+
+    def suppressed(self, check: str, line: int) -> bool:
+        """True when `# raylint: disable=<check>` is on the flagged line
+        or the line directly above it."""
+        for ln in (line, line - 1):
+            if 1 <= ln <= len(self.lines):
+                m = _SUPPRESS_RE.search(self.lines[ln - 1])
+                if m:
+                    what = {w.strip() for w in m.group(1).split(",")}
+                    if "all" in what or check in what:
+                        return True
+        return False
+
+    def base_chain(self, classname: str) -> List[str]:
+        """Same-module ancestor classes, nearest first (cycles cut)."""
+        out: List[str] = []
+        seen = {classname}
+        frontier = [classname]
+        while frontier:
+            cur = frontier.pop(0)
+            for base in self.class_bases.get(cur, []):
+                base = base.split(".")[-1]
+                if base in self.classes and base not in seen:
+                    seen.add(base)
+                    out.append(base)
+                    frontier.append(base)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# checker 1: lock-discipline
+# ---------------------------------------------------------------------------
+
+def _writes_in(node: ast.AST) -> List[Tuple[str, int]]:
+    """(attr, line) self-attr writes performed directly by `node`
+    (assignment targets, aug-assign, del, container mutator calls)."""
+    out: List[Tuple[str, int]] = []
+    if isinstance(node, ast.Assign):
+        for t in node.targets:
+            for a in _written_self_attrs(t):
+                out.append((a, node.lineno))
+    elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+        if getattr(node, "value", None) is not None or \
+                isinstance(node, ast.AugAssign):
+            for a in _written_self_attrs(node.target):
+                out.append((a, node.lineno))
+    elif isinstance(node, ast.Delete):
+        for t in node.targets:
+            for a in _written_self_attrs(t):
+                out.append((a, node.lineno))
+    elif isinstance(node, ast.Call):
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _MUTATORS:
+            a = _self_attr(node.func.value)
+            if a is not None:
+                out.append((a, node.lineno))
+    return out
+
+
+def _escapes_self(call: ast.Call) -> bool:
+    """Does this call receive `self` as an explicit argument?"""
+    for arg in list(call.args) + [kw.value for kw in call.keywords]:
+        if isinstance(arg, ast.Name) and arg.id == "self":
+            return True
+        if isinstance(arg, ast.Starred) and \
+                isinstance(arg.value, ast.Name) and arg.value.id == "self":
+            return True
+    return False
+
+
+def _is_super_init(call: ast.Call) -> bool:
+    return (isinstance(call.func, ast.Attribute)
+            and call.func.attr == "__init__"
+            and isinstance(call.func.value, ast.Call)
+            and isinstance(call.func.value.func, ast.Name)
+            and call.func.value.func.id == "super")
+
+
+def _init_escape_fact(ctx: ModuleContext, classname: str,
+                      memo: Dict[str, bool]) -> bool:
+    """Does `classname.__init__` leak self (directly or via a same-module
+    base __init__)?"""
+    if classname in memo:
+        return memo[classname]
+    memo[classname] = False  # cycle guard
+    cls = ctx.classes.get(classname)
+    if cls is None:
+        return False
+    init = next((n for n in cls.body
+                 if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                 and n.name == "__init__"), None)
+    escaped = False
+    if init is not None:
+        for node in ast.walk(init):
+            if isinstance(node, ast.Call):
+                if _escapes_self(node):
+                    escaped = True
+                    break
+                if _is_super_init(node):
+                    for base in ctx.base_chain(classname):
+                        if _init_escape_fact(ctx, base, memo):
+                            escaped = True
+                            break
+                    if escaped:
+                        break
+    else:
+        # no own __init__: inherits the base's behavior
+        for base in ctx.base_chain(classname):
+            if _init_escape_fact(ctx, base, memo):
+                escaped = True
+                break
+    memo[classname] = escaped
+    return escaped
+
+
+def check_lock_discipline(ctx: ModuleContext) -> List[Finding]:
+    findings: List[Finding] = []
+    escape_memo: Dict[str, bool] = {}
+
+    # ---- class-level inference ----
+    # pass 1: guarded attrs per class (merged along same-module bases)
+    own_guarded: Dict[str, Set[str]] = {}
+    for classname, cls in ctx.classes.items():
+        lock_test = ctx.lock_test_for_class(classname)
+        guarded: Set[str] = set()
+        for item in cls.body:
+            if not isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if item.name == "__init__":
+                continue
+            for node, held, _nested in _scan_held(item.body, (), False,
+                                                  lock_test):
+                if held and any(h.startswith("self.") for h in held):
+                    for attr, _line in _writes_in(node):
+                        guarded.add(attr)
+        own_guarded[classname] = guarded
+
+    for classname, cls in ctx.classes.items():
+        guarded = set(own_guarded.get(classname, ()))
+        for base in ctx.base_chain(classname):
+            guarded |= own_guarded.get(base, set())
+        if not guarded:
+            continue
+        lock_test = ctx.lock_test_for_class(classname)
+        for item in cls.body:
+            if not isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if item.name.endswith("_locked"):
+                continue  # contract: caller holds the lock
+            scope = f"{classname}.{item.name}"
+            if item.name == "__init__":
+                # exempt until self escapes (publication point)
+                escaped = False
+                for stmt in item.body:
+                    if escaped:
+                        for node in ast.walk(stmt):
+                            for attr, line in _writes_in(node):
+                                if attr in guarded:
+                                    findings.append(Finding(
+                                        ctx.relpath, "lock-discipline",
+                                        scope, f"attr:{attr}", line,
+                                        f"write to lock-guarded `self."
+                                        f"{attr}` after `self` escaped in "
+                                        f"__init__ (object is visible to "
+                                        f"other threads before its state "
+                                        f"is complete)"))
+                    else:
+                        for node in ast.walk(stmt):
+                            if isinstance(node, ast.Call) and (
+                                    _escapes_self(node)
+                                    or (_is_super_init(node) and any(
+                                        _init_escape_fact(ctx, b,
+                                                          escape_memo)
+                                        for b in ctx.base_chain(
+                                            classname)))):
+                                escaped = True
+                                break
+                continue
+            for node, held, nested in _scan_held(item.body, (), False,
+                                                 lock_test):
+                if held and any(h.startswith("self.") for h in held):
+                    continue
+                for attr, line in _writes_in(node):
+                    if attr in guarded:
+                        where = ("nested function in " if nested else "")
+                        findings.append(Finding(
+                            ctx.relpath, "lock-discipline", scope,
+                            f"attr:{attr}", line,
+                            f"write to `self.{attr}` outside the lock "
+                            f"that guards it elsewhere ({where}{scope})"))
+
+    # ---- module-level inference (globals under module locks) ----
+    if ctx.module_lock_globals:
+        lock_test = ctx.lock_test_for_class(None)
+        global_names = _module_global_names(ctx)
+        guarded_globals: Set[str] = set()
+        fn_nodes = [(cname, fn) for cname, fn in _iter_func_nodes(ctx.tree)]
+        for _cname, fn in fn_nodes:
+            for node, held, _nested in _scan_held(fn.body, (), False,
+                                                  lock_test):
+                if not any(h in ctx.module_lock_globals for h in held):
+                    continue
+                for name, _line in _global_writes_in(node, global_names):
+                    guarded_globals.add(name)
+        if guarded_globals:
+            for cname, fn in fn_nodes:
+                if fn.name.endswith("_locked"):
+                    continue
+                scope = f"{cname}.{fn.name}" if cname else fn.name
+                for node, held, _n in _scan_held(fn.body, (), False,
+                                                 lock_test):
+                    if any(h in ctx.module_lock_globals for h in held):
+                        continue
+                    for name, line in _global_writes_in(node, global_names):
+                        if name in guarded_globals:
+                            findings.append(Finding(
+                                ctx.relpath, "lock-discipline", scope,
+                                f"global:{name}", line,
+                                f"write to module global `{name}` outside "
+                                f"the module lock that guards it "
+                                f"elsewhere"))
+    return findings
+
+
+def _module_global_names(ctx: ModuleContext) -> Set[str]:
+    names: Set[str] = set()
+    for node in ctx.tree.body:
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    names.add(t.id)
+        elif isinstance(node, ast.AnnAssign) and \
+                isinstance(node.target, ast.Name):
+            names.add(node.target.id)
+    return names
+
+
+def _global_writes_in(node: ast.AST,
+                      global_names: Set[str]) -> List[Tuple[str, int]]:
+    out: List[Tuple[str, int]] = []
+    if isinstance(node, ast.Assign):
+        for t in node.targets:
+            for n in _written_globals(t, global_names):
+                out.append((n, node.lineno))
+    elif isinstance(node, ast.AugAssign):
+        for n in _written_globals(node.target, global_names):
+            out.append((n, node.lineno))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# checker 2: blocking-under-lock
+# ---------------------------------------------------------------------------
+
+_SUBPROCESS_BLOCKING = {"run", "call", "check_call", "check_output",
+                        "Popen", "getoutput", "getstatusoutput"}
+_RAY_BLOCKING = {"get", "wait", "kill"}
+
+
+def _direct_block_reason(call: ast.Call) -> Optional[str]:
+    """Reason string when `call` is a known blocking primitive."""
+    name = dotted(call.func)
+    if name:
+        parts = name.split(".")
+        if name == "time.sleep":
+            return "time.sleep"
+        if parts[0] == "subprocess" and parts[-1] in _SUBPROCESS_BLOCKING:
+            return name
+        if name in ("os.system", "os.waitpid"):
+            return name
+        if parts[0] in ("ray_tpu", "ray") and len(parts) == 2 and \
+                parts[1] in _RAY_BLOCKING:
+            return name
+    if isinstance(call.func, ast.Attribute):
+        attr = call.func.attr
+        if attr == "result":
+            return ".result()"
+        if attr == "remote":
+            return ".remote() [RPC send]"
+        if attr == "join" and not call.args:
+            return ".join()"
+    return None
+
+
+def _build_block_summaries(ctx: ModuleContext):
+    """qual -> (direct_reasons, callees). qual is 'Class.meth' or
+    'func'. Callees resolved within the module (self.m → same class or
+    same-module base; bare f() → module function)."""
+    info: Dict[str, Tuple[List[str], Set[str]]] = {}
+    for classname, fn in _iter_func_nodes(ctx.tree):
+        qual = f"{classname}.{fn.name}" if classname else fn.name
+        direct: List[str] = []
+        callees: Set[str] = set()
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            reason = _direct_block_reason(node)
+            if reason:
+                direct.append(reason)
+                continue
+            name = dotted(node.func)
+            if not name:
+                continue
+            if name.startswith("self.") and classname:
+                meth = name[5:]
+                if "." not in meth:
+                    for owner in [classname] + ctx.base_chain(classname):
+                        if f"{owner}.{meth}" in info or _class_has_method(
+                                ctx, owner, meth):
+                            callees.add(f"{owner}.{meth}")
+                            break
+            elif "." not in name and name in ctx.module_funcs:
+                callees.add(name)
+        info[qual] = (direct, callees)
+    return info
+
+
+def _class_has_method(ctx: ModuleContext, classname: str,
+                      meth: str) -> bool:
+    cls = ctx.classes.get(classname)
+    if cls is None:
+        return False
+    return any(isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+               and n.name == meth for n in cls.body)
+
+
+def _block_chains(ctx: ModuleContext) -> Dict[str, str]:
+    """Fixpoint: qual -> human chain like '_poll → ray_tpu.get' for every
+    function that (transitively) blocks."""
+    info = _build_block_summaries(ctx)
+    chains: Dict[str, str] = {}
+    changed = True
+    while changed:
+        changed = False
+        for qual, (direct, callees) in info.items():
+            if qual in chains:
+                continue
+            if direct:
+                chains[qual] = direct[0]
+                changed = True
+                continue
+            for callee in callees:
+                if callee in chains:
+                    chains[qual] = f"{callee} → {chains[callee]}"
+                    changed = True
+                    break
+    return chains
+
+
+def check_blocking_under_lock(ctx: ModuleContext) -> List[Finding]:
+    findings: List[Finding] = []
+    chains = _block_chains(ctx)
+    for classname, fn in _iter_func_nodes(ctx.tree):
+        scope = f"{classname}.{fn.name}" if classname else fn.name
+        lock_test = ctx.lock_test_for_class(classname)
+        for node, held, _nested in _scan_held(fn.body, (), False,
+                                              lock_test):
+            if not held or not isinstance(node, ast.Call):
+                continue
+            name = dotted(node.func) or ""
+            # condition-variable pattern: calls on the held lock itself
+            if any(name == h or name.startswith(h + ".") for h in held):
+                continue
+            reason = _direct_block_reason(node)
+            if reason:
+                findings.append(Finding(
+                    ctx.relpath, "blocking-under-lock", scope, reason,
+                    node.lineno,
+                    f"blocking `{reason}` while holding "
+                    f"{', '.join(held)}"))
+                continue
+            target = None
+            if name.startswith("self.") and classname and \
+                    "." not in name[5:]:
+                meth = name[5:]
+                for owner in [classname] + ctx.base_chain(classname):
+                    if f"{owner}.{meth}" in chains:
+                        target = f"{owner}.{meth}"
+                        break
+            elif "." not in name and name in chains:
+                target = name
+            if target is not None:
+                findings.append(Finding(
+                    ctx.relpath, "blocking-under-lock", scope,
+                    f"call:{target}", node.lineno,
+                    f"`{name}()` blocks ({target} → {chains[target]}) "
+                    f"while holding {', '.join(held)}"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# checker 3: jit-purity
+# ---------------------------------------------------------------------------
+
+_JIT_ENTRY = {"jit", "pjit", "shard_map", "scan", "while_loop"}
+
+
+def _jit_entry_name(name: Optional[str]) -> Optional[str]:
+    """'jax.jit' / 'jit' / 'lax.scan' / 'shard_map' → canonical entry."""
+    if not name:
+        return None
+    last = name.split(".")[-1]
+    if last not in _JIT_ENTRY:
+        return None
+    # bare `scan`/`while_loop` could be anything; require a lax/jax
+    # qualifier for those
+    if last in ("scan", "while_loop") and "lax" not in name and \
+            "jax" not in name:
+        return None
+    return last
+
+
+def _collect_jit_targets(ctx: ModuleContext):
+    """Yield (funcdef_or_lambda, classname_or_None, via) for every
+    function staged by jit/pjit/shard_map/scan."""
+    # name -> (node, classname) for resolution
+    local_funcs: Dict[Tuple[Optional[str], str],
+                      ast.AST] = {}
+    for classname, fn in _iter_func_nodes(ctx.tree):
+        local_funcs[(classname, fn.name)] = fn
+        # nested defs too (scan bodies are usually local closures)
+        for sub in ast.walk(fn):
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and sub is not fn:
+                local_funcs[(classname, sub.name)] = sub
+
+    seen: Set[int] = set()
+
+    def _resolve(arg: ast.AST, classname: Optional[str]):
+        if isinstance(arg, ast.Lambda):
+            return arg
+        name = dotted(arg)
+        if not name:
+            return None
+        if name.startswith("self."):
+            return local_funcs.get((classname, name[5:]))
+        if "." not in name:
+            return (local_funcs.get((classname, name))
+                    or local_funcs.get((None, name)))
+        return None
+
+    # decorators
+    for classname, fn in _iter_func_nodes(ctx.tree):
+        for nested_cls, node in [(classname, fn)] + [
+                (classname, sub) for sub in ast.walk(fn)
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and sub is not fn]:
+            for dec in node.decorator_list:
+                entry = _jit_entry_name(dotted(dec))
+                if entry is None and isinstance(dec, ast.Call):
+                    dec_name = dotted(dec.func) or ""
+                    entry = _jit_entry_name(dec_name)
+                    if entry is None and \
+                            dec_name.split(".")[-1] == "partial" and \
+                            dec.args:
+                        entry = _jit_entry_name(dotted(dec.args[0]))
+                if entry and id(node) not in seen:
+                    seen.add(id(node))
+                    yield node, nested_cls, f"@{entry}"
+
+    # call sites: jit(f), shard_map(f, ...), lax.scan(f, ...)
+    for classname, fn in _iter_func_nodes(ctx.tree):
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            entry = _jit_entry_name(dotted(node.func))
+            if entry is None:
+                name = dotted(node.func) or ""
+                if name.split(".")[-1] == "partial" and node.args:
+                    entry = _jit_entry_name(dotted(node.args[0]))
+                    if entry and len(node.args) > 1:
+                        target = _resolve(node.args[1], classname)
+                        if target is not None and id(target) not in seen:
+                            seen.add(id(target))
+                            yield target, classname, entry
+                    continue
+                continue
+            target = _resolve(node.args[0], classname)
+            if target is not None and id(target) not in seen:
+                seen.add(id(target))
+                yield target, classname, entry
+
+
+_TIME_IMPURE = {"time.time", "time.monotonic", "time.perf_counter",
+                "time.sleep", "time.time_ns", "time.perf_counter_ns"}
+_LOGGERISH = re.compile(r"^(logging|logger|log|_logger)\.")
+_LOG_METHODS = {"debug", "info", "warning", "warn", "error", "exception",
+                "critical", "log"}
+
+
+def check_jit_purity(ctx: ModuleContext) -> List[Finding]:
+    findings: List[Finding] = []
+    for target, classname, via in _collect_jit_targets(ctx):
+        if isinstance(target, ast.Lambda):
+            scope = (f"{classname}.<lambda>" if classname else "<lambda>")
+            body_nodes: List[ast.AST] = [target.body]
+        else:
+            scope = (f"{classname}.{target.name}" if classname
+                     else target.name)
+            body_nodes = list(target.body)
+        for root in body_nodes:
+            for node in ast.walk(root):
+                if isinstance(node, ast.Call):
+                    name = dotted(node.func) or ""
+                    if name.startswith("jax.debug."):
+                        continue  # sanctioned host callback
+                    if name == "print":
+                        findings.append(Finding(
+                            ctx.relpath, "jit-purity", scope, "print",
+                            node.lineno,
+                            f"`print` inside a {via}-staged function "
+                            f"runs at trace time only (use "
+                            f"jax.debug.print)"))
+                    elif _LOGGERISH.match(name) and \
+                            name.split(".")[-1] in _LOG_METHODS:
+                        findings.append(Finding(
+                            ctx.relpath, "jit-purity", scope, "logging",
+                            node.lineno,
+                            f"logging inside a {via}-staged function "
+                            f"runs at trace time only"))
+                    elif name in _TIME_IMPURE:
+                        findings.append(Finding(
+                            ctx.relpath, "jit-purity", scope, name,
+                            node.lineno,
+                            f"`{name}` inside a {via}-staged function is "
+                            f"a host side effect (baked in at trace "
+                            f"time)"))
+                    elif _is_host_rng_call(ctx, node):
+                        findings.append(Finding(
+                            ctx.relpath, "jit-purity", scope,
+                            dotted(node.func) or "host-rng", node.lineno,
+                            f"host RNG inside a {via}-staged function "
+                            f"(use jax.random with a threaded key)"))
+                elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                    targets = (node.targets
+                               if isinstance(node, ast.Assign)
+                               else [node.target])
+                    for t in targets:
+                        for attr in _written_self_attrs(t):
+                            findings.append(Finding(
+                                ctx.relpath, "jit-purity", scope,
+                                f"self-store:{attr}", node.lineno,
+                                f"storing to `self.{attr}` inside a "
+                                f"{via}-staged function leaks tracers "
+                                f"into persistent state"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# checker 4: seeded-rng
+# ---------------------------------------------------------------------------
+
+def _expr_names(node: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _is_host_rng_call(ctx: ModuleContext, call: ast.Call) -> bool:
+    """`random.<fn>(...)` (module ref, not Random construction) or
+    `np.random.<fn>(...)`."""
+    func = call.func
+    if not isinstance(func, ast.Attribute):
+        return False
+    if func.attr in ("Random", "SystemRandom", "default_rng", "Generator"):
+        return False  # constructing a dedicated (seedable) stream
+    value = func.value
+    # np.random.<fn>
+    if isinstance(value, ast.Attribute) and value.attr == "random" and \
+            isinstance(value.value, ast.Name) and \
+            value.value.id in ctx.numpy_aliases:
+        return True
+    # random.<fn> — including `(rng or random).shuffle`
+    names = _expr_names(value)
+    if names & ctx.random_aliases:
+        # exclude attribute chains where `random` is an attr of numpy
+        # (already handled) or a local var named random-ish bound to a
+        # Random instance — a bare Name ref to the module is the signal
+        return True
+    return False
+
+
+def check_seeded_rng(ctx: ModuleContext) -> List[Finding]:
+    if f"{os.sep}_private{os.sep}" not in ctx.path and \
+            "/_private/" not in ctx.relpath:
+        return []
+    findings: List[Finding] = []
+    if not ctx.random_aliases and not ctx.numpy_aliases:
+        return findings
+    for classname, fn in _iter_func_nodes(ctx.tree):
+        scope = f"{classname}.{fn.name}" if classname else fn.name
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) and _is_host_rng_call(ctx, node):
+                name = dotted(node.func) or "random.*"
+                findings.append(Finding(
+                    ctx.relpath, "seeded-rng", scope, name, node.lineno,
+                    f"bare `{name}` in a _private/ runtime path breaks "
+                    f"RAY_TPU_CHAOS replay — draw from "
+                    f"FaultPlan.rng_for(site) (fault_injection) or a "
+                    f"seeded random.Random stream instead"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+_CHECKERS = {
+    "lock-discipline": check_lock_discipline,
+    "blocking-under-lock": check_blocking_under_lock,
+    "jit-purity": check_jit_purity,
+    "seeded-rng": check_seeded_rng,
+}
+
+
+def analyze_source(source: str, relpath: str = "<string>",
+                   path: Optional[str] = None,
+                   checks: Sequence[str] = CHECKS) -> List[Finding]:
+    ctx = ModuleContext(path or relpath, relpath, source)
+    findings: List[Finding] = []
+    for check in checks:
+        for f in _CHECKERS[check](ctx):
+            if not ctx.suppressed(f.check, f.line):
+                findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.check, f.detail))
+    return findings
+
+
+def analyze_file(path: str, root: str,
+                 checks: Sequence[str] = CHECKS) -> List[Finding]:
+    relpath = os.path.relpath(path, root).replace(os.sep, "/")
+    with open(path, encoding="utf-8") as fh:
+        source = fh.read()
+    try:
+        return analyze_source(source, relpath, path, checks)
+    except SyntaxError as e:
+        return [Finding(relpath, "parse-error", "<module>", "syntax",
+                        e.lineno or 0, f"syntax error: {e.msg}")]
+
+
+def iter_python_files(paths: Sequence[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isfile(p):
+            out.append(p)
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = [d for d in dirnames
+                           if d not in ("__pycache__", "build", ".git")]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    out.append(os.path.join(dirpath, fn))
+    return out
+
+
+def analyze_paths(paths: Sequence[str], root: Optional[str] = None,
+                  checks: Sequence[str] = CHECKS) -> List[Finding]:
+    root = root or os.getcwd()
+    findings: List[Finding] = []
+    for path in iter_python_files(paths):
+        findings.extend(analyze_file(path, root, checks))
+    findings.sort(key=lambda f: (f.path, f.line, f.check, f.detail))
+    return findings
