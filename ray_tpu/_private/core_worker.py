@@ -643,18 +643,19 @@ class CoreWorker:
 
     def put(self, value: Any) -> ObjectRef:
         oid = ObjectID.for_put(self.current_task_id, next(self._put_counter))
-        pickled, buffers = serialization.serialize(value)
-        size = serialization.serialized_size(pickled, buffers)
-        if size <= self.config.max_direct_call_object_size or self.store is None:
-            frame = serialization.pack(pickled, buffers)
-            self._run_sync(self._put_inband(oid.binary(), frame))
+        # one-copy put: the serialized value holds only VIEWS (pickle
+        # stream + out-of-band buffers); the payload is copied exactly
+        # once, directly into the shm frame, on the plasma path below
+        sv = serialization.serialize_value(value)
+        if sv.size <= self.config.max_direct_call_object_size \
+                or self.store is None:
+            self._run_sync(self._put_inband(oid.binary(), sv.to_bytes()))
         else:
             # construct the ref (registering the local refcount) BEFORE
             # the pin is recorded — _on_ref_released must find a count
             # to decrement when the user drops the ref
             ref = ObjectRef(oid, self.address)
-            self._plasma_put_pinned(oid, pickled, buffers, size,
-                                    wait_pin=False)
+            self._plasma_put_pinned(oid, sv, wait_pin=False)
             self._run_sync(self._put_plasma_meta(oid.binary()))
             return ref
         return ObjectRef(oid, self.address)
@@ -687,13 +688,17 @@ class CoreWorker:
                     raise
         return write_fn()
 
-    def _plasma_put_pinned(self, oid: ObjectID, pickled, buffers,
-                           size: int, wait_pin: bool = True):
+    def _plasma_put_pinned(self, oid: ObjectID, sv, wait_pin: bool = True):
         """Create+seal+pin without an unprotected window: the creator's
         store reference (held from create until after the raylet's pin
         lands) is what stops a concurrent writer's eviction from
         destroying the fresh refcount-0 object. Reference: the worker
         pins primary copies through its raylet before the task reply.
+
+        `sv` is a serialization.SerializedValue: the create→write-in-
+        place→seal sequence below is the one-copy put protocol — the
+        payload moves from the caller's arrays straight into the
+        writer-private shm buffer, with no intermediate frame bytes.
 
         ``wait_pin=False`` (the driver put() fast path) takes the pin
         RPC off the critical path: put returns after seal and the
@@ -705,11 +710,11 @@ class CoreWorker:
         pin, so replying before the pin lands would let the owner's
         unpin race ahead of it (pinning the object forever)."""
         def write():
-            buf = self.store.create_buffer(oid, size)
-            serialization.write_to(buf, pickled, buffers)
+            buf = self.store.create_buffer(oid, sv.size)
+            sv.write_into(buf)
             self.store.seal(oid)
             # NOT released yet — we still hold the create reference
-        self._plasma_write(write, size)
+        self._plasma_write(write, sv.size)
         fut = asyncio.run_coroutine_threadsafe(
             self._pin_then_release(oid), self._loop)
         if wait_pin:
@@ -2724,12 +2729,11 @@ class CoreWorker:
         """Package one yielded item exactly like a return value: small
         in-band, large into plasma."""
         oid = ObjectID.for_task_return(TaskID(spec.task_id), index)
-        pickled, buffers = serialization.serialize(value)
-        size = serialization.serialized_size(pickled, buffers)
-        if size <= self.config.max_direct_call_object_size or \
+        sv = serialization.serialize_value(value)
+        if sv.size <= self.config.max_direct_call_object_size or \
                 self.store is None:
-            return [oid.binary(), "v", serialization.pack(pickled, buffers)]
-        self._plasma_put_pinned(oid, pickled, buffers, size)
+            return [oid.binary(), "v", sv.to_bytes()]
+        self._plasma_put_pinned(oid, sv)
         return [oid.binary(), "plasma", self.raylet_addr]
 
     async def _report_item(self, spec: task_mod.TaskSpec, item: list) -> dict:
@@ -2810,14 +2814,12 @@ class CoreWorker:
         returns = []
         for i, value in enumerate(results):
             oid = ObjectID.for_task_return(TaskID(spec.task_id), i)
-            pickled, buffers = serialization.serialize(value)
-            size = serialization.serialized_size(pickled, buffers)
-            if size <= self.config.max_direct_call_object_size or \
+            sv = serialization.serialize_value(value)
+            if sv.size <= self.config.max_direct_call_object_size or \
                     self.store is None:
-                returns.append([oid.binary(), "v",
-                                serialization.pack(pickled, buffers)])
+                returns.append([oid.binary(), "v", sv.to_bytes()])
             else:
-                self._plasma_put_pinned(oid, pickled, buffers, size)
+                self._plasma_put_pinned(oid, sv)
                 returns.append([oid.binary(), "plasma", self.raylet_addr])
         return {"returns": returns}
 

@@ -82,8 +82,15 @@ class PlasmaBuffer:
 
     def __del__(self):
         store = self._store
-        if store is not None and store._h >= 0:
-            store._lib.ss_release(store._h, self._id_bytes)
+        if store is None:
+            return
+        # snapshot: close() nulls _lib/_h BEFORE detaching, so a __del__
+        # racing close()/destroy() either sees a live handle or a dead
+        # store — never a detached handle index another attach may have
+        # reused (which would corrupt the new store's refcounts)
+        lib, h = store._lib, store._h
+        if lib is not None and h >= 0:
+            lib.ss_release(h, self._id_bytes)
 
 
 class ObjectStore:
@@ -103,9 +110,16 @@ class ObjectStore:
     # -- lifecycle --------------------------------------------------------
 
     @classmethod
-    def create(cls, name: str, capacity: int, table_size: int = 65536):
+    def create(cls, name: str, capacity: int, table_size: int = 65536,
+               shards: int = 0):
+        """Create a store arena. `shards` picks the index/allocator
+        stripe count (0 = scale with capacity: one stripe per 128 MB,
+        capped at 16 — small test stores keep single-lock semantics).
+        `RAY_TPU_STORE_SHARDS` overrides the default."""
         lib = load_shm_store()
-        h = lib.ss_create_store(name.encode(), capacity, table_size)
+        if shards == 0:
+            shards = int(os.environ.get("RAY_TPU_STORE_SHARDS", "0"))
+        h = lib.ss_create_store(name.encode(), capacity, table_size, shards)
         if h < 0:
             raise ObjectStoreError(f"failed to create store {name}: {h}")
         return cls(name, h, lib)
@@ -119,21 +133,32 @@ class ObjectStore:
         return cls(name, h, lib)
 
     def close(self):
-        if self._h >= 0:
-            self._lib.ss_detach(self._h)
-            self._h = -1
-            self._view.release()
-            try:
-                self._mmap.close()
-            except BufferError:
-                # Zero-copy views handed to callers still reference the
-                # mapping; it is reclaimed when they are garbage-collected.
-                pass
+        if self._h < 0:
+            return
+        lib, h = self._lib, self._h
+        # Invalidate the handle BEFORE detaching: a late
+        # PlasmaBuffer.__del__ (GC on another thread) must observe a
+        # dead store rather than call ss_release on a handle index a
+        # subsequent attach may have reused.
+        self._h = -1
+        self._lib = None
+        lib.ss_detach(h)
+        self._view.release()
+        try:
+            self._mmap.close()
+        except BufferError:
+            # Zero-copy views handed to callers still reference the
+            # mapping; it is reclaimed when they are garbage-collected.
+            pass
 
     def destroy(self):
-        name = self._name
+        name, lib = self._name, self._lib
         self.close()
-        self._lib.ss_unlink_store(name.encode())
+        if lib is None:  # already closed earlier; unlink still applies
+            from ray_tpu.native import load_shm_store
+
+            lib = load_shm_store()
+        lib.ss_unlink_store(name.encode())
 
     # -- data plane -------------------------------------------------------
 
@@ -142,6 +167,8 @@ class ObjectStore:
         return self._view[start : start + size]
 
     def create_buffer(self, object_id: ObjectID, size: int) -> memoryview:
+        if self._lib is None or self._h < 0:
+            raise ObjectStoreError("store is closed")
         off = self._lib.ss_create(self._h, object_id.binary(), size)
         if off == SS_EXISTS:
             raise ObjectStoreError(f"object already exists: {object_id}")
@@ -154,9 +181,31 @@ class ObjectStore:
         return self._slice(off, size)
 
     def seal(self, object_id: ObjectID):
+        if self._lib is None or self._h < 0:
+            raise ObjectStoreError("store is closed")
         rc = self._lib.ss_seal(self._h, object_id.binary())
         if rc not in (SS_OK, SS_EXISTS):
             raise ObjectStoreError(f"seal failed: {rc}")
+
+    def put_value(self, object_id: ObjectID, value) -> int:
+        """One-copy put: create the writer-private shm buffer first, then
+        serialize the frame directly into it, then seal (reference:
+        plasma create→write→seal). The payload is copied exactly once —
+        from the caller's arrays into shared memory; the pickle stream
+        is written from a view of the pickler's buffer, never
+        materialized as intermediate bytes. Returns stored size; the
+        creator reference is dropped (the object is immediately
+        evictable once unreferenced)."""
+        sv = serialization.serialize_value(value)
+        buf = self.create_buffer(object_id, sv.size)
+        try:
+            sv.write_into(buf)
+        except BaseException:
+            self.delete(object_id)  # abort the unsealed create
+            raise
+        self.seal(object_id)
+        self.release(object_id)
+        return sv.size
 
     def put_serialized(self, object_id: ObjectID, pickled: bytes, buffers) -> int:
         """Write a framed serialized value; returns stored size."""
@@ -186,6 +235,8 @@ class ObjectStore:
 
         timeout: -1/None = non-blocking; 0 = wait forever; >0 = wait seconds.
         """
+        if self._lib is None or self._h < 0:
+            raise ObjectStoreError("store is closed")
         size = ctypes.c_uint64()
         t = -1.0 if timeout is None else float(timeout)
         off = self._lib.ss_get(self._h, object_id.binary(), ctypes.byref(size), t)
@@ -205,26 +256,48 @@ class ObjectStore:
         return serialization.deserialize(buf)
 
     def contains(self, object_id: ObjectID) -> bool:
+        if self._lib is None or self._h < 0:
+            return False
         return self._lib.ss_contains(self._h, object_id.binary()) == 2
 
     def release(self, object_id: ObjectID):
+        if self._lib is None or self._h < 0:
+            return  # closed: nothing to release (benign at shutdown)
         self._lib.ss_release(self._h, object_id.binary())
 
     def delete(self, object_id: ObjectID):
+        if self._lib is None or self._h < 0:
+            return
         self._lib.ss_delete(self._h, object_id.binary())
 
     def evict(self, nbytes: int) -> int:
+        if self._lib is None or self._h < 0:
+            return 0
         return self._lib.ss_evict(self._h, nbytes)
+
+    @property
+    def num_shards(self) -> int:
+        if self._lib is None or self._h < 0:
+            return 0
+        return self._lib.ss_num_shards(self._h)
 
     def stats(self) -> dict:
         cap = ctypes.c_uint64()
         alloc = ctypes.c_uint64()
         n = ctypes.c_uint32()
         ref = ctypes.c_uint64()
-        self._lib.ss_stats2(
-            self._h, ctypes.byref(cap), ctypes.byref(alloc),
-            ctypes.byref(n), ctypes.byref(ref)
-        )
+        wait = ctypes.c_uint64()
+        cont = ctypes.c_uint64()
+        evd = ctypes.c_uint64()
+        if self._lib is None or self._h < 0:
+            lib = None
+        else:
+            lib = self._lib
+            lib.ss_stats2(
+                self._h, ctypes.byref(cap), ctypes.byref(alloc),
+                ctypes.byref(n), ctypes.byref(ref), ctypes.byref(wait),
+                ctypes.byref(cont), ctypes.byref(evd)
+            )
         return {
             "capacity": cap.value,
             "allocated": alloc.value,
@@ -233,4 +306,31 @@ class ObjectStore:
             # referenced); `allocated` additionally counts evictable
             # garbage — use `referenced` for backpressure
             "referenced": ref.value,
+            # contention instrumentation, summed over index shards and
+            # allocator regions (per-shard breakdown: shard_stats())
+            "lock_wait_ns": wait.value,
+            "lock_contended": cont.value,
+            "evicted_objects": evd.value,
         }
+
+    def shard_stats(self) -> list:
+        """Per-shard contention/eviction rows (index stripe + its
+        allocator region), for bench auditing and hot-shard triage."""
+        out = []
+        if self._lib is None or self._h < 0:
+            return out
+        row = (ctypes.c_uint64 * 8)()
+        for shard in range(self._lib.ss_num_shards(self._h)):
+            if self._lib.ss_shard_stats(self._h, shard, row) != SS_OK:
+                break
+            out.append({
+                "lock_wait_ns": row[0],
+                "lock_contended": row[1],
+                "lock_acquisitions": row[2],
+                "evicted_objects": row[3],
+                "evicted_bytes": row[4],
+                "num_objects": row[5],
+                "region_allocated": row[6],
+                "region_lock_wait_ns": row[7],
+            })
+        return out

@@ -78,6 +78,68 @@ def serialize(value: Any) -> tuple[bytes, List[memoryview]]:
     return f.getvalue(), buffers
 
 
+class SerializedValue:
+    """A pickled value held without any copy of its payload.
+
+    `pickled` is a zero-copy view over the pickler's internal buffer
+    (io.BytesIO.getbuffer() — the view keeps the BytesIO alive) and
+    `buffers` are pickle-5 out-of-band views over the original arrays,
+    so after `serialize_value` NOTHING large has been copied yet. The
+    one-copy put protocol (reference: plasma client create→write→seal,
+    `src/ray/object_manager/plasma/client.cc`) is then:
+
+        sv = serialize_value(value)
+        buf = store.create_buffer(oid, sv.size)   # writer-private shm
+        sv.write_into(buf)                        # the ONE payload copy
+        store.seal(oid)
+
+    `to_bytes()` materializes the framed object for the in-band path.
+    """
+
+    __slots__ = ("pickled", "buffers", "size")
+
+    def __init__(self, pickled: memoryview, buffers: List[memoryview]):
+        self.pickled = pickled
+        # normalize to flat byte views once, so sizing and writing agree
+        self.buffers = [
+            b if b.ndim == 1 and b.format == "B" else b.cast("B")
+            for b in buffers
+        ]
+        self.size = serialized_size(pickled, self.buffers)
+
+    def write_into(self, dst: memoryview) -> int:
+        """Write the framed object in place; returns bytes written."""
+        return write_to(dst, self.pickled, self.buffers)
+
+    def to_bytes(self) -> bytes:
+        out = bytearray(self.size)
+        write_to(memoryview(out), self.pickled, self.buffers)
+        return bytes(out)
+
+
+def serialize_value(value: Any) -> SerializedValue:
+    """Pickle `value` capturing out-of-band buffers, copying nothing
+    large: the pickle stream stays a view of the pickler's buffer and
+    the oob buffers stay views of the caller's arrays."""
+    buffers: List[memoryview] = []
+    f = io.BytesIO()
+    _Pickler(f, buffers).dump(value)
+    return SerializedValue(f.getbuffer(), buffers)
+
+
+def serialize_into(dst: memoryview, value: Any) -> int:
+    """Serialize `value` writing the frame directly into `dst` (a
+    pre-created shm view). Returns bytes written; raises ValueError when
+    the frame does not fit. Callers that need exact sizing should use
+    `serialize_value` + `create_buffer(sv.size)` + `sv.write_into`."""
+    sv = serialize_value(value)
+    if sv.size > dst.nbytes:
+        raise ValueError(
+            f"serialized frame ({sv.size} B) exceeds destination "
+            f"({dst.nbytes} B)")
+    return sv.write_into(dst)
+
+
 def dumps_with_ref_flag(value: Any) -> tuple[bytes, bool]:
     """Like `dumps`, additionally reporting whether any ObjectRef was
     pickled anywhere inside `value` (nested in containers included)."""

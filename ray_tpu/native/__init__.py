@@ -31,7 +31,12 @@ def load_shm_store() -> ctypes.CDLL:
             # invocation # raylint: disable=blocking-under-lock
             _build()
     lib = ctypes.CDLL(_SO)
-    lib.ss_create_store.argtypes = [ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint32]
+    lib.ss_create_store.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_uint64,
+        ctypes.c_uint32,
+        ctypes.c_uint32,  # num_shards (0 = scale with capacity)
+    ]
     lib.ss_create_store.restype = ctypes.c_int
     lib.ss_attach.argtypes = [ctypes.c_char_p]
     lib.ss_attach.restype = ctypes.c_int
@@ -71,12 +76,23 @@ def load_shm_store() -> ctypes.CDLL:
     lib.ss_unlink_store.restype = ctypes.c_int
     lib.ss_stats2.argtypes = [
         ctypes.c_int,
-        ctypes.POINTER(ctypes.c_uint64),
-        ctypes.POINTER(ctypes.c_uint64),
-        ctypes.POINTER(ctypes.c_uint32),
-        ctypes.POINTER(ctypes.c_uint64),
+        ctypes.POINTER(ctypes.c_uint64),  # capacity
+        ctypes.POINTER(ctypes.c_uint64),  # allocated
+        ctypes.POINTER(ctypes.c_uint32),  # num_objects
+        ctypes.POINTER(ctypes.c_uint64),  # referenced
+        ctypes.POINTER(ctypes.c_uint64),  # lock_wait_ns
+        ctypes.POINTER(ctypes.c_uint64),  # lock_contended
+        ctypes.POINTER(ctypes.c_uint64),  # evicted_objects
     ]
     lib.ss_stats2.restype = None
+    lib.ss_num_shards.argtypes = [ctypes.c_int]
+    lib.ss_num_shards.restype = ctypes.c_uint32
+    lib.ss_shard_stats.argtypes = [
+        ctypes.c_int,
+        ctypes.c_uint32,
+        ctypes.POINTER(ctypes.c_uint64),  # 8-element row
+    ]
+    lib.ss_shard_stats.restype = ctypes.c_int
     lib.ss_memcpy_mt.argtypes = [
         ctypes.c_void_p,
         ctypes.c_void_p,

@@ -1,26 +1,55 @@
-// shm_store.cc — per-node shared-memory immutable object store.
+// shm_store.cc — per-node shared-memory immutable object store (v2: sharded).
 //
 // TPU-native equivalent of the reference's plasma store
 // (src/ray/object_manager/plasma/{store.h,object_lifecycle_manager.h,
 // plasma_allocator.h,eviction_policy.h}), redesigned for simplicity:
 // instead of a store *server* process speaking a unix-socket flatbuffer
 // protocol with fd passing, every process on the node maps one shared
-// memory arena and manipulates the object index directly under a
-// process-shared robust mutex. Object creation/sealing/getting are plain
-// in-memory operations — no RPC in the data path at all. The raylet owns
-// the arena lifecycle; workers attach.
+// memory arena and manipulates the object index directly. Object
+// creation/sealing/getting are plain in-memory operations — no RPC in
+// the data path at all. The raylet owns the arena lifecycle; workers
+// attach.
+//
+// v2 concurrency design (multi-client scaling): the v1 single
+// process-shared mutex serialized every create/seal/get/evict across
+// all writer processes. v2 splits it three ways, mirroring the
+// reference's tiny-index-critical-section plasma design (Moritz et al.,
+// OSDI '18) with per-shard heaps à la Hoard (Berger et al., ASPLOS '00):
+//
+//   - Index shards: the slot table is striped into `num_shards`
+//     independent sub-tables, each with its own robust pshared mutex,
+//     its own LRU list, and its own lock-wait/eviction counters. An
+//     object's id hash picks its shard; creates/seals/gets of objects
+//     in different shards never contend.
+//   - Per-shard free lists: the data region is partitioned into one
+//     region per shard, each with its own allocator mutex + address-
+//     ordered first-fit free list. A create allocates from its home
+//     region first, then steals from others one lock at a time. A
+//     request larger than any single region's free run takes ALL
+//     region locks in ascending order and allocates from a temporarily
+//     merged view (blocks are routed back by start address, so the
+//     merged remainder re-splits cleanly).
+//   - Lock-free reads: `ss_contains` probes with atomic slot-state
+//     loads and takes no lock at all; `ss_release` decrements the
+//     refcount with a generation-checked CAS (refcount and generation
+//     share one 64-bit word), so readers dropping references never
+//     touch a mutex.
+//
+// Lock hierarchy (strictly one-way, validated by the TSAN stress gate):
+//   cv_mutex -> index shard mutex -> region alloc mutex
+// The sealed-broadcast condvar stays global but is only hit by blocking
+// gets: sealers check an atomic waiter count (SC-fenced against the
+// waiter's count-then-probe) and skip the cv_mutex entirely when nobody
+// is parked.
 //
 // Layout of the arena:
-//   [ Header | Slot[table_cap] | data region ... ]
+//   [ Header(+shard/region state) | Slot[table_cap] | data region ... ]
 //
-// - Allocator: address-ordered first-fit free list with coalescing, 64-byte
-//   aligned blocks (plasma uses an embedded dlmalloc; a free list is enough
-//   here because objects are large and few).
-// - Object index: linear-probing open-addressed hash table of fixed slot
-//   count, keyed by 16-byte object ids.
-// - Eviction: LRU over sealed, refcount==0 objects (reference:
-//   eviction_policy.h), triggered automatically when a create fails.
-// - Blocking get: process-shared condvar broadcast on every seal.
+// - Eviction: LRU over sealed, refcount==0 objects per shard, triggered
+//   automatically when a create fails; a create's eviction sweep only
+//   locks the shards it actually touches.
+// - Blocking get: process-shared condvar broadcast on seal when waiters
+//   are parked.
 //
 // Build: g++ -O2 -shared -fPIC -o libshm_store.so shm_store.cc -lpthread -lrt
 
@@ -29,6 +58,7 @@
 #include <cstring>
 #include <ctime>
 #include <thread>
+#include <utility>
 #include <vector>
 
 #include <fcntl.h>
@@ -40,9 +70,15 @@
 namespace {
 
 constexpr uint64_t kMagic = 0x52415953544f5245ULL;  // "RAYSTORE"
-constexpr uint32_t kVersion = 1;
+constexpr uint32_t kVersion = 2;
 constexpr uint64_t kAlign = 64;
 constexpr uint32_t kIdSize = 16;
+constexpr uint32_t kMaxShards = 16;
+// A data region below this is not worth slicing further: objects are
+// large, and tiny regions would push every big create onto the
+// all-locks spanning path. Small (test) stores auto-degrade to one
+// shard — i.e. exactly the v1 behavior, including global LRU order.
+constexpr uint64_t kMinRegionBytes = 128ULL << 20;
 
 // Slot states.
 enum : uint32_t { EMPTY = 0, CREATED = 1, SEALED = 2, TOMB = 3 };
@@ -61,31 +97,69 @@ enum : int64_t {
 };
 
 struct Slot {
-  uint8_t id[kIdSize];
-  uint64_t offset;  // data offset relative to data region base
-  uint64_t size;       // user-visible data size
-  uint64_t alloc_size; // actual bytes taken from the allocator (>= size)
-  uint32_t state;
-  uint32_t refcount;
-  // LRU doubly-linked list, values are slot_index + 1 (0 = nil).
+  uint8_t id[kIdSize];  // 8-aligned; lock-free probes read it as two u64s
+  uint64_t offset;      // data offset relative to data region base
+  uint64_t size;        // user-visible data size
+  uint64_t alloc_size;  // actual bytes taken from the allocator (>= size)
+  uint32_t state;       // atomic: lock-free probes read it
+  // LRU doubly-linked list (per shard), values are slot_index + 1 (0 = nil).
   uint32_t lru_prev;
   uint32_t lru_next;
+  uint32_t _pad;
+  // hi 32 bits: generation, bumped on every tombstone/reuse; lo 32:
+  // refcount. One atomic word so the lock-free release can
+  // decrement-iff-same-incarnation with a single CAS.
+  uint64_t refgen;
 };
+static_assert(sizeof(Slot) == 64, "one cache line per slot");
+
+// One index stripe: a sub-range of the slot table plus its LRU list.
+struct ShardState {
+  pthread_mutex_t mutex;
+  uint32_t lru_head;  // most-recently-used, slot_index + 1 (global index)
+  uint32_t lru_tail;  // least-recently-used
+  uint32_t num_objects;
+  uint32_t _pad0;
+  // Contention instrumentation (read under the shard mutex).
+  uint64_t lock_wait_ns;
+  uint64_t lock_contended;
+  uint64_t lock_acquisitions;
+  uint64_t evicted_objects;
+  uint64_t evicted_bytes;
+  uint8_t _pad[128 - sizeof(pthread_mutex_t) - 16 - 40];
+};
+static_assert(sizeof(ShardState) == 128, "pad shards to two cache lines");
+
+// One allocator region: a sub-range of the data area with its own free
+// list. Free blocks are routed by START address, so the per-region
+// lists stay address-ordered and concatenate into one global order.
+struct RegionState {
+  pthread_mutex_t mutex;
+  uint64_t free_head;  // data-relative offset of first free block, kNil = nil
+  uint64_t allocated;  // bytes handed out charged to this region
+  uint64_t base;       // data-relative region start
+  uint64_t size;       // region bytes (last region absorbs the remainder)
+  uint64_t lock_wait_ns;
+  uint64_t lock_contended;
+  uint8_t _pad[128 - sizeof(pthread_mutex_t) - 48];
+};
+static_assert(sizeof(RegionState) == 128, "pad regions to two cache lines");
 
 struct Header {
   uint64_t magic;
   uint32_t version;
-  uint32_t table_cap;
+  uint32_t table_cap;  // slots in use (= shard_cap * num_shards)
   uint64_t capacity;   // data region bytes
-  uint64_t allocated;  // bytes currently allocated
   uint64_t data_off;   // offset of data region from arena base
-  uint32_t num_objects;
-  uint32_t _pad;
-  uint64_t free_head;  // offset (data-relative) of first free block, ~0 = nil
-  uint32_t lru_head;   // most-recently-used, slot_index + 1
-  uint32_t lru_tail;   // least-recently-used
-  pthread_mutex_t mutex;
+  uint32_t num_shards;
+  uint32_t shard_cap;      // slots per shard
+  uint64_t region_quant;   // nominal bytes per region
+  uint32_t cv_waiters;     // atomic: blocking gets currently parked
+  uint32_t _pad0;
+  pthread_mutex_t cv_mutex;
   pthread_cond_t sealed_cv;
+  ShardState shards[kMaxShards];
+  RegionState regions[kMaxShards];
 };
 
 struct FreeBlock {
@@ -113,21 +187,56 @@ inline FreeBlock* fb(Store* s, uint64_t off) {
   return reinterpret_cast<FreeBlock*>(s->data + off);
 }
 
-class Guard {
- public:
-  explicit Guard(Header* h) : h_(h) {
-    int rc = pthread_mutex_lock(&h_->mutex);
-    if (rc == EOWNERDEAD) {
-      // A process died holding the lock; the index may be mid-update but all
-      // mutations below are ordered so partially-applied states are benign
-      // (worst case: a leaked allocation, reclaimed by eviction).
-      pthread_mutex_consistent(&h_->mutex);
-    }
+inline uint64_t now_ns() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return static_cast<uint64_t>(ts.tv_sec) * 1000000000ULL +
+         static_cast<uint64_t>(ts.tv_nsec);
+}
+
+// Robust lock with contention accounting: the counters are only written
+// AFTER the lock is held (stats readers hold it too), so they need no
+// atomics of their own.
+void lock_timed(pthread_mutex_t* m, uint64_t* wait_ns, uint64_t* contended) {
+  int rc = pthread_mutex_trylock(m);
+  if (rc == 0) return;
+  if (rc == EOWNERDEAD) {
+    pthread_mutex_consistent(m);
+    return;
   }
-  ~Guard() { pthread_mutex_unlock(&h_->mutex); }
+  uint64_t t0 = now_ns();
+  rc = pthread_mutex_lock(m);
+  if (rc == EOWNERDEAD) {
+    // A process died holding the lock; mutations are ordered so
+    // partially-applied states are benign (worst case: a leaked
+    // allocation, reclaimed by eviction).
+    pthread_mutex_consistent(m);
+  }
+  *wait_ns += now_ns() - t0;
+  *contended += 1;
+}
+
+class ShardGuard {
+ public:
+  ShardGuard(Store* s, uint32_t shard) : sh_(&s->hdr->shards[shard]) {
+    lock_timed(&sh_->mutex, &sh_->lock_wait_ns, &sh_->lock_contended);
+    sh_->lock_acquisitions++;
+  }
+  ~ShardGuard() { pthread_mutex_unlock(&sh_->mutex); }
 
  private:
-  Header* h_;
+  ShardState* sh_;
+};
+
+class RegionGuard {
+ public:
+  RegionGuard(Store* s, uint32_t region) : rg_(&s->hdr->regions[region]) {
+    lock_timed(&rg_->mutex, &rg_->lock_wait_ns, &rg_->lock_contended);
+  }
+  ~RegionGuard() { pthread_mutex_unlock(&rg_->mutex); }
+
+ private:
+  RegionState* rg_;
 };
 
 uint64_t hash_id(const uint8_t* id) {
@@ -139,20 +248,66 @@ uint64_t hash_id(const uint8_t* id) {
   return h;
 }
 
-// Find slot holding `id`; returns nullptr if absent. If `insert_pos` is
-// non-null, sets it to the first usable (EMPTY/TOMB) slot on the probe path.
-Slot* find_slot(Store* s, const uint8_t* id, Slot** insert_pos = nullptr) {
-  Header* h = s->hdr;
-  uint32_t cap = h->table_cap;
+inline uint32_t shard_of(Store* s, const uint8_t* id) {
+  // high hash bits pick the shard, low bits the in-shard slot — the two
+  // must not be correlated or every shard collapses onto a few buckets
+  return static_cast<uint32_t>((hash_id(id) >> 32) % s->hdr->num_shards);
+}
+
+// --- atomic slot field access (lock-free probe side) ---
+
+inline uint32_t ld_state(const Slot* sl) {
+  return __atomic_load_n(&sl->state, __ATOMIC_ACQUIRE);
+}
+
+inline void st_state(Slot* sl, uint32_t v) {
+  __atomic_store_n(&sl->state, v, __ATOMIC_RELEASE);
+}
+
+inline bool id_eq(const Slot* sl, const uint8_t* id) {
+  // two aligned u64 atomic loads: a concurrent reuse can tear between
+  // the words, but the refgen generation check (release) / state
+  // re-read (contains) bounds the damage to an advisory stale answer
+  uint64_t a = __atomic_load_n(
+      reinterpret_cast<const uint64_t*>(sl->id), __ATOMIC_RELAXED);
+  uint64_t b = __atomic_load_n(
+      reinterpret_cast<const uint64_t*>(sl->id + 8), __ATOMIC_RELAXED);
+  uint64_t qa, qb;
+  memcpy(&qa, id, 8);
+  memcpy(&qb, id + 8, 8);
+  return a == qa && b == qb;
+}
+
+inline void id_store(Slot* sl, const uint8_t* id) {
+  uint64_t qa, qb;
+  memcpy(&qa, id, 8);
+  memcpy(&qb, id + 8, 8);
+  __atomic_store_n(reinterpret_cast<uint64_t*>(sl->id), qa, __ATOMIC_RELAXED);
+  __atomic_store_n(reinterpret_cast<uint64_t*>(sl->id + 8), qb,
+                   __ATOMIC_RELAXED);
+}
+
+inline Slot* shard_base(Store* s, uint32_t shard) {
+  return s->slots + static_cast<uint64_t>(shard) * s->hdr->shard_cap;
+}
+
+// Find slot holding `id` within its shard; caller holds the shard mutex.
+// If `insert_pos` is non-null, sets it to the first usable (EMPTY/TOMB)
+// slot on the probe path.
+Slot* find_slot(Store* s, uint32_t shard, const uint8_t* id,
+                Slot** insert_pos = nullptr) {
+  uint32_t cap = s->hdr->shard_cap;
+  Slot* base = shard_base(s, shard);
   uint64_t idx = hash_id(id) % cap;
   Slot* first_free = nullptr;
   for (uint32_t probe = 0; probe < cap; ++probe) {
-    Slot* sl = &s->slots[(idx + probe) % cap];
-    if (sl->state == EMPTY) {
+    Slot* sl = &base[(idx + probe) % cap];
+    uint32_t st = __atomic_load_n(&sl->state, __ATOMIC_RELAXED);
+    if (st == EMPTY) {
       if (insert_pos) *insert_pos = first_free ? first_free : sl;
       return nullptr;
     }
-    if (sl->state == TOMB) {
+    if (st == TOMB) {
       if (!first_free) first_free = sl;
       continue;
     }
@@ -162,42 +317,63 @@ Slot* find_slot(Store* s, const uint8_t* id, Slot** insert_pos = nullptr) {
   return nullptr;
 }
 
-// --- LRU list (only sealed objects participate) ---
+// Lock-free probe (contains/release): atomic state loads, advisory by
+// construction — any answer it returns was true at some instant.
+Slot* probe_lockfree(Store* s, uint32_t shard, const uint8_t* id) {
+  uint32_t cap = s->hdr->shard_cap;
+  Slot* base = shard_base(s, shard);
+  uint64_t idx = hash_id(id) % cap;
+  for (uint32_t probe = 0; probe < cap; ++probe) {
+    Slot* sl = &base[(idx + probe) % cap];
+    uint32_t st = ld_state(sl);
+    if (st == EMPTY) return nullptr;
+    if (st == TOMB) continue;
+    if (id_eq(sl, id)) return sl;
+  }
+  return nullptr;
+}
 
-void lru_unlink(Store* s, Slot* sl) {
-  Header* h = s->hdr;
+// --- LRU list (per shard; only sealed objects participate) ---
+
+void lru_unlink(Store* s, ShardState* sh, Slot* sl) {
   uint32_t self = static_cast<uint32_t>(sl - s->slots) + 1;
   if (sl->lru_prev)
     s->slots[sl->lru_prev - 1].lru_next = sl->lru_next;
-  else if (h->lru_head == self)
-    h->lru_head = sl->lru_next;
+  else if (sh->lru_head == self)
+    sh->lru_head = sl->lru_next;
   if (sl->lru_next)
     s->slots[sl->lru_next - 1].lru_prev = sl->lru_prev;
-  else if (h->lru_tail == self)
-    h->lru_tail = sl->lru_prev;
+  else if (sh->lru_tail == self)
+    sh->lru_tail = sl->lru_prev;
   sl->lru_prev = sl->lru_next = 0;
 }
 
-void lru_push_front(Store* s, Slot* sl) {
-  Header* h = s->hdr;
+void lru_push_front(Store* s, ShardState* sh, Slot* sl) {
   uint32_t self = static_cast<uint32_t>(sl - s->slots) + 1;
   sl->lru_prev = 0;
-  sl->lru_next = h->lru_head;
-  if (h->lru_head) s->slots[h->lru_head - 1].lru_prev = self;
-  h->lru_head = self;
-  if (!h->lru_tail) h->lru_tail = self;
+  sl->lru_next = sh->lru_head;
+  if (sh->lru_head) s->slots[sh->lru_head - 1].lru_prev = self;
+  sh->lru_head = self;
+  if (!sh->lru_tail) sh->lru_tail = self;
 }
 
-// --- allocator ---
+// --- allocator (per-region free lists) ---
 
-// On success returns the block offset and sets *granted to the actual bytes
-// consumed (the whole block when the remainder is too small to split — the
-// caller must record this so the full block is returned on free).
-int64_t alloc_block(Store* s, uint64_t want, uint64_t* granted) {
-  Header* h = s->hdr;
-  want = align_up(want);
+inline uint32_t region_of(Store* s, uint64_t off) {
+  uint64_t r = off / s->hdr->region_quant;
+  uint32_t n = s->hdr->num_shards;
+  return r >= n ? n - 1 : static_cast<uint32_t>(r);
+}
+
+// First-fit within one region; caller holds the region mutex. On
+// success returns the block offset and sets *granted to the actual
+// bytes consumed (the whole block when the remainder is too small to
+// split — the caller must record this so the full block is returned on
+// free).
+int64_t alloc_in_region(Store* s, RegionState* rg, uint64_t want,
+                        uint64_t* granted) {
   uint64_t prev = kNil;
-  uint64_t cur = h->free_head;
+  uint64_t cur = rg->free_head;
   while (cur != kNil) {
     FreeBlock* blk = fb(s, cur);
     if (blk->size >= want) {
@@ -207,12 +383,13 @@ int64_t alloc_block(Store* s, uint64_t want, uint64_t* granted) {
         FreeBlock* rb = fb(s, rest);
         rb->size = remain;
         rb->next = blk->next;
-        if (prev == kNil) h->free_head = rest; else fb(s, prev)->next = rest;
+        if (prev == kNil) rg->free_head = rest; else fb(s, prev)->next = rest;
       } else {
-        if (prev == kNil) h->free_head = blk->next; else fb(s, prev)->next = blk->next;
+        if (prev == kNil) rg->free_head = blk->next;
+        else fb(s, prev)->next = blk->next;
         want = blk->size;
       }
-      h->allocated += want;
+      rg->allocated += want;
       *granted = want;
       return static_cast<int64_t>(cur);
     }
@@ -222,23 +399,24 @@ int64_t alloc_block(Store* s, uint64_t want, uint64_t* granted) {
   return SS_NO_MEMORY;
 }
 
-void free_block(Store* s, uint64_t off, uint64_t size) {
-  Header* h = s->hdr;
-  h->allocated -= size;
-  // Address-ordered insert with neighbor coalescing.
-  uint64_t prev = kNil, cur = h->free_head;
+// Address-ordered insert with neighbor coalescing; caller holds the
+// region mutex. Blocks are routed here by start address, so coalescing
+// within the list is always address-correct (a block may extend past
+// its region's nominal end after a spanning allocation — ownership is
+// by start, the boundary is only a routing hint).
+void free_in_region(Store* s, RegionState* rg, uint64_t off, uint64_t size) {
+  rg->allocated -= size;
+  uint64_t prev = kNil, cur = rg->free_head;
   while (cur != kNil && cur < off) {
     prev = cur;
     cur = fb(s, cur)->next;
   }
   uint64_t next = cur;
-  // Merge with next.
-  if (next != kNil && off + size == next) {
+  if (next != kNil && off + size == next) {  // merge with next
     size += fb(s, next)->size;
     next = fb(s, next)->next;
   }
-  // Merge with prev.
-  if (prev != kNil && prev + fb(s, prev)->size == off) {
+  if (prev != kNil && prev + fb(s, prev)->size == off) {  // merge with prev
     fb(s, prev)->size += size;
     fb(s, prev)->next = next;
     return;
@@ -246,43 +424,155 @@ void free_block(Store* s, uint64_t off, uint64_t size) {
   FreeBlock* blk = fb(s, off);
   blk->size = size;
   blk->next = next;
-  if (prev == kNil) h->free_head = off; else fb(s, prev)->next = off;
+  if (prev == kNil) rg->free_head = off; else fb(s, prev)->next = off;
 }
 
-// Convert a just-tombstoned slot (and any tombstone run ending at it) back to
-// EMPTY when the next probe slot is EMPTY — bounds probe-path degradation
-// under create/delete churn.
-void scrub_tombstones(Store* s, Slot* sl) {
-  uint32_t cap = s->hdr->table_cap;
-  uint32_t idx = static_cast<uint32_t>(sl - s->slots);
-  if (s->slots[(idx + 1) % cap].state != EMPTY) return;
+void region_free(Store* s, uint64_t off, uint64_t size) {
+  uint32_t r = region_of(s, off);
+  RegionGuard g(s, r);
+  free_in_region(s, &s->hdr->regions[r], off, size);
+}
+
+// Slow path for requests no single region can satisfy: take ALL region
+// locks in ascending order (deadlock-free by construction), allocate
+// from the temporarily merged global view, and rebuild the per-region
+// lists routed by start address.
+int64_t alloc_spanning(Store* s, uint64_t want, uint64_t* granted) {
+  Header* h = s->hdr;
+  uint32_t n = h->num_shards;
+  for (uint32_t r = 0; r < n; ++r) {
+    RegionState* rg = &h->regions[r];
+    lock_timed(&rg->mutex, &rg->lock_wait_ns, &rg->lock_contended);
+  }
+  // Per-region lists are address-ordered and keyed by block start, so
+  // concatenating them in region order yields one global address order.
+  std::vector<std::pair<uint64_t, uint64_t>> blocks;  // (off, size)
+  for (uint32_t r = 0; r < n; ++r) {
+    for (uint64_t cur = h->regions[r].free_head; cur != kNil;
+         cur = fb(s, cur)->next) {
+      uint64_t off = cur, size = fb(s, cur)->size;
+      if (!blocks.empty() &&
+          blocks.back().first + blocks.back().second == off) {
+        blocks.back().second += size;  // coalesce across region seams
+      } else {
+        blocks.emplace_back(off, size);
+      }
+    }
+  }
+  int64_t out = SS_NO_MEMORY;
+  for (auto& b : blocks) {
+    if (b.second < want) continue;
+    uint64_t take = want;
+    uint64_t remain = b.second - want;
+    if (remain < kAlign + sizeof(FreeBlock)) {
+      take = b.second;
+      remain = 0;
+    }
+    out = static_cast<int64_t>(b.first);
+    *granted = take;
+    h->regions[region_of(s, b.first)].allocated += take;
+    b.first += take;
+    b.second = remain;
+    break;
+  }
+  // Rebuild the per-region lists (ordering preserved: blocks is global
+  // address order, appends keep each list sorted).
+  uint64_t heads[kMaxShards];
+  uint64_t* tails[kMaxShards];
+  for (uint32_t r = 0; r < n; ++r) {
+    heads[r] = kNil;
+    tails[r] = &heads[r];
+  }
+  for (auto& b : blocks) {
+    if (b.second == 0) continue;
+    FreeBlock* blk = fb(s, b.first);
+    blk->size = b.second;
+    blk->next = kNil;
+    uint32_t r = region_of(s, b.first);
+    *tails[r] = b.first;
+    tails[r] = &blk->next;
+  }
+  for (uint32_t r = 0; r < n; ++r) h->regions[r].free_head = heads[r];
+  for (uint32_t r = n; r-- > 0;) pthread_mutex_unlock(&h->regions[r].mutex);
+  return out;
+}
+
+int64_t alloc_block(Store* s, uint64_t want, uint64_t* granted,
+                    uint32_t home) {
+  Header* h = s->hdr;
+  want = align_up(want);
+  uint32_t n = h->num_shards;
+  for (uint32_t i = 0; i < n; ++i) {
+    uint32_t r = (home + i) % n;
+    RegionGuard g(s, r);
+    int64_t off = alloc_in_region(s, &h->regions[r], want, granted);
+    if (off >= 0) return off;
+  }
+  if (n > 1) return alloc_spanning(s, want, granted);
+  return SS_NO_MEMORY;
+}
+
+// Convert a just-tombstoned slot (and any tombstone run ending at it)
+// back to EMPTY when the next probe slot is EMPTY — bounds probe-path
+// degradation under create/delete churn. Shard-local ring; caller holds
+// the shard mutex. Safe against lock-free probes: a probe that reads
+// the fresh EMPTY stops exactly where it would have stopped at the run
+// end (no live element sits beyond an EMPTY slot on its probe path).
+void scrub_tombstones(Store* s, uint32_t shard, Slot* sl) {
+  uint32_t cap = s->hdr->shard_cap;
+  Slot* base = shard_base(s, shard);
+  uint32_t idx = static_cast<uint32_t>(sl - base);
+  if (__atomic_load_n(&base[(idx + 1) % cap].state, __ATOMIC_RELAXED) != EMPTY)
+    return;
   for (uint32_t back = 0; back < cap; ++back) {
-    Slot* cur = &s->slots[(idx + cap - back) % cap];
-    if (cur->state != TOMB) break;
-    cur->state = EMPTY;
+    Slot* cur = &base[(idx + cap - back) % cap];
+    if (__atomic_load_n(&cur->state, __ATOMIC_RELAXED) != TOMB) break;
+    st_state(cur, EMPTY);
   }
 }
 
-// Evict LRU sealed refcount==0 objects until at least `need` bytes were
-// reclaimed (or nothing evictable remains). Returns bytes evicted.
-uint64_t evict_locked(Store* s, uint64_t need) {
-  Header* h = s->hdr;
+// Evict LRU sealed refcount==0 objects from ONE shard until at least
+// `need` bytes were reclaimed (or nothing evictable remains in it).
+uint64_t evict_shard(Store* s, uint32_t shard, uint64_t need) {
+  ShardGuard g(s, shard);
+  ShardState* sh = &s->hdr->shards[shard];
   uint64_t evicted = 0;
-  uint32_t cur = h->lru_tail;
+  uint32_t cur = sh->lru_tail;
   while (cur && evicted < need) {
     Slot* sl = &s->slots[cur - 1];
     uint32_t next = sl->lru_prev;
-    if (sl->state == SEALED && sl->refcount == 0) {
-      lru_unlink(s, sl);
-      free_block(s, sl->offset, sl->alloc_size);
+    if (__atomic_load_n(&sl->state, __ATOMIC_RELAXED) == SEALED &&
+        (__atomic_load_n(&sl->refgen, __ATOMIC_ACQUIRE) & 0xffffffffULL) ==
+            0) {
+      lru_unlink(s, sh, sl);
+      region_free(s, sl->offset, sl->alloc_size);
       evicted += sl->alloc_size;
-      sl->state = TOMB;
-      scrub_tombstones(s, sl);
-      h->num_objects--;
+      sh->evicted_objects++;
+      sh->evicted_bytes += sl->alloc_size;
+      // generation bump BEFORE tombstoning: a lock-free release racing
+      // this eviction must fail its CAS, not resurrect the slot
+      uint64_t gen = __atomic_load_n(&sl->refgen, __ATOMIC_RELAXED) >> 32;
+      __atomic_store_n(&sl->refgen, (gen + 1) << 32, __ATOMIC_RELEASE);
+      st_state(sl, TOMB);
+      scrub_tombstones(s, shard, sl);
+      sh->num_objects--;
     }
     cur = next;
   }
   return evicted;
+}
+
+// Wake blocking gets after a seal. SC fences pair with the waiter's
+// count-then-probe so a seal either sees the parked waiter (and takes
+// the cv_mutex to broadcast) or the waiter's re-probe sees the seal —
+// the cv_mutex is never touched when nobody is blocked.
+void wake_getters(Header* h) {
+  __atomic_thread_fence(__ATOMIC_SEQ_CST);
+  if (__atomic_load_n(&h->cv_waiters, __ATOMIC_SEQ_CST) == 0) return;
+  int rc = pthread_mutex_lock(&h->cv_mutex);
+  if (rc == EOWNERDEAD) pthread_mutex_consistent(&h->cv_mutex);
+  pthread_cond_broadcast(&h->sealed_cv);
+  pthread_mutex_unlock(&h->cv_mutex);
 }
 
 // Guards the process-local handle table (ctypes calls release the GIL, so
@@ -290,7 +580,7 @@ uint64_t evict_locked(Store* s, uint64_t need) {
 pthread_mutex_t g_handle_mutex = PTHREAD_MUTEX_INITIALIZER;
 
 int attach_common(const char* name, bool create, uint64_t capacity,
-                  uint32_t table_cap) {
+                  uint32_t table_cap, uint32_t num_shards) {
   pthread_mutex_lock(&g_handle_mutex);
   int handle = -1;
   for (int i = 0; i < kMaxHandles; ++i) {
@@ -345,17 +635,37 @@ int attach_common(const char* name, bool create, uint64_t capacity,
     memset(h, 0, sizeof(Header));
     h->magic = kMagic;
     h->version = kVersion;
-    h->table_cap = table_cap;
     h->capacity = capacity;
     h->data_off = hdr_bytes + align_up(sizeof(Slot) * static_cast<uint64_t>(table_cap));
-    h->free_head = 0;
-    h->lru_head = h->lru_tail = 0;
+
+    // Shard count: explicit request, else scaled to capacity so small
+    // (test) stores keep exact v1 single-lock/global-LRU semantics.
+    uint32_t nshards = num_shards;
+    if (nshards == 0)
+      nshards = static_cast<uint32_t>(capacity / kMinRegionBytes);
+    if (nshards < 1) nshards = 1;
+    if (nshards > kMaxShards) nshards = kMaxShards;
+    uint32_t shard_cap = table_cap / nshards;
+    if (shard_cap < 8) {  // keep probe rings useful on tiny tables
+      nshards = table_cap / 8 ? table_cap / 8 : 1;
+      if (nshards > kMaxShards) nshards = kMaxShards;
+      shard_cap = table_cap / nshards;
+    }
+    h->num_shards = nshards;
+    h->shard_cap = shard_cap;
+    h->table_cap = shard_cap * nshards;
+    h->region_quant = (capacity / nshards) & ~(kAlign - 1);
+    if (h->region_quant < kAlign) h->region_quant = kAlign;
 
     pthread_mutexattr_t ma;
     pthread_mutexattr_init(&ma);
     pthread_mutexattr_setpshared(&ma, PTHREAD_PROCESS_SHARED);
     pthread_mutexattr_setrobust(&ma, PTHREAD_MUTEX_ROBUST);
-    pthread_mutex_init(&h->mutex, &ma);
+    pthread_mutex_init(&h->cv_mutex, &ma);
+    for (uint32_t i = 0; i < nshards; ++i)
+      pthread_mutex_init(&h->shards[i].mutex, &ma);
+    for (uint32_t i = 0; i < nshards; ++i)
+      pthread_mutex_init(&h->regions[i].mutex, &ma);
     pthread_mutexattr_destroy(&ma);
 
     pthread_condattr_t ca;
@@ -368,9 +678,15 @@ int attach_common(const char* name, bool create, uint64_t capacity,
     s->slots = reinterpret_cast<Slot*>(s->base + hdr_bytes);
     memset(s->slots, 0, sizeof(Slot) * table_cap);
     s->data = s->base + h->data_off;
-    FreeBlock* blk = fb(s, 0);
-    blk->size = capacity;
-    blk->next = kNil;
+    for (uint32_t r = 0; r < nshards; ++r) {
+      RegionState* rg = &h->regions[r];
+      rg->base = r * h->region_quant;
+      rg->size = (r == nshards - 1) ? capacity - rg->base : h->region_quant;
+      rg->free_head = rg->base;
+      FreeBlock* blk = fb(s, rg->base);
+      blk->size = rg->size;
+      blk->next = kNil;
+    }
   } else {
     Header* h = s->hdr;
     if (h->magic != kMagic || h->version != kVersion) {
@@ -393,44 +709,69 @@ Store* get_store(int handle) {
 
 extern "C" {
 
-// Create a new arena (raylet). Returns handle >= 0 or negative error.
-int ss_create_store(const char* name, uint64_t capacity, uint32_t table_cap) {
+// Create a new arena (raylet). `num_shards` 0 = scale with capacity.
+// Returns handle >= 0 or negative error.
+int ss_create_store(const char* name, uint64_t capacity, uint32_t table_cap,
+                    uint32_t num_shards) {
   shm_unlink(name);  // drop any stale arena from a crashed prior session
-  return attach_common(name, /*create=*/true, align_up(capacity), table_cap);
+  return attach_common(name, /*create=*/true, align_up(capacity), table_cap,
+                       num_shards);
 }
 
 // Attach to an existing arena (worker). Returns handle >= 0 or negative error.
 int ss_attach(const char* name) {
-  return attach_common(name, /*create=*/false, 0, 0);
+  return attach_common(name, /*create=*/false, 0, 0, 0);
 }
 
 // Allocate an object buffer. Returns data-region-relative offset, or error.
 // The new object has refcount 1 (the creator) and is invisible to get()
-// until sealed.
+// until sealed. Allocation and eviction run BEFORE the index insert, so
+// the only index critical section is the (tiny) slot write.
 int64_t ss_create(int handle, const uint8_t* id, uint64_t size) {
   Store* s = get_store(handle);
   if (!s) return SS_BAD_HANDLE;
   if (size == 0) size = kAlign;
-  Guard g(s->hdr);
-  Slot* insert = nullptr;
-  if (find_slot(s, id, &insert)) return SS_EXISTS;
-  if (!insert) return SS_TABLE_FULL;
+  Header* h = s->hdr;
+  uint32_t shard = shard_of(s, id);
+  // advisory fast-out: don't evict live data to make room for a
+  // duplicate create (the insert below re-checks authoritatively)
+  {
+    Slot* dup = probe_lockfree(s, shard, id);
+    if (dup && id_eq(dup, id)) return SS_EXISTS;
+  }
   uint64_t granted = 0;
-  int64_t off = alloc_block(s, size, &granted);
+  int64_t off = alloc_block(s, size, &granted, shard);
   // Evict until the allocation fits (not merely until `size` bytes were
   // reclaimed): freed blocks may not coalesce into a large-enough run.
+  // Each sweep starts at the home shard and only locks the shards it
+  // actually has to touch.
   while (off == SS_NO_MEMORY) {
-    if (evict_locked(s, align_up(size)) == 0) return SS_NO_MEMORY;
-    off = alloc_block(s, size, &granted);
+    uint64_t need = align_up(size);
+    uint64_t freed = 0;
+    for (uint32_t i = 0; i < h->num_shards && freed < need; ++i)
+      freed += evict_shard(s, (shard + i) % h->num_shards, need - freed);
+    if (freed == 0) return SS_NO_MEMORY;
+    off = alloc_block(s, size, &granted, shard);
   }
-  memcpy(insert->id, id, kIdSize);
+  ShardGuard g(s, shard);
+  Slot* insert = nullptr;
+  if (find_slot(s, shard, id, &insert)) {
+    region_free(s, static_cast<uint64_t>(off), granted);
+    return SS_EXISTS;
+  }
+  if (!insert) {
+    region_free(s, static_cast<uint64_t>(off), granted);
+    return SS_TABLE_FULL;
+  }
+  id_store(insert, id);
   insert->offset = static_cast<uint64_t>(off);
   insert->size = size;
   insert->alloc_size = granted;
-  insert->state = CREATED;
-  insert->refcount = 1;
   insert->lru_prev = insert->lru_next = 0;
-  s->hdr->num_objects++;
+  uint64_t gen = __atomic_load_n(&insert->refgen, __ATOMIC_RELAXED) >> 32;
+  __atomic_store_n(&insert->refgen, ((gen + 1) << 32) | 1, __ATOMIC_RELEASE);
+  st_state(insert, CREATED);
+  s->hdr->shards[shard].num_objects++;
   return off;
 }
 
@@ -438,13 +779,17 @@ int64_t ss_create(int handle, const uint8_t* id, uint64_t size) {
 int ss_seal(int handle, const uint8_t* id) {
   Store* s = get_store(handle);
   if (!s) return SS_BAD_HANDLE;
-  Guard g(s->hdr);
-  Slot* sl = find_slot(s, id);
-  if (!sl) return SS_NOT_FOUND;
-  if (sl->state == SEALED) return SS_EXISTS;
-  sl->state = SEALED;
-  lru_push_front(s, sl);
-  pthread_cond_broadcast(&s->hdr->sealed_cv);
+  uint32_t shard = shard_of(s, id);
+  {
+    ShardGuard g(s, shard);
+    Slot* sl = find_slot(s, shard, id);
+    if (!sl) return SS_NOT_FOUND;
+    if (__atomic_load_n(&sl->state, __ATOMIC_RELAXED) == SEALED)
+      return SS_EXISTS;
+    st_state(sl, SEALED);
+    lru_push_front(s, &s->hdr->shards[shard], sl);
+  }
+  wake_getters(s->hdr);
   return SS_OK;
 }
 
@@ -456,6 +801,7 @@ int64_t ss_get(int handle, const uint8_t* id, uint64_t* size_out,
   Store* s = get_store(handle);
   if (!s) return SS_BAD_HANDLE;
   Header* h = s->hdr;
+  uint32_t shard = shard_of(s, id);
   struct timespec deadline;
   if (timeout_s > 0) {
     clock_gettime(CLOCK_MONOTONIC, &deadline);
@@ -466,60 +812,94 @@ int64_t ss_get(int handle, const uint8_t* id, uint64_t* size_out,
       deadline.tv_nsec -= 1000000000L;
     }
   }
-  Guard g(h);
   for (;;) {
-    Slot* sl = find_slot(s, id);
-    if (sl && sl->state == SEALED) {
-      sl->refcount++;
-      lru_unlink(s, sl);
-      lru_push_front(s, sl);
-      *size_out = sl->size;
-      return static_cast<int64_t>(sl->offset);
+    {
+      ShardGuard g(s, shard);
+      Slot* sl = find_slot(s, shard, id);
+      if (sl && __atomic_load_n(&sl->state, __ATOMIC_RELAXED) == SEALED) {
+        __atomic_fetch_add(&sl->refgen, 1, __ATOMIC_ACQ_REL);
+        ShardState* sh = &h->shards[shard];
+        lru_unlink(s, sh, sl);
+        lru_push_front(s, sh, sl);
+        *size_out = sl->size;
+        return static_cast<int64_t>(sl->offset);
+      }
+      if (timeout_s < 0) return sl ? SS_NOT_SEALED : SS_NOT_FOUND;
     }
-    if (timeout_s < 0) return sl ? SS_NOT_SEALED : SS_NOT_FOUND;
-    int rc;
-    if (timeout_s == 0) {
-      rc = pthread_cond_wait(&h->sealed_cv, &h->mutex);
-    } else {
-      rc = pthread_cond_timedwait(&h->sealed_cv, &h->mutex, &deadline);
+    // Park on the global sealed cv. The waiter count is published (SC)
+    // BEFORE the re-probe; wake_getters fences symmetrically, so either
+    // the sealer sees us parked or our re-probe sees the seal.
+    __atomic_fetch_add(&h->cv_waiters, 1, __ATOMIC_SEQ_CST);
+    int rc = pthread_mutex_lock(&h->cv_mutex);
+    if (rc == EOWNERDEAD) pthread_mutex_consistent(&h->cv_mutex);
+    __atomic_thread_fence(__ATOMIC_SEQ_CST);
+    Slot* sl = probe_lockfree(s, shard, id);
+    rc = 0;
+    if (!(sl && ld_state(sl) == SEALED)) {
+      if (timeout_s == 0) {
+        rc = pthread_cond_wait(&h->sealed_cv, &h->cv_mutex);
+      } else {
+        rc = pthread_cond_timedwait(&h->sealed_cv, &h->cv_mutex, &deadline);
+      }
+      if (rc == EOWNERDEAD) {
+        pthread_mutex_consistent(&h->cv_mutex);
+        rc = 0;
+      }
     }
+    pthread_mutex_unlock(&h->cv_mutex);
+    __atomic_fetch_sub(&h->cv_waiters, 1, __ATOMIC_SEQ_CST);
     if (rc == ETIMEDOUT) return SS_TIMEOUT;
   }
 }
 
-// 0 = absent, 1 = created (unsealed), 2 = sealed.
+// 0 = absent, 1 = created (unsealed), 2 = sealed. Entirely lock-free.
 int ss_contains(int handle, const uint8_t* id) {
   Store* s = get_store(handle);
   if (!s) return SS_BAD_HANDLE;
-  Guard g(s->hdr);
-  Slot* sl = find_slot(s, id);
+  Slot* sl = probe_lockfree(s, shard_of(s, id), id);
   if (!sl) return 0;
-  return sl->state == SEALED ? 2 : 1;
+  return ld_state(sl) == SEALED ? 2 : 1;
 }
 
 // Drop one reference (creator after seal, or a getter when done).
+// Lock-free: a generation-checked CAS on the packed (gen, refcount)
+// word — if the slot was recycled between the probe and the CAS, the
+// generation mismatch aborts the decrement instead of corrupting the
+// new occupant's count.
 int ss_release(int handle, const uint8_t* id) {
   Store* s = get_store(handle);
   if (!s) return SS_BAD_HANDLE;
-  Guard g(s->hdr);
-  Slot* sl = find_slot(s, id);
+  Slot* sl = probe_lockfree(s, shard_of(s, id), id);
   if (!sl) return SS_NOT_FOUND;
-  if (sl->refcount > 0) sl->refcount--;
-  return SS_OK;
+  uint64_t rg = __atomic_load_n(&sl->refgen, __ATOMIC_ACQUIRE);
+  if (!id_eq(sl, id)) return SS_NOT_FOUND;  // recycled between probe and read
+  uint64_t gen = rg >> 32;
+  for (;;) {
+    if ((rg >> 32) != gen) return SS_NOT_FOUND;  // our incarnation is gone
+    if ((rg & 0xffffffffULL) == 0) return SS_OK;  // nothing left to drop
+    if (__atomic_compare_exchange_n(&sl->refgen, &rg, rg - 1, false,
+                                    __ATOMIC_ACQ_REL, __ATOMIC_ACQUIRE))
+      return SS_OK;
+  }
 }
 
 // Delete an object immediately (abort an unsealed create, or force-remove).
 int ss_delete(int handle, const uint8_t* id) {
   Store* s = get_store(handle);
   if (!s) return SS_BAD_HANDLE;
-  Guard g(s->hdr);
-  Slot* sl = find_slot(s, id);
+  uint32_t shard = shard_of(s, id);
+  ShardGuard g(s, shard);
+  Slot* sl = find_slot(s, shard, id);
   if (!sl) return SS_NOT_FOUND;
-  if (sl->state == SEALED) lru_unlink(s, sl);
-  free_block(s, sl->offset, sl->alloc_size);
-  sl->state = TOMB;
-  scrub_tombstones(s, sl);
-  s->hdr->num_objects--;
+  ShardState* sh = &s->hdr->shards[shard];
+  if (__atomic_load_n(&sl->state, __ATOMIC_RELAXED) == SEALED)
+    lru_unlink(s, sh, sl);
+  region_free(s, sl->offset, sl->alloc_size);
+  uint64_t gen = __atomic_load_n(&sl->refgen, __ATOMIC_RELAXED) >> 32;
+  __atomic_store_n(&sl->refgen, (gen + 1) << 32, __ATOMIC_RELEASE);
+  st_state(sl, TOMB);
+  scrub_tombstones(s, shard, sl);
+  sh->num_objects--;
   return SS_OK;
 }
 
@@ -527,44 +907,117 @@ int ss_delete(int handle, const uint8_t* id) {
 uint64_t ss_evict(int handle, uint64_t nbytes) {
   Store* s = get_store(handle);
   if (!s) return 0;
-  Guard g(s->hdr);
-  return evict_locked(s, nbytes);
+  uint64_t evicted = 0;
+  for (uint32_t i = 0; i < s->hdr->num_shards && evicted < nbytes; ++i)
+    evicted += evict_shard(s, i, nbytes - evicted);
+  return evicted;
 }
 
 void ss_stats(int handle, uint64_t* capacity, uint64_t* allocated,
               uint32_t* num_objects) {
   Store* s = get_store(handle);
   if (!s) { *capacity = *allocated = 0; *num_objects = 0; return; }
-  Guard g(s->hdr);
-  *capacity = s->hdr->capacity;
-  *allocated = s->hdr->allocated;
-  *num_objects = s->hdr->num_objects;
+  Header* h = s->hdr;
+  *capacity = h->capacity;
+  uint64_t alloc = 0;
+  uint32_t n = 0;
+  for (uint32_t i = 0; i < h->num_shards; ++i) {
+    ShardGuard g(s, i);
+    n += h->shards[i].num_objects;
+  }
+  for (uint32_t i = 0; i < h->num_shards; ++i) {
+    RegionGuard g(s, i);
+    alloc += h->regions[i].allocated;
+  }
+  *allocated = alloc;
+  *num_objects = n;
 }
 
-// ss_stats plus the UNEVICTABLE byte count: bytes in unsealed objects
-// or sealed objects some client still references. `allocated` includes
+// ss_stats plus the UNEVICTABLE byte count and aggregate contention
+// counters. `referenced` is bytes in unsealed objects or sealed objects
+// some client still references; `allocated` additionally counts
 // evictable garbage a later create would reclaim, so backpressure
-// decisions must look at `referenced` instead (allocated-based
-// throttling stalls on space that is actually free).
+// decisions must look at `referenced` (allocated-based throttling
+// stalls on space that is actually free). `lock_wait_ns`/`lock_contended`
+// sum the index-shard and alloc-region mutexes; `evicted_objects` sums
+// LRU evictions since creation.
 void ss_stats2(int handle, uint64_t* capacity, uint64_t* allocated,
-               uint32_t* num_objects, uint64_t* referenced) {
+               uint32_t* num_objects, uint64_t* referenced,
+               uint64_t* lock_wait_ns, uint64_t* lock_contended,
+               uint64_t* evicted_objects) {
   Store* s = get_store(handle);
-  if (!s) { *capacity = *allocated = *referenced = 0; *num_objects = 0;
-            return; }
-  Guard g(s->hdr);
-  *capacity = s->hdr->capacity;
-  *allocated = s->hdr->allocated;
-  *num_objects = s->hdr->num_objects;
-  uint64_t ref = 0;
-  uint32_t cap = s->hdr->table_cap;
-  for (uint32_t i = 0; i < cap; ++i) {
-    Slot* sl = &s->slots[i];
-    if (sl->state == CREATED ||
-        (sl->state == SEALED && sl->refcount > 0)) {
-      ref += sl->alloc_size;
+  if (!s) {
+    *capacity = *allocated = *referenced = 0;
+    *lock_wait_ns = *lock_contended = *evicted_objects = 0;
+    *num_objects = 0;
+    return;
+  }
+  Header* h = s->hdr;
+  *capacity = h->capacity;
+  uint64_t alloc = 0, ref = 0, wait = 0, cont = 0, evd = 0;
+  uint32_t n = 0;
+  for (uint32_t i = 0; i < h->num_shards; ++i) {
+    ShardGuard g(s, i);
+    ShardState* sh = &h->shards[i];
+    n += sh->num_objects;
+    wait += sh->lock_wait_ns;
+    cont += sh->lock_contended;
+    evd += sh->evicted_objects;
+    Slot* base = shard_base(s, i);
+    for (uint32_t j = 0; j < h->shard_cap; ++j) {
+      Slot* sl = &base[j];
+      uint32_t st = __atomic_load_n(&sl->state, __ATOMIC_RELAXED);
+      if (st == CREATED ||
+          (st == SEALED &&
+           (__atomic_load_n(&sl->refgen, __ATOMIC_RELAXED) & 0xffffffffULL) >
+               0)) {
+        ref += sl->alloc_size;
+      }
     }
   }
+  for (uint32_t i = 0; i < h->num_shards; ++i) {
+    RegionGuard g(s, i);
+    alloc += h->regions[i].allocated;
+    wait += h->regions[i].lock_wait_ns;
+    cont += h->regions[i].lock_contended;
+  }
+  *allocated = alloc;
+  *num_objects = n;
   *referenced = ref;
+  *lock_wait_ns = wait;
+  *lock_contended = cont;
+  *evicted_objects = evd;
+}
+
+uint32_t ss_num_shards(int handle) {
+  Store* s = get_store(handle);
+  return s ? s->hdr->num_shards : 0;
+}
+
+// Per-shard instrumentation row: [lock_wait_ns, lock_contended,
+// lock_acquisitions, evicted_objects, evicted_bytes, num_objects,
+// region_allocated, region_lock_wait_ns]. Returns SS_OK or an error.
+int ss_shard_stats(int handle, uint32_t shard, uint64_t* out) {
+  Store* s = get_store(handle);
+  if (!s) return static_cast<int>(SS_BAD_HANDLE);
+  Header* h = s->hdr;
+  if (shard >= h->num_shards) return static_cast<int>(SS_NOT_FOUND);
+  {
+    ShardGuard g(s, shard);
+    ShardState* sh = &h->shards[shard];
+    out[0] = sh->lock_wait_ns;
+    out[1] = sh->lock_contended;
+    out[2] = sh->lock_acquisitions;
+    out[3] = sh->evicted_objects;
+    out[4] = sh->evicted_bytes;
+    out[5] = sh->num_objects;
+  }
+  {
+    RegionGuard g(s, shard);
+    out[6] = h->regions[shard].allocated;
+    out[7] = h->regions[shard].lock_wait_ns;
+  }
+  return static_cast<int>(SS_OK);
 }
 
 // Parallel memcopy for large object payloads (reference: the plasma

@@ -4,10 +4,18 @@
 // TSAN/ASAN bazel configs (SURVEY.md §5 "race detection / sanitizers");
 // this is the equivalent harness for shm_store.cc. N threads hammer one
 // store with create/seal/get/release/delete plus LRU-eviction pressure
-// (objects are sized so the arena wraps several times). Build with
-// `make stress-asan` / `make stress-tsan` and run; a clean exit under
-// the sanitizer is the pass condition (tests/test_native_sanitize.py
-// drives the ASAN build in CI).
+// (objects are sized so the arena wraps several times). Two phases:
+//
+//   1. single-shard (auto-degraded small arena): the v1 shape — global
+//      LRU, one index stripe, one free list.
+//   2. forced 8-way sharding on the same small arena: hammers the
+//      sharded create/seal/evict paths, the lock-free contains/release
+//      probes, cross-shard eviction sweeps, and the all-region-locks
+//      spanning allocator (every 64th object is bigger than one region).
+//
+// Build with `make stress-asan` / `make stress-tsan` and run; a clean
+// exit under the sanitizer is the pass condition
+// (tests/test_native_sanitize.py drives both builds in CI).
 
 #include <atomic>
 #include <cstdint>
@@ -21,10 +29,12 @@
 #include <unistd.h>
 
 extern "C" {
-int ss_create_store(const char* name, uint64_t capacity, uint32_t table_cap);
+int ss_create_store(const char* name, uint64_t capacity, uint32_t table_cap,
+                    uint32_t num_shards);
 int64_t ss_create(int handle, const uint8_t* id, uint64_t size);
 int ss_seal(int handle, const uint8_t* id);
 int64_t ss_get(int handle, const uint8_t* id, uint64_t* size, double timeout);
+int ss_contains(int handle, const uint8_t* id);
 int ss_release(int handle, const uint8_t* id);
 int ss_delete(int handle, const uint8_t* id);
 uint64_t ss_evict(int handle, uint64_t nbytes);
@@ -32,6 +42,12 @@ int ss_detach(int handle);
 int ss_unlink_store(const char* name);
 uint64_t ss_data_offset(int handle);
 uint64_t ss_map_size(int handle);
+void ss_stats2(int handle, uint64_t* capacity, uint64_t* allocated,
+               uint32_t* num_objects, uint64_t* referenced,
+               uint64_t* lock_wait_ns, uint64_t* lock_contended,
+               uint64_t* evicted_objects);
+uint32_t ss_num_shards(int handle);
+int ss_shard_stats(int handle, uint32_t shard, uint64_t* out);
 }
 
 namespace {
@@ -41,6 +57,9 @@ constexpr int kItersPerThread = 2000;
 constexpr uint64_t kObjectSize = 64 * 1024;
 // arena holds ~32 objects; 8 threads x 2000 iterations wrap it ~500x
 constexpr uint64_t kCapacity = 2 * 1024 * 1024;
+// in the sharded phase (8 regions of 256 KB) this forces the spanning
+// (all-region-locks) allocation path
+constexpr uint64_t kBigObjectSize = 512 * 1024;
 
 void make_id(uint8_t* id, int thread, int i) {
   std::memset(id, 0, 16);
@@ -52,15 +71,17 @@ std::atomic<int> failures{0};
 
 uint8_t* g_base = nullptr;
 
-void worker(int handle, int thread) {
+void worker(int handle, int thread, bool sharded) {
   uint8_t* base = g_base;
   uint64_t data_off = ss_data_offset(handle);
   uint8_t id[16];
   for (int i = 0; i < kItersPerThread; ++i) {
     make_id(id, thread, i);
-    int64_t off = ss_create(handle, id, kObjectSize);
+    uint64_t want =
+        (sharded && i % 64 == 0) ? kBigObjectSize : kObjectSize;
+    int64_t off = ss_create(handle, id, want);
     if (off < 0) continue;  // full under pressure: acceptable
-    std::memset(base + data_off + off, thread & 0xff, kObjectSize);
+    std::memset(base + data_off + off, thread & 0xff, want);
     ss_seal(handle, id);
     ss_release(handle, id);
 
@@ -73,25 +94,35 @@ void worker(int handle, int thread) {
     if (got >= 0) {
       volatile uint8_t sink = base[data_off + got];
       (void)sink;
-      if (size != kObjectSize) failures.fetch_add(1);
+      if (size != kObjectSize && size != kBigObjectSize)
+        failures.fetch_add(1);
       ss_release(handle, other);
     }
+    // lock-free probes racing create/seal/evict on other threads' ids
+    make_id(other, (thread + 3) % kThreads, i);
+    (void)ss_contains(handle, other);
+    if (i % 5 == 0) ss_release(handle, other);  // stale/absent: must be safe
     if (i % 16 == 0) ss_evict(handle, kObjectSize);
     if (i % 7 == 0) {
       make_id(other, thread, i / 2);
       ss_delete(handle, other);
     }
+    if (i % 128 == 0) {  // stats readers racing the data plane
+      uint64_t cap, alloc, ref, wait, cont, evd;
+      uint32_t n;
+      ss_stats2(handle, &cap, &alloc, &n, &ref, &wait, &cont, &evd);
+      uint64_t row[8];
+      for (uint32_t sh = 0; sh < ss_num_shards(handle); ++sh)
+        ss_shard_stats(handle, sh, row);
+    }
   }
 }
 
-}  // namespace
-
-int main() {
-  const char* name = "/ray_tpu_stress";
+int run_phase(const char* name, uint32_t num_shards, const char* label) {
   ss_unlink_store(name);
-  int handle = ss_create_store(name, kCapacity, 4096);
+  int handle = ss_create_store(name, kCapacity, 4096, num_shards);
   if (handle < 0) {
-    std::fprintf(stderr, "create_store failed\n");
+    std::fprintf(stderr, "create_store(%s) failed\n", label);
     return 1;
   }
   // the store mmaps internally but does not export its base; map the
@@ -102,21 +133,36 @@ int main() {
                                       fd, 0));
   close(fd);
   if (g_base == MAP_FAILED) {
-    std::fprintf(stderr, "mmap failed\n");
+    std::fprintf(stderr, "mmap(%s) failed\n", label);
     return 1;
   }
+  bool sharded = ss_num_shards(handle) > 1;
   std::vector<std::thread> threads;
   for (int t = 0; t < kThreads; ++t) {
-    threads.emplace_back(worker, handle, t);
+    threads.emplace_back(worker, handle, t, sharded);
   }
   for (auto& th : threads) th.join();
+  void* mapped = g_base;
+  uint64_t mapped_size = ss_map_size(handle);
   ss_detach(handle);
+  munmap(mapped, mapped_size);
   ss_unlink_store(name);
   if (failures.load() != 0) {
-    std::fprintf(stderr, "corruption: %d bad sizes\n", failures.load());
+    std::fprintf(stderr, "corruption (%s): %d bad sizes\n", label,
+                 failures.load());
     return 2;
   }
-  std::printf("stress OK: %d threads x %d iterations\n", kThreads,
-              kItersPerThread);
+  std::printf("stress OK (%s): %d threads x %d iterations\n", label,
+              kThreads, kItersPerThread);
+  return 0;
+}
+
+}  // namespace
+
+int main() {
+  int rc = run_phase("/ray_tpu_stress", 0, "single-shard");
+  if (rc != 0) return rc;
+  rc = run_phase("/ray_tpu_stress_sharded", 8, "sharded");
+  if (rc != 0) return rc;
   return 0;
 }

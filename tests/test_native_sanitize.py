@@ -5,6 +5,12 @@ under AddressSanitizer and ThreadSanitizer — the reference's TSAN/ASAN
 bazel-config equivalent for `src/ray/object_manager/plasma/`. TSAN is
 the native-side counterpart of the Python-side lockdep + raylint gates:
 ASAN catches lifetime bugs, TSAN the data races and lock inversions.
+
+The driver runs two phases and both must print their OK line: the
+single-shard (v1-shaped) store, and an 8-way-sharded store that hammers
+the sharded create/seal/evict paths, the lock-free contains/release
+probes, cross-shard eviction sweeps, and the all-region-locks spanning
+allocator.
 """
 
 import os
@@ -32,7 +38,8 @@ def _build_and_stress(target: str, label: str,
         capture_output=True, text=True, timeout=300, env=env)
     assert run.returncode == 0, \
         f"{label} stress failed:\n{run.stdout[-1000:]}\n{run.stderr[-3000:]}"
-    assert "stress OK" in run.stdout
+    assert "stress OK (single-shard)" in run.stdout
+    assert "stress OK (sharded)" in run.stdout
 
 
 def test_shm_store_stress_under_asan():

@@ -128,6 +128,229 @@ def test_cross_process_get():
         store.destroy()
 
 
+def test_stats_expose_lock_and_eviction_counters(shm_store):
+    st = shm_store.stats()
+    for key in ("lock_wait_ns", "lock_contended", "evicted_objects",
+                "referenced"):
+        assert key in st
+    assert shm_store.num_shards >= 1
+    rows = shm_store.shard_stats()
+    assert len(rows) == shm_store.num_shards
+    assert all("lock_acquisitions" in r for r in rows)
+    # force evictions; the aggregate and per-shard counters must move
+    for _ in range(9):
+        _put(shm_store, ObjectID.from_random(), 8 * 1024 * 1024)
+    assert shm_store.stats()["evicted_objects"] > 0
+    assert sum(r["evicted_objects"] for r in shm_store.shard_stats()) > 0
+
+
+def _put(store, oid, nbytes):
+    buf = store.create_buffer(oid, nbytes)
+    buf[:4] = b"xxxx"
+    store.seal(oid)
+    store.release(oid)
+
+
+@pytest.fixture
+def sharded_store():
+    """A store with 8 forced index/allocator shards (a production-sized
+    arena would pick this up automatically from its capacity)."""
+    name = f"/ray_tpu_test_sh_{os.getpid()}_{os.urandom(4).hex()}"
+    store = ObjectStore.create(name, capacity=32 * 1024 * 1024,
+                               table_size=4096, shards=8)
+    yield store
+    store.destroy()
+
+
+def test_sharded_store_basics(sharded_store):
+    assert sharded_store.num_shards == 8
+    oids = [ObjectID.from_random() for _ in range(64)]
+    for i, oid in enumerate(oids):
+        buf = sharded_store.create_buffer(oid, 4096)
+        buf[:] = bytes([i % 251]) * 4096
+        sharded_store.seal(oid)
+        sharded_store.release(oid)
+    for i, oid in enumerate(oids):
+        out = sharded_store.get_buffer(oid)
+        assert bytes(out) == bytes([i % 251]) * 4096
+    # objects landed across multiple stripes, not one hot shard
+    populated = sum(1 for r in sharded_store.shard_stats()
+                    if r["num_objects"] > 0)
+    assert populated > 1
+
+
+def test_sharded_spanning_allocation(sharded_store):
+    # 32 MB / 8 shards = 4 MB regions: a 10 MB object cannot fit any
+    # single region free list and must take the spanning (all-region
+    # locks) path — and still read back intact.
+    oid = ObjectID.from_random()
+    buf = sharded_store.create_buffer(oid, 10 * 1024 * 1024)
+    buf[:8] = b"spanning"
+    buf[-8:] = b"tail-ok!"
+    sharded_store.seal(oid)
+    out = sharded_store.get_buffer(oid)
+    assert bytes(out[:8]) == b"spanning"
+    assert bytes(out[-8:]) == b"tail-ok!"
+
+
+def test_sharded_cross_shard_eviction(sharded_store):
+    # fill every stripe with small evictable objects, then create one
+    # object larger than any stripe's share: the eviction sweep must
+    # reclaim across shards (taking only the shards it touches)
+    for _ in range(100):
+        _put(sharded_store, ObjectID.from_random(), 256 * 1024)
+    big = ObjectID.from_random()
+    buf = sharded_store.create_buffer(big, 24 * 1024 * 1024)
+    assert buf.nbytes == 24 * 1024 * 1024
+    assert sharded_store.stats()["evicted_objects"] > 0
+
+
+# -- concurrent correctness (tentpole gate): 4 threads + 2 processes
+# interleave create/write/seal/get/delete/evict on one sharded store;
+# no torn reads, exact refcount accounting at quiesce. Runs under
+# RAY_TPU_LOCKDEP=1 via the module-wide conftest fixture. ----------------
+
+def _det_oid(seed: int, i: int) -> ObjectID:
+    return ObjectID(bytes([seed % 256]) + i.to_bytes(4, "little") + b"\0" * 11)
+
+
+def _mixed_ops(store, seed, iters, peers):
+    """One worker's op mix. Shared ids are written with a uniform tag
+    byte and never force-deleted (readers hold refcounts, so eviction
+    cannot touch them mid-read — any non-uniform read is a torn read).
+    Delete churn runs on a private id namespace nobody else reads."""
+    import random
+
+    rng = random.Random(seed)
+    errors = []
+    for i in range(iters):
+        oid = _det_oid(seed, i)
+        tag = (seed * 31 + i) % 251
+        size = rng.choice([512, 4096, 65536])
+        try:
+            buf = store.create_buffer(oid, size)
+        except ObjectStoreError:  # full under pressure: acceptable
+            buf = None
+        if buf is not None:
+            buf[:] = bytes([tag]) * size
+            del buf
+            store.seal(oid)
+            store.release(oid)
+        # read a peer's recent object (may be evicted — both outcomes
+        # legal, but a present object must be untorn)
+        p = peers[rng.randrange(len(peers))]
+        view = store.get_buffer(_det_oid(p, rng.randrange(i + 1)),
+                                timeout=-1)
+        if view is not None:
+            data = bytes(view)
+            if data and any(b != data[0] for b in data):
+                errors.append(f"torn read by {seed} at iter {i}")
+            del view
+        if i % 16 == 0:
+            store.evict(64 * 1024)
+        if i % 7 == 0:
+            # private create/delete churn (ids offset far from shared)
+            priv = _det_oid(seed + 100, i)
+            try:
+                store.create_buffer(priv, 2048)
+                store.delete(priv)
+            except ObjectStoreError:
+                pass
+    return errors
+
+
+def _mixed_proc(name, seed, iters, peers, q):
+    from ray_tpu._private.object_store import ObjectStore as _OS
+
+    store = _OS.attach(name)
+    try:
+        q.put(_mixed_ops(store, seed, iters, peers))
+    finally:
+        store.close()
+
+
+def test_concurrent_mixed_ops_no_torn_reads_exact_refcounts():
+    import gc
+    import threading
+
+    name = f"/ray_tpu_test_mix_{os.getpid()}"
+    store = ObjectStore.create(name, capacity=32 * 1024 * 1024,
+                               table_size=4096, shards=8)
+    try:
+        thread_seeds = [1, 2, 3, 4]
+        proc_seeds = [5, 6]
+        peers = thread_seeds + proc_seeds
+        ctx = multiprocessing.get_context("spawn")
+        q = ctx.Queue()
+        procs = [ctx.Process(target=_mixed_proc,
+                             args=(name, s, 250, peers, q))
+                 for s in proc_seeds]
+        for p in procs:
+            p.start()
+        results = []
+        threads = [threading.Thread(
+            target=lambda s=s: results.append(
+                _mixed_ops(store, s, 400, peers)))
+            for s in thread_seeds]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        for p in procs:
+            results.append(q.get(timeout=120))
+        for p in procs:
+            p.join(timeout=30)
+        errors = [e for r in results for e in r]
+        assert not errors, errors[:5]
+
+        # exact refcount accounting at quiesce: every creator released,
+        # every reader view dropped -> nothing is referenced, and a full
+        # eviction sweep must drain the store to zero objects/bytes
+        gc.collect()
+        st = store.stats()
+        assert st["referenced"] == 0, st
+        store.evict(2 ** 62)
+        st = store.stats()
+        assert st["num_objects"] == 0, st
+        assert st["allocated"] == 0, st
+    finally:
+        store.destroy()
+
+
+def test_close_drops_handle_refs_before_detach():
+    """Regression (use-after-detach): close() must null _lib/_h BEFORE
+    detaching so a late PlasmaBuffer.__del__ cannot ss_release on a
+    handle index a newer store reuses."""
+    import gc
+
+    name = f"/ray_tpu_test_close_{os.getpid()}"
+    store = ObjectStore.create(name, capacity=4 * 1024 * 1024,
+                               table_size=256)
+    oid = ObjectID.from_random()
+    buf = store.create_buffer(oid, 1024)
+    buf[:4] = b"live"
+    del buf
+    store.seal(oid)
+    view = store.get_buffer(oid)  # holds a PlasmaBuffer store ref
+    store.destroy()
+    assert store._h == -1 and store._lib is None
+    # a second store that reuses the freed handle index must be immune
+    # to the stale view's __del__
+    store2 = ObjectStore.create(name, capacity=4 * 1024 * 1024,
+                                table_size=256)
+    try:
+        oid2 = ObjectID.from_random()
+        buf2 = store2.create_buffer(oid2, 1024)
+        del buf2
+        store2.seal(oid2)  # creator ref still held -> referenced > 0
+        before = store2.stats()["referenced"]
+        del view
+        gc.collect()  # stale PlasmaBuffer.__del__ fires: must be a no-op
+        assert store2.stats()["referenced"] == before
+    finally:
+        store2.destroy()
+
+
 def test_coalescing_allocator(shm_store):
     # Allocate the entire region in chunks, free them all, then allocate one
     # object nearly the full size: only works if free blocks coalesce.

@@ -1,4 +1,5 @@
 import numpy as np
+import pytest
 
 from ray_tpu._private import serialization
 
@@ -39,6 +40,50 @@ def test_custom_serializer():
         assert out.v == 42
     finally:
         serialization.deregister_serializer(Opaque)
+
+
+def test_serialize_value_one_copy_roundtrip():
+    value = {"a": np.arange(10000, dtype=np.float64), "b": "text", "c": 7}
+    sv = serialization.serialize_value(value)
+    # nothing large copied yet: the pickle stream is a view over the
+    # pickler's buffer, oob buffers are views over the original arrays
+    assert isinstance(sv.pickled, memoryview)
+    assert len(sv.buffers) == 1
+    dst = bytearray(sv.size)
+    written = sv.write_into(memoryview(dst))
+    assert written == sv.size
+    out = serialization.loads(dst)
+    np.testing.assert_array_equal(out["a"], value["a"])
+    assert out["b"] == "text" and out["c"] == 7
+
+
+def test_serialize_value_frame_matches_pack():
+    value = [np.ones(777, np.uint8), {"k": b"v" * 1000}]
+    sv = serialization.serialize_value(value)
+    pickled, buffers = serialization.serialize(value)
+    assert sv.size == serialization.serialized_size(pickled, buffers)
+    assert sv.to_bytes() == serialization.pack(pickled, buffers)
+
+
+def test_serialize_into():
+    arr = np.arange(4096, dtype=np.int32)
+    sv = serialization.serialize_value(arr)
+    dst = bytearray(sv.size)
+    n = serialization.serialize_into(memoryview(dst), arr)
+    assert n == sv.size
+    np.testing.assert_array_equal(serialization.loads(dst), arr)
+    with pytest.raises(ValueError):
+        serialization.serialize_into(memoryview(bytearray(8)), arr)
+
+
+def test_serialize_value_noncontiguous_buffer():
+    # a transposed (non-C-contiguous parent) array still frames and
+    # round-trips — write_into must normalize oob views to flat bytes
+    arr = np.arange(64, dtype=np.float32).reshape(8, 8)[:, :4].copy()
+    sv = serialization.serialize_value(arr)
+    dst = bytearray(sv.size)
+    sv.write_into(memoryview(dst))
+    np.testing.assert_array_equal(serialization.loads(dst), arr)
 
 
 def test_closures_cloudpickled():
