@@ -20,6 +20,7 @@ from __future__ import annotations
 import asyncio
 import contextvars
 import hashlib
+import inspect
 import itertools
 import logging
 import os
@@ -2447,7 +2448,11 @@ class CoreWorker:
                 )
             method = getattr(self._actor_instance, spec.method_name)
             result = method(*args, **kwargs)
-            if asyncio.iscoroutine(result):
+            if asyncio.iscoroutine(result) and \
+                    not inspect.isgenerator(result):
+                # isgenerator guard: on py<3.12 asyncio.iscoroutine also
+                # matches plain generators, and awaiting one TypeErrors
+                # instead of reaching the streaming dispatch below
                 result = await result
             if spec.streaming and hasattr(result, "__anext__"):
                 return await self._execute_streaming_async(spec, result)
@@ -2554,9 +2559,15 @@ class CoreWorker:
                     method = getattr(self._actor_instance,
                                      spec.method_name)
                     result = method(*args, **kwargs)
-                if asyncio.iscoroutine(result):
+                if asyncio.iscoroutine(result) and \
+                        not inspect.isgenerator(result):
                     # Sync path got a coroutine (async method, concurrency 1
                     # without dedicated loop): run it to completion here.
+                    # The isgenerator guard matters on py<3.12, where
+                    # asyncio.iscoroutine also matches plain generators
+                    # (legacy @asyncio.coroutine) — asyncio.run on a
+                    # streaming task's generator raises "Task got bad
+                    # yield" instead of streaming it.
                     result = asyncio.run(result)
             else:
                 raise RuntimeError(f"unknown task type {spec.task_type}")
@@ -2590,13 +2601,17 @@ class CoreWorker:
         the compile call loudly), then pump this actor's nodes in
         topological order on one daemon thread — fan-in reads one
         channel per argument, fan-out writes one channel per consumer.
-        Frames travel as ("ok", seq, value) / ("err", seq, message); an
+        Frames carry a raw (tag, seq, length) header + pickled payload
+        (zero-pickle plane, ray_tpu/experimental/channel.py); an
         upstream error flows through untouched so the driver sees the
-        original, and lagging inputs are re-read until their seqs agree
+        original, and lagging inputs are released from the header alone
+        — never deserialized — and re-read until their seqs agree
         (self-healing after a driver-side timeout)."""
         import pickle
 
-        from ray_tpu.experimental.channel import (ChannelClosedError,
+        from ray_tpu.experimental.channel import (TAG_ERR, TAG_OK,
+                                                  ChannelClosedError,
+                                                  FrameScratch,
                                                   ShmChannel)
 
         attached: Dict[str, ShmChannel] = {}
@@ -2613,47 +2628,62 @@ class CoreWorker:
                 [(pos, get_ch(n)) for pos, n in st["ins"]],
                 [get_ch(n) for n in st["outs"]],
                 getattr(self._actor_instance, st["method"]),
+                FrameScratch(),
             ))
 
-        def run_stage(st, ins, outs, method):
-            entries = {pos: pickle.loads(ch.read()) for pos, ch in ins}
+        def run_stage(st, ins, outs, method, scratch):
             chans = dict(ins)
+            # headers first: (tag, seq, payload_view) per input, slots
+            # still held — nothing deserialized yet
+            heads = {pos: ch.read_frame() for pos, ch in ins}
             while True:
-                mx = max(s for (_t, s, _v) in entries.values())
-                lagging = [p for p, (_t, s, _v) in entries.items()
+                mx = max(s for (_t, s, _v) in heads.values())
+                lagging = [p for p, (_t, s, _v) in heads.items()
                            if s < mx]
                 if not lagging:
                     break
                 for p in lagging:
-                    entries[p] = pickle.loads(chans[p].read())
-            err = next((v for (t, _s, v) in entries.values()
-                        if t == "err"), None)
+                    # stale frame: release straight from the header —
+                    # the payload is never unpickled just to be thrown
+                    # away
+                    heads[p] = None  # drop the payload view first
+                    chans[p].release_frame()
+                    heads[p] = chans[p].read_frame()
+            err = None
+            values = {}
+            for pos, (tag, _s, view) in heads.items():
+                if tag == TAG_ERR:
+                    if err is None:
+                        err = pickle.loads(view)
+                else:
+                    values[pos] = pickle.loads(view)
+                del view
+                heads[pos] = None
+                chans[pos].release_frame()
             if err is not None:
-                payload = pickle.dumps(("err", mx, err))
+                tag, view = TAG_ERR, scratch.pack(err)
             else:
                 fn_args = [None] * st["nargs"]
                 for pos, v in st["consts"]:
                     fn_args[pos] = v
-                for pos, (_t, _s, v) in entries.items():
+                for pos, v in values.items():
                     fn_args[pos] = v
                 try:
-                    payload = pickle.dumps(("ok", mx, method(*fn_args)))
+                    tag, view = TAG_OK, scratch.pack(method(*fn_args))
                 except Exception as e:  # noqa: BLE001 — to driver
-                    payload = pickle.dumps(
-                        ("err", mx,
-                         f"{st['method']} failed: "
-                         f"{traceback.format_exc()}\n{e!r}"))
+                    tag, view = TAG_ERR, scratch.pack(
+                        f"{st['method']} failed: "
+                        f"{traceback.format_exc()}\n{e!r}")
             for out in outs:
                 try:
-                    out.write(payload)
+                    out.write_frame(tag, mx, view)
                 except ValueError as e:
                     # oversize result: the pump must survive and the
                     # driver must see the cause (the tiny error frame
                     # always fits)
-                    out.write(pickle.dumps(
-                        ("err", mx,
-                         f"{st['method']} result does not fit the "
-                         f"channel: {e}")))
+                    out.write_frame(TAG_ERR, mx, pickle.dumps(
+                        f"{st['method']} result does not fit the "
+                        f"channel: {e}"))
 
         def loop():
             try:

@@ -18,10 +18,16 @@ seqlock shm channels (`ray_tpu/experimental/channel.py`): one channel
 per EDGE, so a fan-out producer writes each consumer's channel and a
 fan-in consumer reads one channel per argument.
 
-Every frame on a channel is ``(tag, seq, value)`` where ``seq`` is the
-driver's execution counter: after a timeout the driver simply bumps the
-counter and readers discard stale frames, so a slow execution can never
-desynchronize the pipeline into returning a previous result.
+Every frame on a channel carries a raw header ``(tag, seq, length)``
+followed by the pickled payload, where ``seq`` is the driver's
+execution counter: after a timeout the driver simply bumps the counter
+and readers discard stale frames — from the header alone, without
+deserializing the payload — so a slow execution can never
+desynchronize the pipeline into returning a previous result. Payloads
+are serialized once per value into a reusable per-edge scratch buffer
+and memcpy'd into each consumer edge (`FrameScratch`,
+ray_tpu/experimental/channel.py): the steady-state hot loop does no
+tuple pickling and no per-call allocation.
 """
 
 from __future__ import annotations
@@ -200,7 +206,7 @@ class CompiledDAG:
         at compile time (shm channels are same-node; the cross-node
         story is the jitted path where ICI moves arrays)."""
         from ray_tpu._private.worker_api import ActorMethod
-        from ray_tpu.experimental.channel import ShmChannel
+        from ray_tpu.experimental.channel import FrameScratch, ShmChannel
 
         order: List[DAGNode] = plan["order"]
         outputs: List[DAGNode] = plan["outputs"]
@@ -230,6 +236,7 @@ class CompiledDAG:
                 "outs": [],    # channel names
             }
         self._input_channels: List[Tuple[int, ShmChannel]] = []
+        self._input_scratch: Dict[int, FrameScratch] = {}
         for node in order:
             d = descs[id(node)]
             for pos, a in enumerate(node._args):
@@ -240,6 +247,7 @@ class CompiledDAG:
                 elif isinstance(a, InputNode):
                     name, ch = new_channel()
                     self._input_channels.append((a._index, ch))
+                    self._input_scratch.setdefault(a._index, FrameScratch())
                     d["ins"].append((pos, name))
                 else:
                     d["consts"].append((pos, a))
@@ -304,6 +312,15 @@ class CompiledDAG:
         return chain
 
     def execute(self, *root_args, timeout: Optional[float] = None):
+        """Run one execution of the compiled graph.
+
+        ``timeout`` bounds the channel path (driver write + output
+        read). On the fused-jit path it is IGNORED: the whole graph is
+        one synchronous XLA computation with no cancellation point, so
+        there is nothing to time out — the call returns when the device
+        finishes. The lazy fallback forwards the timeout to
+        ``ray_tpu.get``.
+        """
         if self._jitted is not None:
             return self._jitted(*root_args)
         if self._channels is not None:
@@ -316,29 +333,39 @@ class CompiledDAG:
         import pickle
         import time
 
+        from ray_tpu.experimental.channel import TAG_ERR, TAG_OK
+
         timeout = self._timeout if timeout is None else timeout
         self._seq += 1
         seq = self._seq
         deadline = time.monotonic() + timeout
-        frames: Dict[int, bytes] = {}
+        views: Dict[int, memoryview] = {}
         for idx, ch in self._input_channels:
-            # one pickle per distinct input index, not per consumer edge
-            frame = frames.get(idx)
-            if frame is None:
-                frame = frames[idx] = pickle.dumps(
-                    ("ok", seq, root_args[idx]))
-            ch.write(frame, timeout=max(0.0, deadline - time.monotonic()))
+            # one serialization per distinct input index, reused for
+            # every consumer edge (zero-copy memcpy per edge)
+            view = views.get(idx)
+            if view is None:
+                view = views[idx] = self._input_scratch[idx].pack(
+                    root_args[idx])
+            ch.write_frame(TAG_OK, seq, view,
+                           timeout=max(0.0, deadline - time.monotonic()))
         results = []
         for ch in self._output_channels:
             while True:
-                tag, s, value = pickle.loads(
-                    ch.read(timeout=max(0.0, deadline - time.monotonic())))
+                tag, s, payload = ch.read_frame(
+                    timeout=max(0.0, deadline - time.monotonic()))
                 if s == seq:
                     break
-                # stale frame from an execution the driver timed out on:
-                # discard — the seq tag is what keeps a slow pipeline
-                # from desynchronizing into returning old results
-            if tag == "err":
+                # stale frame from an execution the driver timed out
+                # on: release the slot straight from the header — the
+                # payload is never deserialized
+                ch.release_frame()
+            try:
+                value = pickle.loads(payload)
+            finally:
+                del payload
+                ch.release_frame()
+            if tag == TAG_ERR:
                 raise ray_tpu.RayTaskError(
                     f"compiled DAG stage failed:\n{value}")
             results.append(value)

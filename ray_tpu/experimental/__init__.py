@@ -1,6 +1,9 @@
 """Experimental APIs (reference: `python/ray/experimental/`)."""
 
 from ray_tpu.experimental.channel import (  # noqa: F401
+    TAG_ERR,
+    TAG_OK,
     ChannelClosedError,
+    FrameScratch,
     ShmChannel,
 )

@@ -9,6 +9,15 @@ natively on `jax.sharding.Mesh` + GSPMD + `shard_map`, with XLA collectives
 riding ICI inside a slice and DCN across slices.
 """
 
+from ray_tpu.parallel.compile_cache import (
+    ExecutableCache,
+    RetraceError,
+    cache_stats,
+    compiled_step,
+    fold_steps,
+    global_cache,
+    stack_batches,
+)
 from ray_tpu.parallel.mesh import (MeshConfig, build_hybrid_mesh,
                                    build_mesh, mesh_shape_for)
 from ray_tpu.parallel.sharding import (
@@ -19,12 +28,19 @@ from ray_tpu.parallel.sharding import (
 )
 
 __all__ = [
+    "ExecutableCache",
     "MeshConfig",
+    "RetraceError",
     "ShardingStrategy",
     "build_hybrid_mesh",
     "build_mesh",
+    "cache_stats",
+    "compiled_step",
+    "fold_steps",
+    "global_cache",
     "logical_axis_rules",
     "mesh_shape_for",
     "shard_batch",
     "sharding_constraint",
+    "stack_batches",
 ]

@@ -1,9 +1,11 @@
-"""`shard_map` import shim across the jax API move.
+"""`shard_map` / `axis_size` import shims across the jax API moves.
 
 jax exports `shard_map` at top level from ~0.6 with the `check_vma`
 kwarg; before that it lives in `jax.experimental.shard_map` and the same
-knob is spelled `check_rep`. All ray_tpu call sites use the new spelling
-and import from here.
+knob is spelled `check_rep`. Similarly `lax.axis_size` (static size of a
+mapped axis) only exists on newer jax; on 0.4.x the static size lives on
+`jax.core.axis_frame(name)`. All ray_tpu call sites use the new
+spellings and import from here.
 """
 
 from __future__ import annotations
@@ -22,4 +24,20 @@ except ImportError:  # pragma: no cover - exercised on jax 0.4.x boxes
         return _shard_map(*args, **kwargs)
 
 
-__all__ = ["shard_map"]
+def axis_size(axis_name) -> int:
+    """STATIC size of a mapped mesh axis, usable for Python control
+    flow (permutation lists, capacity math) inside shard_map bodies."""
+    from jax import lax
+
+    try:
+        return lax.axis_size(axis_name)
+    except AttributeError:  # pragma: no cover - jax 0.4.x
+        import jax.core
+
+        # 0.4.x returns a frame object or (under some tracers) the
+        # bare int size
+        frame = jax.core.axis_frame(axis_name)
+        return frame if isinstance(frame, int) else frame.size
+
+
+__all__ = ["axis_size", "shard_map"]

@@ -1,0 +1,258 @@
+"""AOT executable cache + multi-step dispatch folding.
+
+The driver hot path of a training loop is one `step(carry, batch)` call
+per step; under plain `jax.jit` every call pays Python dispatch plus the
+jit call-time cache probe, and any accidental re-construction of the jit
+(fresh closure per step) silently retraces. This module makes the
+steady-state cost of a step one executable invocation:
+
+``compiled_step``
+    Wraps a function with a process-wide AOT executable cache keyed by
+    (function identity, argument treedefs/avals, mesh): the first call
+    lowers and compiles once via ``jax.jit(...).lower(...).compile()``
+    (reference: the jax AOT API), every subsequent call with the same
+    abstract signature dispatches the cached executable directly. Hits,
+    misses, and retraces are counted (`cache_stats()` — surfaced by
+    bench.py's `dispatch_overhead` phase). A *retrace* is a miss for a
+    function that already has a cached executable (shape/dtype/treedef
+    drift): the guard warns by default and raises with
+    ``on_retrace="error"`` — the silent-retrace failure mode the
+    raylint ``jit-cache-stability`` check flags statically.
+
+``fold_steps``
+    The opt-in ``steps_per_call`` wrapper: folds K optimizer steps into
+    ONE dispatch with a ``lax.scan`` over prefetched on-device batches
+    (leading [K, ...] axis) and a donated carry, so XLA updates the
+    parameter buffers in place and the fixed per-dispatch overhead is
+    amortized K-fold. This is the Pathways-style dispatch-amortization
+    move: the driver submits one program per K steps instead of K.
+
+The single-controller analogy to the compiled-DAG channel plane
+(ray_tpu/dag.py) is deliberate: both turn per-step driver work into a
+constant-size doorbell on a pre-built execution plan.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+from jax import lax
+
+logger = logging.getLogger(__name__)
+
+
+class RetraceError(RuntimeError):
+    """A compiled_step function was called with a new abstract signature
+    while ``on_retrace="error"`` (shape/dtype/treedef drift would
+    silently recompile every step)."""
+
+
+@dataclass
+class CacheStats:
+    hits: int = 0
+    misses: int = 0
+    retraces: int = 0
+
+    def as_dict(self) -> Dict[str, int]:
+        return {"hits": self.hits, "misses": self.misses,
+                "retraces": self.retraces}
+
+
+def _leaf_key(leaf: Any):
+    """Abstract (aval) key for one pytree leaf: shape+dtype+sharding for
+    arrays, value identity for hashable Python scalars."""
+    shape = getattr(leaf, "shape", None)
+    dtype = getattr(leaf, "dtype", None)
+    if shape is not None and dtype is not None:
+        sharding = getattr(leaf, "sharding", None)
+        return ("aval", tuple(shape), str(dtype),
+                None if sharding is None else repr(sharding))
+    # non-array leaf (python int/float/bool/None): its VALUE is baked
+    # into the trace as a weak-typed constant, so it is part of the key
+    return ("const", type(leaf).__name__, repr(leaf))
+
+
+def _abstract_key(args: tuple, kwargs: dict):
+    leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+    return treedef, tuple(_leaf_key(leaf) for leaf in leaves)
+
+
+def _mesh_key(mesh) -> Optional[tuple]:
+    if mesh is None:
+        return None
+    shape = getattr(mesh, "shape", None)
+    if shape is not None:
+        return (tuple(sorted(dict(shape).items())),
+                tuple(str(d) for d in getattr(mesh, "devices", []) or []))
+    return (repr(mesh),)
+
+
+class ExecutableCache:
+    """Process-wide cache of AOT-compiled executables.
+
+    Key: (function identity, arg treedefs/avals, mesh, donate/static
+    config). Function identity is ``id(fn)`` paired with a strong
+    reference to ``fn`` held by the entry — an id can therefore never
+    be recycled into a false hit while its entry is alive.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries: Dict[tuple, Any] = {}
+        self._fn_signatures: Dict[tuple, set] = {}
+        self.stats = CacheStats()
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._fn_signatures.clear()
+            self.stats = CacheStats()
+
+    def size(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def lookup(self, fn: Callable, args: tuple, kwargs: dict, *,
+               donate_argnums: Tuple[int, ...] = (),
+               static_argnums: Tuple[int, ...] = (),
+               mesh=None, on_retrace: str = "warn"):
+        """Return the compiled executable for this abstract call
+        signature, lowering+compiling on first use."""
+        treedef, avals = _abstract_key(args, kwargs)
+        fn_key = (id(fn), getattr(fn, "__qualname__", None))
+        key = (fn_key, treedef, avals, _mesh_key(mesh),
+               tuple(donate_argnums), tuple(static_argnums))
+        sig = (treedef, avals)
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is not None:
+                self.stats.hits += 1
+                return entry[1]
+            self.stats.misses += 1
+            prior = self._fn_signatures.setdefault(fn_key, set())
+            retraced = bool(prior) and sig not in prior
+            if retraced:
+                self.stats.retraces += 1
+            prior.add(sig)
+        if retraced:
+            name = getattr(fn, "__name__", repr(fn))
+            msg = (f"compiled_step retrace: {name} called with a new "
+                   f"abstract signature (shape/dtype/structure changed) "
+                   f"— every such change compiles a fresh executable")
+            if on_retrace == "error":
+                raise RetraceError(msg)
+            logger.warning(msg)
+        compiled = jax.jit(
+            fn, donate_argnums=donate_argnums,
+            static_argnums=static_argnums,
+        ).lower(*args, **kwargs).compile()
+        with self._lock:
+            # keep fn alive alongside its executable (id-key safety)
+            self._entries[key] = (fn, compiled)
+        return compiled
+
+
+_GLOBAL_CACHE = ExecutableCache()
+
+
+def global_cache() -> ExecutableCache:
+    return _GLOBAL_CACHE
+
+
+def cache_stats() -> Dict[str, int]:
+    """Process-wide executable-cache counters (bench `dispatch_overhead`
+    reads these): hits / misses / retraces / entries."""
+    stats = _GLOBAL_CACHE.stats.as_dict()
+    stats["entries"] = _GLOBAL_CACHE.size()
+    return stats
+
+
+def compiled_step(fn: Optional[Callable] = None, *,
+                  donate_argnums: Tuple[int, ...] = (),
+                  static_argnums: Tuple[int, ...] = (),
+                  mesh=None, cache: Optional[ExecutableCache] = None,
+                  on_retrace: str = "warn") -> Callable:
+    """Decorator/wrapper: dispatch ``fn`` through the AOT executable
+    cache.
+
+    The first call with a given abstract signature lowers and compiles
+    once; later calls invoke the cached executable with no jit-layer
+    dispatch. ``donate_argnums`` marks carries (params/opt-state) whose
+    buffers XLA reuses in place. The wrapper exposes ``.cache`` and
+    ``.stats`` for tests and bench counters.
+    """
+    if fn is None:
+        return functools.partial(
+            compiled_step, donate_argnums=donate_argnums,
+            static_argnums=static_argnums, mesh=mesh, cache=cache,
+            on_retrace=on_retrace)
+    use_cache = cache if cache is not None else _GLOBAL_CACHE
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        compiled = use_cache.lookup(
+            fn, args, kwargs, donate_argnums=donate_argnums,
+            static_argnums=static_argnums, mesh=mesh,
+            on_retrace=on_retrace)
+        return compiled(*args, **kwargs)
+
+    wrapper.cache = use_cache
+    wrapper.stats = use_cache.stats
+    wrapper.__wrapped__ = fn
+    return wrapper
+
+
+def fold_steps(step_fn: Callable, steps_per_call: int, *,
+               donate_carry: bool = True,
+               mesh=None, cache: Optional[ExecutableCache] = None,
+               on_retrace: str = "warn") -> Callable:
+    """Fold K optimizer steps into one dispatch (opt-in
+    ``steps_per_call``).
+
+    ``step_fn(carry, batch) -> (carry, aux)`` becomes
+    ``multi(carry, batches) -> (carry, auxes)`` where ``batches`` holds
+    K prefetched on-device batches stacked on a leading axis and
+    ``auxes`` stacks each step's aux ([K, ...]). The K-step body is one
+    ``lax.scan`` inside one cached executable with the carry donated —
+    driver cost per K steps is a single dispatch. The staged body is
+    subject to raylint's ``jit-purity`` gate: host side effects inside
+    ``step_fn`` are baked in at trace time, not executed per step.
+    """
+    if steps_per_call < 1:
+        raise ValueError(f"steps_per_call must be >= 1, "
+                         f"got {steps_per_call}")
+
+    def multi_step(carry, batches):
+        return lax.scan(step_fn, carry, batches,
+                        length=steps_per_call)
+
+    multi_step.__name__ = (
+        f"fold_steps({getattr(step_fn, '__name__', 'step')}"
+        f"x{steps_per_call})")
+    multi_step.__qualname__ = multi_step.__name__
+    wrapper = compiled_step(
+        multi_step, donate_argnums=(0,) if donate_carry else (),
+        mesh=mesh, cache=cache, on_retrace=on_retrace)
+    wrapper.steps_per_call = steps_per_call
+    return wrapper
+
+
+def stack_batches(batches, device=None):
+    """Stack an iterable of K same-shape batch pytrees into one
+    [K, ...] pytree placed on device — the prefetched input block a
+    `fold_steps` wrapper consumes."""
+    import jax.numpy as jnp
+
+    batches = list(batches)
+    if not batches:
+        raise ValueError("stack_batches needs at least one batch")
+    stacked = jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves), *batches)
+    if device is not None:
+        stacked = jax.device_put(stacked, device)
+    return stacked

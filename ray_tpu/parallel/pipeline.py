@@ -79,7 +79,12 @@ def _schedule(stage_fn: Callable, n_stages: int, num_microbatches: int,
         if loss_fn is None:
             acc = jnp.zeros_like(mb)  # retired outputs
         else:
-            acc = jnp.zeros((), jnp.float32)  # running loss sum
+            # running loss sum. Shape (1,), NOT a scalar: jax 0.4.x's
+            # shard_map transpose rejects rank-0 scan carries with a
+            # _SpecError (the backward's spec check sees float32[] as
+            # unassignable), which broke jax.grad through the fused
+            # loss; a length-1 vector transposes cleanly.
+            acc = jnp.zeros((1,), jnp.float32)
 
         def step(carry, t):
             state, acc = carry
@@ -109,7 +114,7 @@ def _schedule(stage_fn: Callable, n_stages: int, num_microbatches: int,
             return acc.reshape(1, *xb.shape)
         # scalar: everyone learns the last stage's loss sum — a scalar
         # psum is the entire cross-stage cost of the fused path
-        return lax.psum(acc, axis_name) / num_microbatches
+        return lax.psum(acc, axis_name)[0] / num_microbatches
 
     return local
 

@@ -27,6 +27,7 @@ from ray_tpu.train.trainer import (
     BaseTrainer,
     DataParallelTrainer,
     JaxTrainer,
+    TrainStepRunner,
 )
 
 __all__ = [
@@ -45,6 +46,7 @@ __all__ = [
     "RunConfig",
     "ScalingConfig",
     "TrainContext",
+    "TrainStepRunner",
     "get_checkpoint",
     "get_context",
     "get_dataset_shard",

@@ -1,0 +1,206 @@
+"""AOT executable cache + steps_per_call folding (ROADMAP r5 #3).
+
+Covers the dispatch plane behind sub-2 ms driver overhead: hit/miss
+counters, donation actually taking effect (the donated carry's buffer is
+consumed), the retrace guard firing on an abstract-signature change, and
+loss-trajectory equivalence of one folded K-step dispatch vs K single
+steps.
+"""
+
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.parallel.compile_cache import (
+    ExecutableCache,
+    RetraceError,
+    cache_stats,
+    compiled_step,
+    fold_steps,
+    global_cache,
+    stack_batches,
+)
+
+
+def _sgd_step(w, batch):
+    x, y = batch
+    def loss_fn(w):
+        return jnp.mean((x @ w - y) ** 2)
+    loss, g = jax.value_and_grad(loss_fn)(w)
+    return w - 0.1 * g, loss
+
+
+def _make_data(seed, n=32, d=4):
+    rng = np.random.RandomState(seed)
+    x = jnp.asarray(rng.randn(n, d), jnp.float32)
+    true_w = jnp.asarray(rng.randn(d), jnp.float32)
+    return x, x @ true_w
+
+
+def test_hit_miss_counters_and_entries():
+    cache = ExecutableCache()
+    step = compiled_step(_sgd_step, donate_argnums=(0,), cache=cache)
+    w = jnp.zeros(4)
+    batch = _make_data(0)
+    w, _ = step(w, batch)
+    assert cache.stats.as_dict() == {"hits": 0, "misses": 1,
+                                     "retraces": 0}
+    assert cache.size() == 1
+    for _ in range(3):
+        w, _ = step(w, batch)
+    assert cache.stats.hits == 3
+    assert cache.stats.misses == 1
+    assert cache.size() == 1  # one executable serves every step
+
+
+def test_donation_buffer_consumed():
+    """donate_argnums must reach the AOT executable: the donated carry
+    is consumed by the call (its buffer was reused for the output)."""
+    cache = ExecutableCache()
+    step = compiled_step(_sgd_step, donate_argnums=(0,), cache=cache)
+    batch = _make_data(1)
+    w0 = jnp.zeros(4)
+    w1, _ = step(w0, batch)  # compile + run
+    assert w0.is_deleted(), "donated carry should be consumed"
+    w2, _ = step(w1, batch)  # cached-executable path donates too
+    assert w1.is_deleted()
+    assert not w2.is_deleted()
+    # and without donation the input survives
+    cache2 = ExecutableCache()
+    step_nd = compiled_step(_sgd_step, cache=cache2)
+    w3 = jnp.zeros(4)
+    step_nd(w3, batch)
+    assert not w3.is_deleted()
+
+
+def test_retrace_guard_fires_on_shape_change():
+    cache = ExecutableCache()
+    step = compiled_step(_sgd_step, donate_argnums=(0,), cache=cache)
+    step(jnp.zeros(4), _make_data(0, d=4))
+    assert cache.stats.retraces == 0
+    # same function, new aval signature: miss + retrace recorded
+    step(jnp.zeros(8), _make_data(0, d=8))
+    assert cache.stats.retraces == 1
+    assert cache.stats.misses == 2
+    # strict mode raises instead of silently compiling a third variant
+    strict = compiled_step(_sgd_step, donate_argnums=(0,), cache=cache,
+                           on_retrace="error")
+    with pytest.raises(RetraceError, match="new abstract signature"):
+        strict(jnp.zeros(16), _make_data(0, d=16))
+
+
+def test_dtype_change_is_a_retrace():
+    cache = ExecutableCache()
+    f = compiled_step(lambda x: x * 2, cache=cache)
+    f(jnp.zeros(4, jnp.float32))
+    f(jnp.zeros(4, jnp.int32))
+    assert cache.stats.retraces == 1
+
+
+def test_fold_steps_matches_k_single_steps():
+    """One steps_per_call=K dispatch must walk the same loss trajectory
+    as K single-step dispatches."""
+    k = 4
+    x, y = _make_data(2)
+    batches = [( x[i * 8:(i + 1) * 8], y[i * 8:(i + 1) * 8])
+               for i in range(k)]
+
+    w_ref = jnp.zeros(4)
+    ref_losses = []
+    for b in batches:
+        w_ref, loss = _sgd_step(w_ref, b)
+        ref_losses.append(float(loss))
+
+    cache = ExecutableCache()
+    multi = fold_steps(_sgd_step, k, cache=cache)
+    assert multi.steps_per_call == k
+    w_fold, losses = multi(jnp.zeros(4), stack_batches(batches))
+    assert losses.shape == (k,)
+    np.testing.assert_allclose(np.asarray(losses), ref_losses,
+                               rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(w_fold), np.asarray(w_ref),
+                               rtol=1e-5)
+    # the folded program is ONE cached executable: driver cost for the
+    # next K steps is a single hit
+    w2, _ = multi(w_fold, stack_batches(batches))
+    assert cache.stats.as_dict() == {"hits": 1, "misses": 1,
+                                     "retraces": 0}
+
+
+def test_fold_steps_donates_carry():
+    k = 2
+    x, y = _make_data(3)
+    batches = stack_batches([(x, y)] * k)
+    cache = ExecutableCache()
+    multi = fold_steps(_sgd_step, k, cache=cache)
+    w0 = jnp.zeros(4)
+    multi(w0, batches)
+    assert w0.is_deleted(), "folded carry should be donated"
+
+
+def test_train_step_runner_equivalence_and_stats():
+    from ray_tpu.train import TrainStepRunner
+
+    k = 3
+    x, y = _make_data(4)
+    batches = [(x, y)] * (2 * k)
+
+    w_ref = jnp.zeros(4)
+    ref_losses = []
+    for b in batches:
+        w_ref, loss = _sgd_step(w_ref, b)
+        ref_losses.append(float(loss))
+
+    runner = TrainStepRunner(_sgd_step, steps_per_call=k)
+    w = jnp.zeros(4)
+    it = iter(batches)
+    got = []
+    for _ in range(2):
+        w, losses = runner.run(w, it)
+        got.extend(float(v) for v in losses)
+    np.testing.assert_allclose(got, ref_losses, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(w), np.asarray(w_ref),
+                               rtol=1e-5)
+    stats = runner.cache_stats()
+    assert stats["misses"] == 1 and stats["hits"] == 1
+
+    # steps_per_call=1 path: plain per-batch stepping, same trajectory
+    runner1 = TrainStepRunner(_sgd_step)
+    w1 = jnp.zeros(4)
+    for b in batches:
+        w1, _ = runner1.run(w1, b)
+    np.testing.assert_allclose(np.asarray(w1), np.asarray(w_ref),
+                               rtol=1e-5)
+
+
+def test_global_cache_stats_shape():
+    before = cache_stats()
+    assert set(before) == {"hits", "misses", "retraces", "entries"}
+
+    @compiled_step
+    def bump(x):
+        return x + 1
+
+    bump(jnp.zeros(2))
+    bump(jnp.zeros(2))
+    after = cache_stats()
+    assert after["misses"] >= before["misses"] + 1
+    assert after["hits"] >= before["hits"] + 1
+    global_cache().clear()
+    cleared = cache_stats()
+    assert cleared["entries"] == 0
+
+
+def test_python_scalar_is_part_of_the_key():
+    """Non-array leaves are baked into the trace; a changed scalar must
+    be a different executable, not a stale cache hit."""
+    cache = ExecutableCache()
+    f = compiled_step(lambda x, s: x * s, cache=cache)
+    a = f(jnp.ones(2), 2.0)
+    b = f(jnp.ones(2), 3.0)
+    np.testing.assert_allclose(np.asarray(a), [2.0, 2.0])
+    np.testing.assert_allclose(np.asarray(b), [3.0, 3.0])
+    assert cache.size() == 2

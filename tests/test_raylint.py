@@ -386,6 +386,144 @@ class TestSeededRng:
 
 
 # ---------------------------------------------------------------------------
+# checker 5: jit-cache-stability
+# ---------------------------------------------------------------------------
+
+class TestJitCacheStability:
+    def test_jit_in_for_loop_flagged(self):
+        findings = run("""
+            import jax
+
+            def train(batches):
+                for b in batches:
+                    f = jax.jit(lambda x: x + 1)
+                    f(b)
+        """)
+        assert any(f.check == "jit-cache-stability"
+                   and f.detail == "in-loop:jit"
+                   and f.scope == "train" for f in findings), findings
+
+    def test_shard_map_in_while_loop_flagged(self):
+        findings = run("""
+            from jax.experimental.shard_map import shard_map
+
+            def pump(mesh, spec):
+                while True:
+                    fn = shard_map(body, mesh=mesh, in_specs=spec,
+                                   out_specs=spec)
+                    fn(0)
+        """)
+        assert any(f.check == "jit-cache-stability"
+                   and f.detail == "in-loop:shard_map"
+                   for f in findings), findings
+
+    def test_construct_and_call_flagged(self):
+        findings = run("""
+            import jax
+
+            def once(x):
+                return jax.jit(lambda v: v * 2)(x)
+        """)
+        assert any(f.check == "jit-cache-stability"
+                   and f.detail == "construct-and-call:jit"
+                   for f in findings), findings
+
+    def test_fresh_closure_inside_loop_flagged(self):
+        findings = run("""
+            import jax
+
+            def build(stages):
+                fns = []
+                for s in stages:
+                    def stage_fn(x, s=s):
+                        return jax.jit(lambda v: v + s)(x)
+                    fns.append(stage_fn)
+                return fns
+        """)
+        assert any(f.check == "jit-cache-stability"
+                   for f in findings), findings
+
+    def test_hoisted_jit_called_in_loop_ok(self):
+        findings = run("""
+            import jax
+
+            def train(batches):
+                f = jax.jit(lambda x: x + 1)
+                for b in batches:
+                    f(b)
+        """)
+        assert "jit-cache-stability" not in checks_of(findings)
+
+    def test_compiled_step_is_the_sanctioned_form(self):
+        findings = run("""
+            from ray_tpu.parallel import compiled_step
+
+            @compiled_step(donate_argnums=(0,))
+            def step(w, b):
+                return w + b, None
+
+            def train(w, batches):
+                for b in batches:
+                    w, _ = step(w, b)
+                return w
+        """)
+        assert "jit-cache-stability" not in checks_of(findings)
+
+    def test_inline_suppression_applies(self):
+        findings = run("""
+            import jax
+
+            def train(batches):
+                for b in batches:
+                    f = jax.jit(lambda x: x + 1)  # raylint: disable=jit-cache-stability
+                    f(b)
+        """)
+        assert "jit-cache-stability" not in checks_of(findings)
+
+
+# ---------------------------------------------------------------------------
+# jit-purity over the AOT-cache stagers (compiled_step / fold_steps)
+# ---------------------------------------------------------------------------
+
+class TestJitPurityOverCompiledStep:
+    def test_print_in_compiled_step_flagged(self):
+        findings = run("""
+            from ray_tpu.parallel import compiled_step
+
+            @compiled_step(donate_argnums=(0,))
+            def step(w, b):
+                print(w)
+                return w + b, None
+        """)
+        assert any(f.check == "jit-purity" and f.detail == "print"
+                   and f.scope == "step" for f in findings), findings
+
+    def test_sleep_in_fold_steps_body_flagged(self):
+        findings = run("""
+            import time
+            from ray_tpu.parallel import fold_steps
+
+            def make(step_count):
+                def body(c, b):
+                    time.sleep(0.1)
+                    return c, b
+                return fold_steps(body, step_count)
+        """)
+        assert any(f.check == "jit-purity" and f.detail == "time.sleep"
+                   for f in findings), findings
+
+    def test_pure_compiled_step_silent(self):
+        findings = run("""
+            from ray_tpu.parallel import compiled_step
+
+            @compiled_step(donate_argnums=(0,))
+            def step(w, b):
+                return w + b, None
+        """)
+        assert "jit-purity" not in checks_of(findings)
+
+
+# ---------------------------------------------------------------------------
 # suppression + baseline
 # ---------------------------------------------------------------------------
 

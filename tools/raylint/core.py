@@ -42,6 +42,17 @@ analysis gates"):
     seeded ``random.Random(...)`` stream is the sanctioned form and is
     not flagged.
 
+``jit-cache-stability``
+    Flags jit-compiled callables constructed where they cannot be
+    cached: ``jax.jit`` / ``pjit`` / ``shard_map`` construction inside a
+    ``for``/``while`` loop body (a fresh wrapper per iteration discards
+    the compilation cache — every step silently retraces), and the
+    construct-and-call form ``jax.jit(f)(x)`` which builds and throws
+    away the wrapper in one expression. The sanctioned forms are
+    hoisting the jit out of the loop or routing the step through the
+    AOT executable cache (``ray_tpu.parallel.compiled_step`` /
+    ``fold_steps``).
+
 Suppression: append ``# raylint: disable=<check>`` (or ``disable=all``)
 to the flagged line, or put it on a comment line directly above.
 """
@@ -55,7 +66,7 @@ import re
 from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
 CHECKS = ("lock-discipline", "blocking-under-lock", "jit-purity",
-          "seeded-rng")
+          "seeded-rng", "jit-cache-stability")
 
 _LOCKISH_NAME = re.compile(r"lock|mutex|cond", re.IGNORECASE)
 _LOCK_FACTORIES = {
@@ -691,11 +702,16 @@ def check_blocking_under_lock(ctx: ModuleContext) -> List[Finding]:
 # checker 3: jit-purity
 # ---------------------------------------------------------------------------
 
-_JIT_ENTRY = {"jit", "pjit", "shard_map", "scan", "while_loop"}
+_JIT_ENTRY = {"jit", "pjit", "shard_map", "scan", "while_loop",
+              "compiled_step", "fold_steps"}
 
 
 def _jit_entry_name(name: Optional[str]) -> Optional[str]:
-    """'jax.jit' / 'jit' / 'lax.scan' / 'shard_map' → canonical entry."""
+    """'jax.jit' / 'jit' / 'lax.scan' / 'shard_map' / 'compiled_step'
+    → canonical entry. `compiled_step`/`fold_steps` are the AOT
+    executable-cache stagers (ray_tpu.parallel.compile_cache): their
+    bodies are staged exactly like a jit's, so jit-purity gates them
+    too."""
     if not name:
         return None
     last = name.split(".")[-1]
@@ -899,6 +915,82 @@ def check_seeded_rng(ctx: ModuleContext) -> List[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# checker 5: jit-cache-stability
+# ---------------------------------------------------------------------------
+
+_JIT_CONSTRUCTORS = {"jit", "pjit", "shard_map"}
+
+
+def _jit_ctor_name(name: Optional[str]) -> Optional[str]:
+    """jit-wrapper CONSTRUCTION sites only (not scan/while_loop, which
+    execute rather than build a cached callable)."""
+    if not name:
+        return None
+    last = name.split(".")[-1]
+    return last if last in _JIT_CONSTRUCTORS else None
+
+
+def check_jit_cache_stability(ctx: ModuleContext) -> List[Finding]:
+    """Flag jit wrappers constructed where their compilation cache is
+    discarded: inside a loop body (fresh wrapper per iteration — every
+    step silently retraces) or constructed-and-called in one expression
+    (``jax.jit(f)(x)``). Hoist the construction, or use the AOT
+    executable cache (ray_tpu.parallel.compiled_step / fold_steps)."""
+    findings: List[Finding] = []
+    flagged: Set[int] = set()
+
+    def flag(call: ast.Call, scope: str, entry: str, why: str,
+             detail: str) -> None:
+        if id(call) in flagged:
+            return
+        flagged.add(id(call))
+        findings.append(Finding(
+            ctx.relpath, "jit-cache-stability", scope,
+            f"{detail}:{entry}", call.lineno, why))
+
+    def visit(node: ast.AST, scope: str, classname: Optional[str],
+              in_loop: bool) -> None:
+        for child in ast.iter_child_nodes(node):
+            c_scope, c_class, c_loop = scope, classname, in_loop
+            if isinstance(child, ast.ClassDef):
+                c_class = child.name
+            elif isinstance(child,
+                            (ast.FunctionDef, ast.AsyncFunctionDef)):
+                c_scope = (f"{c_class}.{child.name}" if c_class
+                           else child.name)
+                # in_loop propagates INTO a def inside a loop: that def
+                # is a fresh closure per iteration, so a jit built in
+                # its body is rebuilt per step too
+            elif isinstance(child, (ast.For, ast.While, ast.AsyncFor)):
+                c_loop = True
+            if isinstance(child, ast.Call):
+                inner = child.func
+                if isinstance(inner, ast.Call):
+                    entry = _jit_ctor_name(dotted(inner.func))
+                    if entry:
+                        flag(inner, c_scope, entry,
+                             f"`{entry}(...)(...)` constructs and "
+                             f"discards the jitted callable in one "
+                             f"expression — every call retraces; bind "
+                             f"the wrapper once (or use "
+                             f"ray_tpu.parallel.compiled_step)",
+                             "construct-and-call")
+                entry = _jit_ctor_name(dotted(child.func))
+                if entry and c_loop and id(child) not in flagged:
+                    flag(child, c_scope, entry,
+                         f"`{entry}` constructed inside a loop builds a "
+                         f"fresh wrapper per iteration — the compilation "
+                         f"cache is discarded and every step silently "
+                         f"retraces; hoist it out of the loop (or use "
+                         f"ray_tpu.parallel.compiled_step / fold_steps)",
+                         "in-loop")
+            visit(child, c_scope, c_class, c_loop)
+
+    visit(ctx.tree, "<module>", None, False)
+    return findings
+
+
+# ---------------------------------------------------------------------------
 # driver
 # ---------------------------------------------------------------------------
 
@@ -907,6 +999,7 @@ _CHECKERS = {
     "blocking-under-lock": check_blocking_under_lock,
     "jit-purity": check_jit_purity,
     "seeded-rng": check_seeded_rng,
+    "jit-cache-stability": check_jit_cache_stability,
 }
 
 
