@@ -566,6 +566,92 @@ def bench_dispatch_overhead(pipeline_bubble: dict | None = None):
     return out
 
 
+def bench_observability_overhead():
+    """Cost ceiling of the flight-recorder plane (ISSUE 5): the step
+    profiler is ALWAYS ON, so its price on the sub-2 ms dispatch path
+    PR 4 bought must stay under 1%. Times the same cached-executable
+    dispatch loop with the recorder disabled and enabled (palindromic
+    interleave, medians — slow drift on shared hosts cancels), reports
+    the delta, and emits `observability_dispatch_per_s` value-style so
+    the >15% REGRESSION self-comparison gates the *absolute* dispatch
+    rate with the recorder on. Also measures the raw record_step cost
+    and proves the ring stays bounded under sustained stepping."""
+    import statistics
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_tpu.parallel.compile_cache import (ExecutableCache,
+                                                compiled_step)
+    from ray_tpu.util import step_profiler as sp
+
+    cache = ExecutableCache()
+    w = jnp.asarray(np.random.RandomState(0).randn(192, 192),
+                    jnp.float32)
+
+    def step(x):
+        for _ in range(8):
+            x = jnp.tanh(x @ w)
+        return x
+
+    tick = compiled_step(step, cache=cache)
+    x = jnp.ones((192, 192), jnp.float32)
+    x = tick(x)  # compile
+    x.block_until_ready()
+
+    def per_call_us() -> float:
+        nonlocal x
+        n, start = 0, time.perf_counter()
+        while time.perf_counter() - start < 0.35:
+            x = tick(x)
+            n += 1
+        x.block_until_ready()
+        return 1e6 * (time.perf_counter() - start) / n
+
+    was_enabled = sp.enabled()
+    dis, en = [], []
+    try:
+        per_call_us()  # warm both code paths before measuring
+        # strict alternation, min-of-passes: min is robust against the
+        # scheduler-noise spikes a shared/1-core box injects (the
+        # recorder's cost is deterministic; the noise is one-sided)
+        for on in (False, True) * 6:
+            sp.set_enabled(on)
+            (en if on else dis).append(per_call_us())
+    finally:
+        sp.set_enabled(was_enabled)
+    dis_us = min(dis)
+    en_us = min(en)
+    overhead_pct = 100.0 * (en_us - dis_us) / dis_us
+
+    # raw recorder costs, in isolation
+    t0 = time.perf_counter()
+    reps = 20000
+    for i in range(reps):
+        sp.record_step(i, 1.0, host_dispatch_ms=0.5, tokens=1)
+    record_us = 1e6 * (time.perf_counter() - t0) / reps
+    ring_len_after = len(sp.ring().recent())
+    bounded = ring_len_after <= sp.ring().capacity
+
+    detail = {
+        "dispatch_us_recorder_off": round(dis_us, 2),
+        "dispatch_us_recorder_on": round(en_us, 2),
+        "overhead_pct": round(overhead_pct, 2),
+        "meets_1pct_target": overhead_pct < 1.0,
+        "record_step_us": round(record_us, 3),
+        "dispatch_sample_interval": sp.dispatch_stats()[
+            "sample_interval"],
+        "ring_capacity": sp.ring().capacity,
+        "ring_bounded_after_sustained_stepping": bounded,
+    }
+    return {
+        "observability_overhead": detail,
+        # value-keyed: the >15% REGRESSION gate compares this rate like
+        # every other suite metric
+        "observability_dispatch_per_s": 1e6 / en_us,
+    }
+
+
 def bench_scale_envelope():
     """Scale-envelope rows (reference `release/benchmarks/README.md`:
     2k+ nodes / 40k+ actors / 10k+ simultaneous tasks / 1k+ PGs across
@@ -962,6 +1048,19 @@ def main():
             suite["dispatch_overhead_error"] = repr(e)[:300]
     else:
         suite["dispatch_overhead"] = {"skipped": "budget"}
+
+    # the flight recorder's cost ceiling rides with the dispatch plane:
+    # cheap to measure, gates the always-on recorder at <1%
+    if remaining() > 45 or not on_tpu:
+        try:
+            oo = bench_observability_overhead()
+            for k, v in oo.items():
+                suite[k] = v if isinstance(v, dict) else {
+                    "value": round(v, 2), "vs_baseline": None}
+        except Exception as e:  # noqa: BLE001
+            suite["observability_overhead_error"] = repr(e)[:300]
+    else:
+        suite["observability_overhead"] = {"skipped": "budget"}
 
     # off-TPU the control-plane phase IS the headline — never gate it
     if remaining() > 120 or not on_tpu:

@@ -643,6 +643,14 @@ class CoreWorker:
     # ------------------------------------------------------------------
 
     def put(self, value: Any) -> ObjectRef:
+        if not tracing.enabled():  # contextmanager costs ~2us/call
+            return self._put_impl(value)
+        with tracing.span("object.put", kind="producer") as s:
+            ref = self._put_impl(value)
+            s["attrs"]["object_id"] = ref.hex()[:16]
+            return ref
+
+    def _put_impl(self, value: Any) -> ObjectRef:
         oid = ObjectID.for_put(self.current_task_id, next(self._put_counter))
         # one-copy put: the serialized value holds only VIEWS (pickle
         # stream + out-of-band buffers); the payload is copied exactly
@@ -770,6 +778,18 @@ class CoreWorker:
     _FAST_MISS = object()
 
     def get(self, refs, timeout: float | None = None):
+        if not tracing.enabled():
+            return self._get_impl(refs, timeout)
+        if isinstance(refs, ObjectRef):
+            n = 1
+        else:
+            refs = list(refs)  # materialize: span must not eat the iter
+            n = len(refs)
+        with tracing.span("object.get", kind="consumer",
+                          attrs={"num_refs": n}):
+            return self._get_impl(refs, timeout)
+
+    def _get_impl(self, refs, timeout: float | None = None):
         single = isinstance(refs, ObjectRef)
         ref_list = [refs] if single else list(refs)
         deadline = None if timeout is None else time.monotonic() + timeout
@@ -2612,7 +2632,8 @@ class CoreWorker:
         from ray_tpu.experimental.channel import (TAG_ERR, TAG_OK,
                                                   ChannelClosedError,
                                                   FrameScratch,
-                                                  ShmChannel)
+                                                  ShmChannel,
+                                                  note_stale_skip)
 
         attached: Dict[str, ShmChannel] = {}
 
@@ -2648,7 +2669,21 @@ class CoreWorker:
                     # away
                     heads[p] = None  # drop the payload view first
                     chans[p].release_frame()
+                    note_stale_skip()
                     heads[p] = chans[p].read_frame()
+            traced = tracing.enabled()
+            if traced:
+                # consumer half of each input hop's arrow: the frame
+                # header carries no trace ctx, so the producer span
+                # (driver/upstream stage) and this span share
+                # flow_id=<channel>:<seq> and the unified timeline
+                # stitches the cross-process arrow at merge time
+                for _pos, ch in ins:
+                    with tracing.span(
+                            "channel.read", kind="consumer",
+                            attrs={"channel": ch._name, "seq": mx,
+                                   "flow_id": f"{ch._name}:{mx}"}):
+                        pass
             err = None
             values = {}
             for pos, (tag, _s, view) in heads.items():
@@ -2669,14 +2704,27 @@ class CoreWorker:
                 for pos, v in values.items():
                     fn_args[pos] = v
                 try:
-                    tag, view = TAG_OK, scratch.pack(method(*fn_args))
+                    if traced:
+                        with tracing.span(f"stage.{st['method']}",
+                                          attrs={"seq": mx}):
+                            result = method(*fn_args)
+                    else:
+                        result = method(*fn_args)
+                    tag, view = TAG_OK, scratch.pack(result)
                 except Exception as e:  # noqa: BLE001 — to driver
                     tag, view = TAG_ERR, scratch.pack(
                         f"{st['method']} failed: "
                         f"{traceback.format_exc()}\n{e!r}")
             for out in outs:
                 try:
-                    out.write_frame(tag, mx, view)
+                    if traced:
+                        with tracing.span(
+                                "channel.write", kind="producer",
+                                attrs={"channel": out._name, "seq": mx,
+                                       "flow_id": f"{out._name}:{mx}"}):
+                            out.write_frame(tag, mx, view)
+                    else:
+                        out.write_frame(tag, mx, view)
                 except ValueError as e:
                     # oversize result: the pump must survive and the
                     # driver must see the cause (the tiny error frame

@@ -233,16 +233,24 @@ class GcsServer:
         states: Dict[str, int] = {}
         for a in self.actors.values():
             states[a["state"]] = states.get(a["state"], 0) + 1
+        from ray_tpu._private import scheduling as scheduling_mod
+
         lines = [
             "# TYPE gcs_nodes_alive gauge",
             f"gcs_nodes_alive "
             f"{sum(1 for n in self.nodes.values() if n['alive'])}",
             f"gcs_placement_groups_pending {len(self._pending_pgs)}",
+            # scheduler queue depth at the GCS: actors waiting for a
+            # feasible node + pending PGs (flight-recorder plane)
+            "# TYPE scheduler_queue_depth gauge",
+            f"scheduler_queue_depth "
+            f"{len(self._pending_actors) + len(self._pending_pgs)}",
+            f"gcs_actors_pending {len(self._pending_actors)}",
             f"gcs_task_events {len(self.task_events)}",
         ]
         for state, count in states.items():
             lines.append(f'gcs_actors{{state="{state}"}} {count}')
-        return "\n".join(lines) + "\n"
+        return "\n".join(lines) + "\n" + scheduling_mod.metrics_text()
 
     async def start(self, metrics_port: int | None = None):
         self.server.register_all(self)

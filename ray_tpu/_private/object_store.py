@@ -313,6 +313,41 @@ class ObjectStore:
             "evicted_objects": evd.value,
         }
 
+    def metrics_text(self) -> str:
+        """Prometheus exposition of store + per-shard contention stats,
+        computed at scrape time (daemon `/metrics` extra_text — the
+        flight-recorder view of the sharded shm plane)."""
+        st = self.stats()
+        lines = [
+            "# TYPE object_store_lock_wait_ns_total counter",
+            f"object_store_lock_wait_ns_total {st['lock_wait_ns']}",
+            "# TYPE object_store_lock_contended_total counter",
+            f"object_store_lock_contended_total {st['lock_contended']}",
+            "# TYPE object_store_evicted_objects_total counter",
+            f"object_store_evicted_objects_total {st['evicted_objects']}",
+            "# TYPE object_store_referenced_bytes gauge",
+            f"object_store_referenced_bytes {st['referenced']}",
+            "# TYPE object_store_shards gauge",
+            f"object_store_shards {self.num_shards}",
+        ]
+        shard_rows = self.shard_stats()
+        if shard_rows:
+            lines.append("# TYPE object_store_shard_lock_wait_ns gauge")
+            for i, row in enumerate(shard_rows):
+                lines.append(
+                    f'object_store_shard_lock_wait_ns{{shard="{i}"}} '
+                    f"{row['lock_wait_ns']}")
+                lines.append(
+                    f'object_store_shard_contended{{shard="{i}"}} '
+                    f"{row['lock_contended']}")
+                lines.append(
+                    f'object_store_shard_evicted{{shard="{i}"}} '
+                    f"{row['evicted_objects']}")
+                lines.append(
+                    f'object_store_shard_objects{{shard="{i}"}} '
+                    f"{row['num_objects']}")
+        return "\n".join(lines) + "\n"
+
     def shard_stats(self) -> list:
         """Per-shard contention/eviction rows (index stripe + its
         allocator region), for bench auditing and hot-shard triage."""

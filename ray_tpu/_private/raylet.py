@@ -187,10 +187,16 @@ class Raylet:
     # ------------------------------------------------------------------
 
     def _metrics_text(self) -> str:
+        from ray_tpu._private import scheduling as scheduling_mod
+
         stats = self.store.stats()
         lines = [
             "# TYPE raylet_pending_leases gauge",
             f"raylet_pending_leases {len(self._pending)}",
+            # alias under the cross-daemon name the flight-recorder
+            # dashboards key on (same value as raylet_pending_leases)
+            "# TYPE scheduler_queue_depth gauge",
+            f"scheduler_queue_depth {len(self._pending)}",
             f"raylet_workers {len(self._workers)}",
             f"raylet_pinned_objects {len(self._pinned)}",
             f"raylet_spilled_objects {len(self._spilled)}",
@@ -201,7 +207,11 @@ class Raylet:
         for k, v in self.available.items():
             lines.append(
                 f'raylet_resource_available{{resource="{k}"}} {v}')
-        return "\n".join(lines) + "\n"
+        # sharded-store contention + per-shard rows, and the scheduling
+        # decision counters — computed at scrape time
+        return ("\n".join(lines) + "\n"
+                + self.store.metrics_text()
+                + scheduling_mod.metrics_text())
 
     async def start(self, metrics_port: int | None = None):
         self.server.register_all(self)

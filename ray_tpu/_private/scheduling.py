@@ -24,6 +24,36 @@ from ray_tpu._private import task as task_mod
 _DEFAULT_RNG = random.Random()
 
 
+class _SchedStats:
+    """Process-wide scheduling counters (flight-recorder plane): plain
+    integer increments on the decision path, exposed at scrape time via
+    `metrics_text()` from the daemons' /metrics extra_text."""
+
+    __slots__ = ("pick_calls", "no_feasible", "bundle_placements",
+                 "bundle_failures")
+
+    def __init__(self):
+        self.pick_calls = 0
+        self.no_feasible = 0
+        self.bundle_placements = 0
+        self.bundle_failures = 0
+
+
+SCHED_STATS = _SchedStats()
+
+
+def metrics_text() -> str:
+    s = SCHED_STATS
+    return (
+        "# TYPE scheduler_pick_node_total counter\n"
+        f"scheduler_pick_node_total {s.pick_calls}\n"
+        "# TYPE scheduler_no_feasible_total counter\n"
+        f"scheduler_no_feasible_total {s.no_feasible}\n"
+        "# TYPE scheduler_bundle_placements_total counter\n"
+        f"scheduler_bundle_placements_total {s.bundle_placements}\n"
+        f"scheduler_bundle_failures_total {s.bundle_failures}\n")
+
+
 def _tiebreak_rng() -> random.Random:
     plan = _fi.plan()
     if plan is not None:
@@ -97,6 +127,24 @@ def pick_node(
 ) -> Optional[NodeResources]:
     """Select a node for a task/actor. Returns None if nothing is feasible
     right now (caller queues and retries when resources free up)."""
+    SCHED_STATS.pick_calls += 1
+    node = _pick_node_impl(view, spec_resources, strategy, local_node_id,
+                           target_node_id, soft, spread_threshold, rng)
+    if node is None:
+        SCHED_STATS.no_feasible += 1
+    return node
+
+
+def _pick_node_impl(
+    view: ClusterView,
+    spec_resources: Dict[str, float],
+    strategy: str,
+    local_node_id: Optional[bytes],
+    target_node_id: Optional[bytes],
+    soft: bool,
+    spread_threshold: float,
+    rng: random.Random | None,
+) -> Optional[NodeResources]:
     nodes = view.alive_nodes()
     if not nodes:
         return None
@@ -158,6 +206,19 @@ def place_bundles(
     SPREAD: prefer distinct nodes (best effort). STRICT_SPREAD: distinct
     nodes required. Returns None if infeasible (all-or-nothing).
     """
+    placement = _place_bundles_impl(view, bundles, strategy)
+    if placement is None:
+        SCHED_STATS.bundle_failures += 1
+    else:
+        SCHED_STATS.bundle_placements += 1
+    return placement
+
+
+def _place_bundles_impl(
+    view: ClusterView,
+    bundles: List[Dict[str, float]],
+    strategy: str,
+) -> Optional[List[NodeResources]]:
     nodes = view.alive_nodes()
     remaining = {
         n.node_id: dict(n.available) for n in nodes

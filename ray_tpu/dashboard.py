@@ -35,13 +35,15 @@ function esc(v) {
     '>':'&gt;','"':'&quot;',"'":'&#39;'}[c]));
 }
 async function refresh() {
-  const [nodes, actors, summary, jobs, res, events] = await Promise.all([
+  const [nodes, actors, summary, jobs, res, events, steps] =
+    await Promise.all([
     fetch('/api/nodes').then(r => r.json()),
     fetch('/api/actors').then(r => r.json()),
     fetch('/api/task_summary').then(r => r.json()),
     fetch('/api/jobs').then(r => r.json()),
     fetch('/api/cluster_resources').then(r => r.json()),
     fetch('/api/events').then(r => r.json()),
+    fetch('/api/steps').then(r => r.json()),
   ]);
   let html = '<h2>Cluster</h2><table><tr><th>total</th>' +
              '<th>available</th></tr>' +
@@ -78,7 +80,31 @@ async function refresh() {
             `<td>${jb.finished ? 'FINISHED' : 'RUNNING'}</td>` +
             `<td>${esc(jb.runtime_s ?? '?')}s</td></tr>`;
   }
-  html += '</table><h2>Recent events</h2><table><tr><th>time</th>' +
+  html += '</table><h2>Training steps</h2>';
+  if (steps.records && steps.records.length) {
+    html += '<table><tr><th>step</th><th>total ms</th>' +
+            '<th>dispatch</th><th>device</th><th>data</th>' +
+            '<th>coll</th><th>ckpt</th><th>MFU</th></tr>';
+    for (const s of steps.records.slice(-15).reverse()) {
+      const mfu = (s.mfu == null) ? '-' : s.mfu.toFixed(4);
+      html += `<tr><td>${esc(s.step)}</td>` +
+              `<td>${esc((s.total_ms||0).toFixed(2))}</td>` +
+              `<td>${esc((s.host_dispatch_ms||0).toFixed(2))}</td>` +
+              `<td>${esc((s.device_execute_ms||0).toFixed(2))}</td>` +
+              `<td>${esc((s.data_wait_ms||0).toFixed(2))}</td>` +
+              `<td>${esc((s.collective_ms||0).toFixed(2))}</td>` +
+              `<td>${esc((s.checkpoint_ms||0).toFixed(2))}</td>` +
+              `<td>${esc(mfu)}</td></tr>`;
+    }
+    html += '</table>';
+    const attr = steps.attribution || {};
+    const parts = Object.entries(attr).filter(([k, v]) => v > 0)
+      .map(([k, v]) => `${esc(k)}=${(100 * v).toFixed(1)}%`);
+    if (parts.length) html += `<p>time attribution: ${parts.join('  ')}</p>`;
+  } else {
+    html += '<p>no step records (train with the step profiler on)</p>';
+  }
+  html += '<h2>Recent events</h2><table><tr><th>time</th>' +
           '<th>severity</th><th>source</th><th>label</th>' +
           '<th>message</th></tr>';
   for (const ev of events.slice(-25).reverse()) {
@@ -180,6 +206,21 @@ class Dashboard:
         app.router.add_get("/api/jobs", j(jobs_with_runtime))
         app.router.add_get("/api/events",
                            j(lambda: state_api.list_cluster_events()[-200:]))
+
+        def steps_panel():
+            # flight-recorder plane: merged cross-process step shards
+            # (when tracing is on), else this process's in-memory ring
+            from ray_tpu.util import step_profiler
+
+            records = step_profiler.collect()
+            if not records:
+                records = step_profiler.recent()
+            records = records[-100:]
+            return {"records": records,
+                    "attribution": step_profiler.attribution(records),
+                    "summary": step_profiler.summary()}
+
+        app.router.add_get("/api/steps", j(steps_panel))
 
         loop = asyncio.new_event_loop()
         asyncio.set_event_loop(loop)

@@ -75,6 +75,75 @@ def _fifo_dir() -> str:
         else tempfile.gettempdir()
 
 
+class _ChannelStats:
+    """Process-wide frame-plane counters (flight-recorder plane).
+
+    Hot-path cost is plain integer increments (~100 ns on a ~37 µs
+    hop); blocked-wait time is only measured when a wait actually
+    parks, so the fast path pays nothing for it. Exposed as a
+    scrape-time /metrics callback — no metric objects are constructed
+    per call (see raylint `metric-in-hot-loop`)."""
+
+    __slots__ = ("frames_written", "frames_read", "stale_skips",
+                 "write_wait_ns", "read_wait_ns", "wakeup_tokens")
+
+    def __init__(self):
+        self.frames_written = 0
+        self.frames_read = 0
+        self.stale_skips = 0
+        self.write_wait_ns = 0
+        self.read_wait_ns = 0
+        self.wakeup_tokens = 0
+
+    def as_dict(self) -> dict:
+        return {
+            "frames_written": self.frames_written,
+            "frames_read": self.frames_read,
+            "stale_skips": self.stale_skips,
+            "write_wait_ms": round(self.write_wait_ns / 1e6, 3),
+            "read_wait_ms": round(self.read_wait_ns / 1e6, 3),
+            "wakeup_tokens": self.wakeup_tokens,
+        }
+
+
+CHANNEL_STATS = _ChannelStats()
+
+
+def channel_stats() -> dict:
+    return CHANNEL_STATS.as_dict()
+
+
+def note_stale_skip() -> None:
+    """A stale frame was released from its raw header without
+    deserializing the payload (driver timeout recovery)."""
+    CHANNEL_STATS.stale_skips += 1
+
+
+def _stats_metrics_text() -> str:
+    s = CHANNEL_STATS
+    return (
+        "# TYPE channel_frames_total counter\n"
+        f'channel_frames_total{{op="write"}} {s.frames_written}\n'
+        f'channel_frames_total{{op="read"}} {s.frames_read}\n'
+        "# TYPE channel_stale_skips_total counter\n"
+        f"channel_stale_skips_total {s.stale_skips}\n"
+        "# TYPE channel_wait_ms_total counter\n"
+        f'channel_wait_ms_total{{side="write"}} '
+        f"{round(s.write_wait_ns / 1e6, 3)}\n"
+        f'channel_wait_ms_total{{side="read"}} '
+        f"{round(s.read_wait_ns / 1e6, 3)}\n")
+
+
+def _register_metrics() -> None:
+    from ray_tpu.util import metrics as _metrics
+
+    _metrics.DEFAULT_REGISTRY.register_callback(
+        "channel_frames", _stats_metrics_text)
+
+
+_register_metrics()
+
+
 class ChannelClosedError(RuntimeError):
     """The channel was shut down by its owner (compiled DAG teardown)."""
 
@@ -266,27 +335,44 @@ class ShmChannel:
 
     def _wait_writable(self, timeout: Optional[float]) -> None:
         """Block until the depth-1 slot is free (previous value
-        consumed)."""
+        consumed). Parked time is charged to CHANNEL_STATS only when the
+        wait actually loops — the already-free fast path pays nothing."""
         deadline = None if timeout is None else time.monotonic() + timeout
         spins = 0
+        waited_from = None
         while self._get(0) != self._get(1):
+            if waited_from is None:
+                waited_from = time.perf_counter_ns()
             self._check_open()
             if deadline is not None and time.monotonic() > deadline:
+                CHANNEL_STATS.write_wait_ns += (
+                    time.perf_counter_ns() - waited_from)
                 raise TimeoutError("channel write timed out")
             self._block(self._fre_fd, spins, deadline)
             spins += 1
+        if waited_from is not None:
+            CHANNEL_STATS.write_wait_ns += (
+                time.perf_counter_ns() - waited_from)
         self._check_open()
 
     def _wait_readable(self, timeout: Optional[float]) -> None:
         """Block until a value is published."""
         deadline = None if timeout is None else time.monotonic() + timeout
         spins = 0
+        waited_from = None
         while self._get(0) == self._get(1):
+            if waited_from is None:
+                waited_from = time.perf_counter_ns()
             self._check_open()
             if deadline is not None and time.monotonic() > deadline:
+                CHANNEL_STATS.read_wait_ns += (
+                    time.perf_counter_ns() - waited_from)
                 raise TimeoutError("channel read timed out")
             self._block(self._rdy_fd, spins, deadline)
             spins += 1
+        if waited_from is not None:
+            CHANNEL_STATS.read_wait_ns += (
+                time.perf_counter_ns() - waited_from)
 
     def write(self, data: bytes, timeout: Optional[float] = None) -> None:
         if len(data) > self.capacity:
@@ -328,6 +414,7 @@ class ShmChannel:
         self._set(2, _FRAME + n)
         self._set(0, self._get(0) + 1)  # publish AFTER the payload store
         self._token(self._rdy_fd)
+        CHANNEL_STATS.frames_written += 1
 
     def read_frame(
             self, timeout: Optional[float] = None
@@ -343,6 +430,7 @@ class ShmChannel:
         n = self._get(2)
         tag = buf[_HEADER]
         seq = int.from_bytes(buf[_HEADER + 8:_HEADER + 16], "little")
+        CHANNEL_STATS.frames_read += 1
         return tag, seq, buf[_HEADER + _FRAME:_HEADER + n]
 
     def release_frame(self) -> None:

@@ -129,39 +129,43 @@ class HostGroup:
         self._rounds[tag] += 1
         return f"{tag}#{n}"
 
-    def barrier(self, tag: str = "barrier"):
-        import ray_tpu
+    def _timed_get(self, ref):
+        """Collective completion wait, charged to the flight recorder's
+        collective_ms phase (folds into this thread's next StepStats)."""
+        import time
 
-        ray_tpu.get(self._actor.barrier.remote(self._round_tag(tag), self.rank),
-                    timeout=self.timeout_s)
+        import ray_tpu
+        from ray_tpu.util import step_profiler
+
+        t0 = time.perf_counter()
+        try:
+            return ray_tpu.get(ref, timeout=self.timeout_s)
+        finally:
+            step_profiler.add_phase_ms(
+                "collective_ms", (time.perf_counter() - t0) * 1e3)
+
+    def barrier(self, tag: str = "barrier"):
+        self._timed_get(
+            self._actor.barrier.remote(self._round_tag(tag), self.rank))
 
     def broadcast(self, value=None, root: int = 0, tag: str = "bcast"):
-        import ray_tpu
-
         tag = self._round_tag(tag)
         if self.rank == root:
-            ray_tpu.get(self._actor.put.remote(tag, value), timeout=self.timeout_s)
+            self._timed_get(self._actor.put.remote(tag, value))
             return value
-        return ray_tpu.get(self._actor.take.remote(tag), timeout=self.timeout_s)
+        return self._timed_get(self._actor.take.remote(tag))
 
     def allreduce_sum(self, value, tag: str = "sum"):
-        import ray_tpu
-
-        return ray_tpu.get(
-            self._actor.reduce.remote(self._round_tag(tag), self.rank, value),
-            timeout=self.timeout_s,
-        )
+        return self._timed_get(
+            self._actor.reduce.remote(self._round_tag(tag), self.rank,
+                                      value))
 
     def allgather(self, value, tag: str = "gather"):
         """Every rank receives [value_0, ..., value_{world-1}] in rank
         order (reference `collective.allgather`, GLOO host path)."""
-        import ray_tpu
-
-        return ray_tpu.get(
+        return self._timed_get(
             self._actor.gather.remote(self._round_tag(tag), self.rank,
-                                      value),
-            timeout=self.timeout_s,
-        )
+                                      value))
 
     def reducescatter_sum(self, value, tag: str = "rs"):
         """Sum across ranks, then each rank keeps its 1/world_size shard

@@ -17,8 +17,17 @@ import os
 import subprocess
 import sys
 import time
+import uuid
 
-_STATE_FILE = "/tmp/ray_tpu/cli_node.json"
+_DEFAULT_STATE_FILE = "/tmp/ray_tpu/cli_node.json"
+
+
+def _state_file() -> str:
+    """Node-state file path. `RAY_TPU_CLI_STATE_FILE` overrides the
+    machine-global default so concurrent clusters (test isolation, two
+    operators on one box) track their own daemons instead of refusing
+    to start over each other's state."""
+    return os.environ.get("RAY_TPU_CLI_STATE_FILE", _DEFAULT_STATE_FILE)
 
 
 def _spawn_daemon(args, log_path: str, ready_prefix: str) -> tuple:
@@ -35,8 +44,8 @@ def _spawn_daemon(args, log_path: str, ready_prefix: str) -> tuple:
 
 
 def _save_state(state: dict):
-    os.makedirs(os.path.dirname(_STATE_FILE), exist_ok=True)
-    with open(_STATE_FILE, "w") as f:
+    os.makedirs(os.path.dirname(_state_file()), exist_ok=True)
+    with open(_state_file(), "w") as f:
         json.dump(state, f)
 
 
@@ -50,7 +59,7 @@ def _pid_alive(pid: int) -> bool:
 
 def _load_state() -> dict | None:
     try:
-        with open(_STATE_FILE) as f:
+        with open(_state_file()) as f:
             return json.load(f)
     except (OSError, json.JSONDecodeError):
         return None
@@ -67,7 +76,10 @@ def cmd_start(args):
             raise SystemExit(
                 f"node already running (pids {alive}); "
                 "run `ray_tpu stop` first")
-    session = f"/tmp/ray_tpu/cli_{int(time.time())}"
+    # pid+nonce in the session name: two `start`s in the same second
+    # (e.g. parallel test runs) must never share a session dir
+    session = (f"/tmp/ray_tpu/cli_{int(time.time())}_{os.getpid()}_"
+               f"{uuid.uuid4().hex[:6]}")
     os.makedirs(os.path.join(session, "logs"), exist_ok=True)
     pids = []
     if args.head:
@@ -124,7 +136,7 @@ def cmd_stop(args):
         except ProcessLookupError:
             pass
     try:
-        os.unlink(_STATE_FILE)
+        os.unlink(_state_file())
     except OSError:
         pass
 
@@ -259,6 +271,35 @@ def cmd_summary(args):
 
 
 def cmd_timeline(args):
+    if getattr(args, "unified", False):
+        from ray_tpu.util.timeline import unified_timeline
+
+        # --unified without a reachable cluster still merges spans +
+        # step records (offline flight-recorder view)
+        include_tasks = True
+        ray_tpu = None
+        try:
+            ray_tpu = _connect(args)
+        except SystemExit:
+            include_tasks = False
+        try:
+            events = unified_timeline(args.output,
+                                      trace_dir=args.trace_dir,
+                                      include_tasks=include_tasks)
+            kinds = {}
+            for e in events:
+                k = e.get("cat") or e.get("ph")
+                kinds[k] = kinds.get(k, 0) + 1
+            print(f"wrote {len(events)} events to {args.output} "
+                  "(tasks + spans + step records; open in "
+                  "chrome://tracing or ui.perfetto.dev)")
+            if kinds:
+                print("  " + ", ".join(f"{k}={n}"
+                                       for k, n in sorted(kinds.items())))
+        finally:
+            if ray_tpu is not None:
+                ray_tpu.shutdown()
+        return
     ray_tpu = _connect(args)
     from ray_tpu.util.timeline import timeline
 
@@ -268,6 +309,30 @@ def cmd_timeline(args):
               "(open in chrome://tracing or ui.perfetto.dev)")
     finally:
         ray_tpu.shutdown()
+
+
+def cmd_profile(args):
+    """Flight-recorder view: the last-N step table (per-step MFU +
+    time-attribution breakdown). Offline: reads the step-record shards
+    the training processes wrote beside the tracing shards — no cluster
+    connection needed."""
+    from ray_tpu.util import step_profiler
+
+    records = step_profiler.collect(args.trace_dir)
+    if not records and step_profiler.recent():
+        records = step_profiler.recent()  # in-process fallback
+    if getattr(args, "json", False):
+        for rec in records[-args.last:]:
+            print(json.dumps(rec))
+        return
+    print(step_profiler.format_table(records, last=args.last))
+    if records:
+        attribution = step_profiler.attribution(records)
+        total_steps = records[-1].get("step", len(records))
+        print(f"\n{len(records)} records "
+              f"(through step {total_steps}); "
+              f"dominant phase: "
+              f"{max(attribution, key=attribution.get) if attribution else '?'}")
 
 
 def cmd_client_server(args):
@@ -428,7 +493,25 @@ def main(argv=None):
     p = sub.add_parser("timeline", help="dump a Chrome trace of tasks")
     p.add_argument("--address")
     p.add_argument("--output", default="timeline.json")
+    p.add_argument("--unified", action="store_true",
+                   help="merge task events + tracing spans + per-step "
+                        "records into one trace")
+    p.add_argument("--trace-dir", default=None,
+                   help="span/step shard dir (default: "
+                        "RAY_TPU_TRACE_DIR)")
     p.set_defaults(fn=cmd_timeline)
+
+    p = sub.add_parser(
+        "profile",
+        help="per-step training telemetry: MFU + time attribution")
+    p.add_argument("--trace-dir", default=None,
+                   help="step-record shard dir (default: "
+                        "RAY_TPU_TRACE_DIR)")
+    p.add_argument("--last", type=int, default=20,
+                   help="rows to print (default 20)")
+    p.add_argument("--json", action="store_true",
+                   help="raw JSONL records instead of the table")
+    p.set_defaults(fn=cmd_profile)
 
     p = sub.add_parser(
         "client-server",

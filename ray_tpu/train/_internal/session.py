@@ -81,7 +81,17 @@ class _TrainSession:
                 # a copy here would escape num_to_keep eviction.
                 persisted_path = checkpoint.path
             else:
+                import time as _time
+
+                from ray_tpu.util import step_profiler as _sp
+
+                _t0 = _time.perf_counter()
                 persisted_path = self._persist_checkpoint(checkpoint)
+                # flight recorder: checkpoint persist time folds into
+                # the next StepStats record on this (train-fn) thread
+                _sp.add_phase_ms(
+                    "checkpoint_ms",
+                    (_time.perf_counter() - _t0) * 1e3)
             self._last_checkpoint = Checkpoint(persisted_path)
             if _fi._PLAN is not None:
                 # chaos window: this rank's shard is durable, the gang

@@ -67,7 +67,10 @@ class TrainStepRunner:
 
     def __init__(self, step_fn: Callable, *, steps_per_call: int = 1,
                  donate_carry: bool = True, mesh=None,
-                 on_retrace: str = "warn"):
+                 on_retrace: str = "warn",
+                 tokens_per_step: int = 0,
+                 flops_per_step: float = 0.0,
+                 peak_flops: Optional[float] = None):
         from ray_tpu.parallel.compile_cache import (compiled_step,
                                                     fold_steps)
 
@@ -75,6 +78,13 @@ class TrainStepRunner:
             raise ValueError("steps_per_call must be >= 1")
         self.step_fn = step_fn
         self.steps_per_call = steps_per_call
+        # flight recorder: optional model accounting for the per-step
+        # MFU column (tokens/flops consumed PER SINGLE STEP; peak_flops
+        # overrides device detection — required for MFU on CPU)
+        self._tokens_per_step = tokens_per_step
+        self._flops_per_step = flops_per_step
+        self._peak_flops = peak_flops
+        self._step = 0
         if steps_per_call == 1:
             self._compiled = compiled_step(
                 step_fn, donate_argnums=(0,) if donate_carry else (),
@@ -84,28 +94,73 @@ class TrainStepRunner:
                 step_fn, steps_per_call, donate_carry=donate_carry,
                 mesh=mesh, on_retrace=on_retrace)
 
+    def _prep_batches(self, batches):
+        from ray_tpu.parallel.compile_cache import stack_batches
+
+        if self.steps_per_call == 1:
+            if hasattr(batches, "__next__"):
+                batches = next(batches)
+            return batches
+        if hasattr(batches, "__next__") or (
+                isinstance(batches, (list, tuple))):
+            it = iter(batches)
+            batches = stack_batches(
+                next(it) for _ in range(self.steps_per_call))
+        return batches
+
     def run(self, carry, batches):
         """Advance ``steps_per_call`` steps in one dispatch.
 
         ``batches``: an iterator/iterable of per-step batches (the next
         K are pulled and stacked), or an already-stacked [K, ...] pytree
         when ``steps_per_call > 1``. Returns ``(carry, aux)`` with aux
-        stacked over the K steps (a bare aux for K == 1)."""
-        from ray_tpu.parallel.compile_cache import stack_batches
+        stacked over the K steps (a bare aux for K == 1).
 
-        if self.steps_per_call == 1:
-            if hasattr(batches, "__next__"):
-                batches = next(batches)
-            return self._compiled(carry, batches)
-        if hasattr(batches, "__next__") or (
-                isinstance(batches, (list, tuple))):
-            it = iter(batches)
-            batches = stack_batches(
-                next(it) for _ in range(self.steps_per_call))
-        return self._compiled(carry, batches)
+        Every dispatch lands one ``StepStats`` record in the flight
+        recorder (``ray_tpu.util.step_profiler``): data-wait (batch
+        pull + stack), host-dispatch (time in the cached-executable
+        call), and — when ``RAY_TPU_PROFILE_SYNC`` is on, the default —
+        device-execute as the block-until-ready delta. Disable the
+        recorder wholesale with ``RAY_TPU_STEP_PROFILER=0``."""
+        from ray_tpu.util import step_profiler
+
+        if not step_profiler.enabled():
+            return self._compiled(carry, self._prep_batches(batches))
+        import time
+
+        import jax
+
+        t0 = time.perf_counter()
+        batches = self._prep_batches(batches)
+        t1 = time.perf_counter()
+        out = self._compiled(carry, batches)
+        t2 = time.perf_counter()
+        device_ms = 0.0
+        if step_profiler.sync_mode():
+            jax.block_until_ready(out)
+            device_ms = (time.perf_counter() - t2) * 1e3
+        self._step += self.steps_per_call
+        k = self.steps_per_call
+        step_profiler.record_step(
+            self._step, (time.perf_counter() - t0) * 1e3,
+            host_dispatch_ms=(t2 - t1) * 1e3,
+            device_execute_ms=device_ms,
+            data_wait_ms=(t1 - t0) * 1e3,
+            tokens=self._tokens_per_step * k,
+            flops=self._flops_per_step * k,
+            steps_per_call=k,
+            peak=self._peak_flops,
+        )
+        return out
 
     def cache_stats(self):
         return self._compiled.cache.stats.as_dict()
+
+    def step_stats(self, n: Optional[int] = None):
+        """The flight recorder's recent StepStats rows (dicts)."""
+        from ray_tpu.util import step_profiler
+
+        return step_profiler.recent(n)
 
 
 class BaseTrainer:

@@ -25,6 +25,18 @@ _lock = threading.Lock()
 _files: Dict[str, Any] = {}
 
 
+def _reset_writers() -> None:
+    """Fork safety: per-source writer handles are pid-named; a forked
+    child inheriting them would append events to the parent's shard on a
+    shared file offset. Drop the cache in the child — the next report()
+    opens the child's own shard."""
+    _files.clear()
+
+
+if hasattr(os, "register_at_fork"):
+    os.register_at_fork(after_in_child=_reset_writers)
+
+
 def event_dir() -> str:
     return os.environ.get("RAY_TPU_EVENT_DIR", "/tmp/ray_tpu/events")
 

@@ -12,36 +12,65 @@ from __future__ import annotations
 
 import asyncio
 import threading
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 
 class _Registry:
     def __init__(self):
         self._metrics: List["Metric"] = []
+        self._callbacks: Dict[str, Any] = {}
         self._lock = threading.Lock()
 
     def register(self, metric: "Metric"):
         with self._lock:
             self._metrics.append(metric)
 
+    def register_callback(self, name: str, fn) -> None:
+        """Scrape-time exposition source: `fn()` returns a chunk of
+        Prometheus text (with its own # TYPE lines), computed fresh per
+        scrape. Keyed by name so re-registration (module reload, test
+        setup) replaces instead of duplicating. This is how subsystems
+        with their own cheap counters (compile cache, channel frame
+        plane, step profiler) join the registry without constructing
+        metric objects on their hot paths."""
+        with self._lock:
+            self._callbacks[name] = fn
+
     def prometheus_text(self) -> str:
         lines: List[str] = []
         with self._lock:
             metrics = list(self._metrics)
+            callbacks = list(self._callbacks.items())
         for m in metrics:
             lines.append(f"# HELP {m.name} {m.description}")
             lines.append(f"# TYPE {m.name} {m.prom_type}")
             lines.extend(m.samples())
+        for name, fn in callbacks:
+            try:
+                chunk = fn()
+            except Exception:  # noqa: BLE001 — one bad source must not
+                continue       # take down the whole scrape
+            if chunk:
+                lines.append(chunk.rstrip("\n"))
         return "\n".join(lines) + "\n"
 
 
 DEFAULT_REGISTRY = _Registry()
 
 
+def _escape_label_value(v: str) -> str:
+    """Prometheus text-format escaping for label values: backslash,
+    double-quote and newline (the spec's three escapes — scrapers break
+    on e.g. task names containing quotes otherwise)."""
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
 def _label_str(keys: Sequence[str], values: Tuple) -> str:
     if not keys:
         return ""
-    inner = ",".join(f'{k}="{v}"' for k, v in zip(keys, values))
+    inner = ",".join(
+        f'{k}="{_escape_label_value(str(v))}"'
+        for k, v in zip(keys, values))
     return "{" + inner + "}"
 
 
@@ -177,6 +206,9 @@ async def serve_metrics(host: str = "127.0.0.1", port: int = 0,
             body = reg.prometheus_text()
             if extra_text is not None:
                 body += extra_text()
+            # OpenMetrics-style terminator: scrapers use it to tell a
+            # complete exposition from a truncated one
+            body += "# EOF\n"
             payload = body.encode()
             writer.write(
                 b"HTTP/1.1 200 OK\r\n"

@@ -4,6 +4,13 @@ Reference: `ray timeline` (`python/ray/_private/state.py:434`
 `chrome_tracing_dump`) — profile events from the GCS task table rendered
 for chrome://tracing / Perfetto. Each task becomes a complete ("X")
 event on its owner's row, spanning SUBMITTED → FINISHED/FAILED.
+
+`unified_timeline` additionally merges the tracing plane's span shards
+(submit/execute spans, channel write→read hops with cross-process flow
+arrows) and the flight recorder's per-step records into ONE Chrome
+trace — the `ray_tpu timeline --unified` view: task rows from the GCS,
+span rows per process, a "train-step" row per training process, all on
+the same wall clock.
 """
 
 from __future__ import annotations
@@ -43,6 +50,33 @@ def timeline(filename: Optional[str] = None) -> list:
                 "state": rec["state"],
             },
         })
+    if filename:
+        with open(filename, "w") as f:
+            json.dump(events, f)
+    return events
+
+
+def unified_timeline(filename: Optional[str] = None,
+                     trace_dir: Optional[str] = None,
+                     include_tasks: bool = True) -> list:
+    """Merge task events + tracing spans + step records into one Chrome
+    trace. Each source is optional on its own: no cluster connection
+    skips the task table (`include_tasks=False` or a connection error),
+    an empty trace dir contributes nothing — whatever telemetry exists
+    lands in the one file."""
+    from ray_tpu.util import step_profiler, tracing
+
+    events: list = []
+    if include_tasks:
+        try:
+            events.extend(timeline(None))
+        except Exception:  # noqa: BLE001 — offline use: spans + steps
+            pass           # still merge without a cluster
+    spans = tracing.collect(trace_dir)
+    events.extend(tracing.to_chrome(spans))
+    steps = step_profiler.collect(trace_dir)
+    events.extend(step_profiler.to_chrome(steps))
+    events.sort(key=lambda e: e.get("ts", 0))
     if filename:
         with open(filename, "w") as f:
             json.dump(events, f)

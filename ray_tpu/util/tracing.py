@@ -32,6 +32,22 @@ _lock = threading.Lock()
 _file = None
 
 
+def _reset_writer() -> None:
+    """Fork safety: a child inheriting the parent's cached handle would
+    append its spans to the PARENT's pid-named shard (and interleave
+    writes on a shared file offset). Daemons fork workers, so the cached
+    handle is dropped in the child; the next span opens the child's own
+    shard. Runs in the just-forked child, which is single-threaded —
+    taking the fork-inherited lock here could deadlock on a holder that
+    no longer exists in the child."""
+    global _file
+    _file = None  # raylint: disable=lock-discipline
+
+
+if hasattr(os, "register_at_fork"):
+    os.register_at_fork(after_in_child=_reset_writer)
+
+
 def enabled() -> bool:
     return os.environ.get("RAY_TPU_TRACE", "") in ("1", "true", "on")
 
@@ -146,7 +162,14 @@ def collect(path: Optional[str] = None) -> List[dict]:
 
 def to_chrome(spans: List[dict], filename: Optional[str] = None) -> list:
     """Chrome-trace view: one complete event per span, rows = processes,
-    flow arrows producer → consumer (chrome 's'/'f' flow events)."""
+    flow arrows producer → consumer (chrome 's'/'f' flow events).
+
+    Two arrow mechanisms: parent/span-id links (the submit→execute task
+    path, where the child ships the parent ctx in its TaskSpec), and
+    explicit ``flow_id`` attrs for planes where no ctx can ride the
+    wire — a channel frame has a fixed raw header, so the producer and
+    consumer spans both carry ``flow_id="<channel>:<seq>"`` and the
+    arrow is stitched here, at merge time, across processes."""
     events = []
     for s in spans:
         events.append({
@@ -167,6 +190,15 @@ def to_chrome(spans: List[dict], filename: Optional[str] = None) -> list:
             events.append({
                 "name": "flow", "cat": "trace", "ph": "s",
                 "id": s["span_id"],
+                "ts": s["start"] * 1e6,
+                "pid": s["pid"], "tid": s["trace_id"][:8],
+            })
+        flow_id = s.get("attrs", {}).get("flow_id")
+        if flow_id:
+            events.append({
+                "name": "hop", "cat": "channel",
+                "ph": "s" if s["kind"] == "producer" else "f",
+                "bp": "e", "id": str(flow_id),
                 "ts": s["start"] * 1e6,
                 "pid": s["pid"], "tid": s["trace_id"][:8],
             })

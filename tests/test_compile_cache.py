@@ -178,7 +178,8 @@ def test_train_step_runner_equivalence_and_stats():
 
 def test_global_cache_stats_shape():
     before = cache_stats()
-    assert set(before) == {"hits", "misses", "retraces", "entries"}
+    assert set(before) == {"hits", "misses", "retraces", "entries",
+                           "lowering_ms"}
 
     @compiled_step
     def bump(x):

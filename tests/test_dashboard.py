@@ -84,4 +84,16 @@ def test_dashboard_rest_and_html():
     events = json.loads(urllib.request.urlopen(
         base + "/api/events", timeout=30).read())
     assert isinstance(events, list)  # GCS/raylet lifecycle events
+
+    # steps panel (flight recorder): records + attribution + summary
+    from ray_tpu.util import step_profiler
+
+    step_profiler.record_step(7, 11.0, host_dispatch_ms=2.0)
+    try:
+        steps = json.loads(urllib.request.urlopen(
+            base + "/api/steps", timeout=30).read())
+        assert any(r["step"] == 7 for r in steps["records"])
+        assert "attribution" in steps and "summary" in steps
+    finally:
+        step_profiler.clear()
     ray_tpu.kill(v)

@@ -482,6 +482,104 @@ class TestJitCacheStability:
 
 
 # ---------------------------------------------------------------------------
+# checker 6: metric-in-hot-loop
+# ---------------------------------------------------------------------------
+
+class TestMetricInHotLoop:
+    def test_counter_in_loop_flagged(self):
+        findings = run("""
+            from ray_tpu.util.metrics import Counter
+
+            def scan(items):
+                for item in items:
+                    c = Counter("item_total", "per item")
+                    c.inc()
+        """)
+        assert any(f.check == "metric-in-hot-loop"
+                   and f.detail == "in-loop:Counter"
+                   and f.scope == "scan" for f in findings), findings
+
+    def test_histogram_in_per_call_function_flagged(self):
+        findings = run("""
+            from ray_tpu.util import metrics
+
+            class Replica:
+                def handle_request(self, req):
+                    h = metrics.Histogram("latency_s", "per request")
+                    h.observe(req.latency)
+        """)
+        assert any(f.check == "metric-in-hot-loop"
+                   and f.detail == "per-call:Histogram"
+                   and f.scope == "Replica.handle_request"
+                   for f in findings), findings
+
+    def test_module_scope_and_init_ok(self):
+        findings = run("""
+            from ray_tpu.util.metrics import Counter, Gauge
+
+            REQUESTS = Counter("req_total", "requests")
+
+            class Replica:
+                def __init__(self):
+                    self._inflight = Gauge("inflight", "in flight")
+
+                def handle(self, req):
+                    REQUESTS.inc()
+                    self._inflight.set(1)
+        """)
+        assert "metric-in-hot-loop" not in checks_of(findings)
+
+    def test_setup_function_ok(self):
+        findings = run("""
+            from ray_tpu.util.metrics import Gauge
+
+            def _init_metrics():
+                return Gauge("depth", "queue depth")
+
+            def setup_daemon():
+                return Gauge("up", "daemon up")
+        """)
+        assert "metric-in-hot-loop" not in checks_of(findings)
+
+    def test_collections_counter_not_a_metric(self):
+        findings = run("""
+            import collections
+            from collections import Counter
+
+            def tally(items):
+                for item in items:
+                    c = Counter(item)           # collections.Counter
+                    d = collections.Counter(item)
+        """)
+        assert "metric-in-hot-loop" not in checks_of(findings)
+
+    def test_def_inside_loop_is_per_iteration(self):
+        findings = run("""
+            from ray_tpu.util.metrics import Counter
+
+            def build(names):
+                fns = []
+                for name in names:
+                    def make():
+                        return Counter(name, "fresh per iteration")
+                    fns.append(make)
+                return fns
+        """)
+        assert any(f.check == "metric-in-hot-loop"
+                   and f.detail == "in-loop:Counter"
+                   for f in findings), findings
+
+    def test_inline_suppression_applies(self):
+        findings = run("""
+            from ray_tpu.util.metrics import Counter
+
+            def per_call():
+                return Counter("x", "y")  # raylint: disable=metric-in-hot-loop
+        """)
+        assert "metric-in-hot-loop" not in checks_of(findings)
+
+
+# ---------------------------------------------------------------------------
 # jit-purity over the AOT-cache stagers (compiled_step / fold_steps)
 # ---------------------------------------------------------------------------
 

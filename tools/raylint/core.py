@@ -53,6 +53,18 @@ analysis gates"):
     AOT executable cache (``ray_tpu.parallel.compiled_step`` /
     ``fold_steps``).
 
+``metric-in-hot-loop``
+    Flags ``Counter`` / ``Gauge`` / ``Histogram`` (ray_tpu.util.metrics)
+    constructed inside a loop or a per-call function: every
+    construction registers a NEW metric object with the registry, so a
+    metric built per task/request/iteration leaks registry entries
+    without bound (and every /metrics scrape re-renders all of them).
+    Sanctioned forms: module-scope construction, construction in
+    ``__init__`` (one object per instance), one-time setup functions
+    (names like ``init*``/``setup*``/``create*``/``build*``/
+    ``register*``/``start*``/``main``), or a scrape-time text callback
+    (``DEFAULT_REGISTRY.register_callback``) which constructs nothing.
+
 Suppression: append ``# raylint: disable=<check>`` (or ``disable=all``)
 to the flagged line, or put it on a comment line directly above.
 """
@@ -66,7 +78,7 @@ import re
 from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
 CHECKS = ("lock-discipline", "blocking-under-lock", "jit-purity",
-          "seeded-rng", "jit-cache-stability")
+          "seeded-rng", "jit-cache-stability", "metric-in-hot-loop")
 
 _LOCKISH_NAME = re.compile(r"lock|mutex|cond", re.IGNORECASE)
 _LOCK_FACTORIES = {
@@ -80,6 +92,14 @@ _MUTATORS = {
     "move_to_end", "sort", "reverse",
 }
 _SUPPRESS_RE = re.compile(r"#\s*raylint:\s*disable=([\w,\-]+)")
+
+# ray_tpu.util.metrics constructor names (metric-in-hot-loop)
+_METRIC_TYPES = {"Counter", "Gauge", "Histogram"}
+# one-time setup scopes where constructing a metric is sanctioned
+_METRIC_SETUP_PREFIXES = ("init", "_init", "__init", "setup", "_setup",
+                          "create", "_create", "build", "_build",
+                          "register", "_register", "start", "_start",
+                          "make", "_make", "main")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -229,6 +249,10 @@ class ModuleContext:
         self.module_lock_globals: Set[str] = set()
         self.random_aliases: Set[str] = set()
         self.numpy_aliases: Set[str] = set()
+        # names bound to ray_tpu.util.metrics constructors (so bare
+        # `Counter(...)` is only a metric ctor when imported from the
+        # metrics module — collections.Counter must not be flagged)
+        self.metric_ctor_names: Set[str] = set()
         self._collect()
 
     # -- fact collection -------------------------------------------------
@@ -272,6 +296,12 @@ class ModuleContext:
                             self.random_aliases.discard(
                                 alias.asname or alias.name)
                             self.numpy_aliases.add("__from_numpy__")
+                if node.module and (node.module.endswith("metrics")
+                                    or node.module == "ray_tpu.util"):
+                    for alias in node.names:
+                        if alias.name in _METRIC_TYPES:
+                            self.metric_ctor_names.add(
+                                alias.asname or alias.name)
 
     @staticmethod
     def _is_lock_factory_call(value: ast.AST) -> bool:
@@ -991,6 +1021,86 @@ def check_jit_cache_stability(ctx: ModuleContext) -> List[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# checker 6: metric-in-hot-loop
+# ---------------------------------------------------------------------------
+
+def _is_metric_ctor(ctx: ModuleContext, call: ast.Call) -> Optional[str]:
+    """The metric type name when `call` constructs a
+    ray_tpu.util.metrics Counter/Gauge/Histogram, else None. Bare names
+    must have been imported from a metrics module (collections.Counter
+    is not a metric); dotted calls qualify when the holder looks like a
+    metrics module (`metrics.Counter`, `_metrics.Histogram`)."""
+    name = dotted(call.func)
+    if not name:
+        return None
+    parts = name.split(".")
+    last = parts[-1]
+    if last not in _METRIC_TYPES:
+        return None
+    if len(parts) == 1:
+        return last if name in ctx.metric_ctor_names else None
+    return last if "metric" in parts[-2].lower() else None
+
+
+def _is_setup_scope(func_name: str) -> bool:
+    if func_name in ("__init__", "__new__", "__post_init__"):
+        return True
+    stripped = func_name.lstrip("_")
+    return any(stripped.startswith(p.lstrip("_"))
+               for p in _METRIC_SETUP_PREFIXES)
+
+
+def check_metric_in_hot_loop(ctx: ModuleContext) -> List[Finding]:
+    """Flag Counter/Gauge/Histogram constructed where the construction
+    repeats: inside a loop body, or inside a per-call function (every
+    construction registers a fresh metric — the registry leaks an entry
+    per call). Module scope, __init__, and one-time setup scopes
+    (init*/setup*/create*/build*/register*/start*/make*/main) are the
+    sanctioned construction sites."""
+    findings: List[Finding] = []
+
+    def visit(node: ast.AST, scope: str, classname: Optional[str],
+              in_loop: bool, exempt: bool) -> None:
+        for child in ast.iter_child_nodes(node):
+            c_scope, c_class = scope, classname
+            c_loop, c_exempt = in_loop, exempt
+            if isinstance(child, ast.ClassDef):
+                c_class = child.name
+            elif isinstance(child,
+                            (ast.FunctionDef, ast.AsyncFunctionDef)):
+                c_scope = (f"{c_class}.{child.name}" if c_class
+                           else child.name)
+                # entering a per-call function cancels a setup parent's
+                # exemption; a def inside a loop stays in_loop (fresh
+                # closure per iteration constructs per iteration)
+                c_exempt = _is_setup_scope(child.name)
+            elif isinstance(child, ast.Lambda):
+                # a lambda body runs per call of the lambda
+                c_exempt = False
+            elif isinstance(child, (ast.For, ast.While, ast.AsyncFor)):
+                c_loop = True
+            if isinstance(child, ast.Call):
+                mtype = _is_metric_ctor(ctx, child)
+                if mtype and (c_loop or (
+                        c_scope != "<module>" and not c_exempt)):
+                    where = "in-loop" if c_loop else "per-call"
+                    findings.append(Finding(
+                        ctx.relpath, "metric-in-hot-loop", c_scope,
+                        f"{where}:{mtype}", child.lineno,
+                        f"`{mtype}` constructed "
+                        f"{'inside a loop' if c_loop else 'in a per-call function'}"
+                        f" registers a new metric per execution — the "
+                        f"registry leaks an entry per call; construct "
+                        f"it once at module scope / __init__, or expose "
+                        f"the values via a scrape-time "
+                        f"register_callback"))
+            visit(child, c_scope, c_class, c_loop, c_exempt)
+
+    visit(ctx.tree, "<module>", None, False, True)
+    return findings
+
+
+# ---------------------------------------------------------------------------
 # driver
 # ---------------------------------------------------------------------------
 
@@ -1000,6 +1110,7 @@ _CHECKERS = {
     "jit-purity": check_jit_purity,
     "seeded-rng": check_seeded_rng,
     "jit-cache-stability": check_jit_cache_stability,
+    "metric-in-hot-loop": check_metric_in_hot_loop,
 }
 
 
