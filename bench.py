@@ -120,6 +120,66 @@ def _store_stats() -> dict:
         return {"error": repr(e)[:200]}
 
 
+def _rpc_stats_snapshot() -> dict:
+    """Driver-process RPC coalescing counters (rpc.RPC_STATS)."""
+    from ray_tpu._private import rpc as rpc_mod
+
+    st = rpc_mod.RPC_STATS
+    return {k: getattr(st, k) for k in type(st).__slots__}
+
+
+def _control_plane_attrib(before: dict) -> dict:
+    """Where a control-plane number came from: the phase's driver-side
+    frame-coalescing deltas plus the GCS/raylet scheduler + shard
+    counters, scraped over RPC (`metrics_text`) from the live daemons.
+    Driver-process counters only — worker subprocesses keep their own
+    RPC_STATS — so msgs_per_frame understates cluster-wide coalescing.
+    """
+    now = _rpc_stats_snapshot()
+    delta = {k: now[k] - before.get(k, 0) for k in now}
+    delta["msgs_per_frame"] = round(
+        delta["messages_sent"] / max(1, delta["frames_sent"]), 3)
+    out = {"driver_rpc_delta": delta}
+    try:
+        from ray_tpu._private import worker_api
+
+        cw = worker_api._global_state.core_worker
+
+        async def scrape():
+            gcs = await cw.gcs.call("metrics_text", {}, timeout=10.0)
+            raylet = await cw._clients.get(cw.raylet_addr)
+            ray = await raylet.call("metrics_text", {}, timeout=10.0)
+            return gcs["text"], ray["text"]
+
+        gcs_text, raylet_text = cw._run_sync(scrape())
+        prefixes = ("scheduler_", "raylet_leases_granted",
+                    "raylet_workers_returned", "raylet_pending_leases",
+                    "gcs_table_shard_", "rpc_")
+
+        def agg(text: str) -> dict:
+            # sum labeled series per bare metric name — the artifact
+            # wants attributable totals, not 8 shard rows per table
+            rows = {}
+            for ln in text.splitlines():
+                if not ln or ln.startswith("#"):
+                    continue
+                name, _, val = ln.rpartition(" ")
+                bare = name.split("{", 1)[0]
+                if bare.startswith(prefixes):
+                    try:
+                        rows[bare] = round(
+                            rows.get(bare, 0.0) + float(val), 3)
+                    except ValueError:
+                        pass
+            return rows
+
+        out["gcs"] = agg(gcs_text)
+        out["raylet"] = agg(raylet_text)
+    except Exception as e:  # noqa: BLE001
+        out["scrape_error"] = repr(e)[:200]
+    return out
+
+
 def _check_regressions(suite: dict) -> list | None:
     """Self-comparison gate: load the newest BENCH_r*.json and flag any
     metric that dropped >15% (ROADMAP item #5). Returns the regression
@@ -739,6 +799,68 @@ def bench_scale_envelope():
             cluster.shutdown()
 
 
+def bench_rpc_fanin():
+    """Transport-level microbench, no cluster: 4 clients × 256-deep
+    concurrent echo bursts against one RpcServer — the pure fan-in
+    shape the write coalescer exists for — plus a serial ping-pong
+    row pinning that coalescing adds no latency to request/response
+    traffic. Runs in-process, so it is the one control-plane row
+    that is stable on the 1-core build box."""
+    import asyncio
+
+    from ray_tpu._private import rpc as rpc_mod
+    from ray_tpu._private.rpc import RpcClient, RpcServer
+
+    async def run():
+        server = RpcServer()
+
+        async def echo(payload):
+            return payload
+
+        server.register("echo", echo)
+        await server.start()
+        clients = [await RpcClient(server.address).connect()
+                   for _ in range(4)]
+
+        async def burst(client, n):
+            await asyncio.gather(
+                *[client.call("echo", i) for i in range(n)])
+
+        await asyncio.gather(*[burst(c, 64) for c in clients])  # warm
+        before = _rpc_stats_snapshot()
+        n, start = 0, time.perf_counter()
+        while time.perf_counter() - start < 4.0:
+            await asyncio.gather(*[burst(c, 256) for c in clients])
+            n += 4 * 256
+        fanin = n / (time.perf_counter() - start)
+        now = _rpc_stats_snapshot()
+        msgs = now["messages_sent"] - before["messages_sent"]
+        frames = now["frames_sent"] - before["frames_sent"]
+
+        c = clients[0]
+        n, start = 0, time.perf_counter()
+        while time.perf_counter() - start < 2.0:
+            for _ in range(100):
+                await c.call("echo", 1)
+            n += 100
+        serial = n / (time.perf_counter() - start)
+        for c in clients:
+            await c.close()
+        await server.stop()
+        return fanin, serial, msgs, frames
+
+    fanin, serial, msgs, frames = asyncio.run(run())
+    return {
+        "rpc_fanin_calls_async": fanin,
+        "rpc_serial_calls_sync": serial,
+        "rpc_fanin_coalescing": {
+            "messages_sent": msgs,
+            "frames_sent": frames,
+            "msgs_per_frame": round(msgs / max(1, frames), 3),
+        },
+    }
+
+
 def bench_control_plane():
     """Each phase gets an isolated cluster sized to the machine: worker
     processes beyond the core count thrash instead of pipelining, and a
@@ -956,12 +1078,14 @@ def bench_control_plane():
 
         m, calls = 4, 1000
         ray_tpu.get([caller_work.remote(actors, 8) for _ in range(m)])
+        rpc_before = _rpc_stats_snapshot()
         n, start = 0, time.perf_counter()
         while time.perf_counter() - start < 4.0:
             ray_tpu.get([caller_work.remote(actors, calls)
                          for _ in range(m)])
             n += m * calls
         out["n_n_actor_calls_async"] = n / (time.perf_counter() - start)
+        out["n_n_actor_calls_attrib"] = _control_plane_attrib(rpc_before)
     finally:
         ray_tpu.shutdown()
 
@@ -984,12 +1108,14 @@ def bench_control_plane():
         m, calls = 4, 1000
         clients = [Client.remote() for _ in range(m)]
         ray_tpu.get([c.small_value_batch.remote(8) for c in clients])
+        rpc_before = _rpc_stats_snapshot()
         n, start = 0, time.perf_counter()
         while time.perf_counter() - start < 4.0:
             ray_tpu.get([c.small_value_batch.remote(calls)
                          for c in clients])
             n += m * calls
         out["multi_client_tasks_async"] = n / (time.perf_counter() - start)
+        out["multi_client_tasks_attrib"] = _control_plane_attrib(rpc_before)
     finally:
         ray_tpu.shutdown()
     return out
@@ -1064,6 +1190,13 @@ def main():
 
     # off-TPU the control-plane phase IS the headline — never gate it
     if remaining() > 120 or not on_tpu:
+        try:
+            rf = bench_rpc_fanin()
+            for k, v in rf.items():
+                suite[k] = v if isinstance(v, dict) else {
+                    "value": round(v, 2), "vs_baseline": None}
+        except Exception as e:  # noqa: BLE001
+            suite["rpc_fanin_error"] = repr(e)[:300]
         try:
             cp = bench_control_plane()
             for k, v in cp.items():

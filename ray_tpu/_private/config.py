@@ -85,6 +85,16 @@ class Config:
     # --- rpc ---
     rpc_connect_timeout_s: float = 10.0
     rpc_max_frame_bytes: int = 512 * 1024**2
+    # Write-side frame coalescing: logical messages queued within one
+    # event-loop tick share a BATCH wire frame; crossing either watermark
+    # flushes immediately. 1 disables batching (every message is its own
+    # frame, byte-identical to the pre-BATCH wire format).
+    rpc_batch_max_msgs: int = 128
+    rpc_batch_max_bytes: int = 256 * 1024
+    # Transport send-buffer high-watermark: above this the coalescer stops
+    # writing and parks behind one awaited drain() (backpressure for the
+    # call_nowait pipelined path against a slow peer).
+    rpc_send_high_watermark: int = 4 * 1024**2
 
     # --- gcs ---
     gcs_pubsub_batch_ms: float = 5.0

@@ -1597,7 +1597,12 @@ class CoreWorker:
         finally:
             try:
                 raylet = await self._clients.get(raylet_addr)
-                await raylet.call("return_worker", {
+                # fire-and-forget: the reply was never used, and frames on
+                # one connection are FIFO, so the raylet processes the
+                # return before any subsequent lease request from this
+                # owner — dropping the await removes one round trip per
+                # lease cycle (and the notify rides the write coalescer)
+                await raylet.notify("return_worker", {
                     "lease_id": lease_id,
                     "worker_dead": worker_dead,
                 })

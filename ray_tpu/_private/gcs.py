@@ -18,13 +18,15 @@ import asyncio
 import logging
 import os
 import time
-from collections import OrderedDict
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional
 
 from ray_tpu._private import fault_injection as _fi
+from ray_tpu._private import rpc
+from ray_tpu._private import sharded_table
 from ray_tpu._private import task as task_mod
 from ray_tpu._private.config import Config
+from ray_tpu._private.sharded_table import ShardedTable
 from ray_tpu.util import events as export_events
 from ray_tpu._private.rpc import ClientPool, ConnectionLost, RpcError, RpcServer
 from ray_tpu._private.scheduling import (
@@ -53,14 +55,18 @@ class GcsServer:
         self.clients = ClientPool()
         self.view = ClusterView()
 
-        # Tables.
+        # Tables. The hot-write tables (actor directory, bounded task-event
+        # log) are keyed-shard maps: concurrent registrations and event
+        # ingestion spread over shards with per-shard counters in /metrics,
+        # and write-through persistence routes by the same shard index onto
+        # per-shard store threads (see _persist).
         self.kv: Dict[str, Dict[bytes, bytes]] = {}
         self.nodes: Dict[bytes, dict] = {}
         self.jobs: Dict[bytes, dict] = {}
-        self.actors: Dict[bytes, dict] = {}
+        self.actors: ShardedTable = ShardedTable(name="actors")
         self.named_actors: Dict[str, bytes] = {}
         self.placement_groups: Dict[bytes, dict] = {}
-        self.task_events: "OrderedDict[bytes, dict]" = OrderedDict()
+        self.task_events: ShardedTable = ShardedTable(name="task_events")
         self.subscribers: Dict[str, List[str]] = {}
         self._last_heartbeat: Dict[bytes, float] = {}
         self._pending_actors: List[bytes] = []
@@ -85,8 +91,13 @@ class GcsServer:
         from ray_tpu._private.store_client import make_store_client
 
         self.store = make_store_client(store_path)
-        self._store_pool = (ThreadPoolExecutor(1, "gcs-store")
-                            if self.store else None)
+        # One single-thread writer per table shard: same key → same shard
+        # → same thread keeps per-key mutation order, while writes for
+        # different shards no longer serialize on one store thread.
+        self._store_pools = ([
+            ThreadPoolExecutor(1, f"gcs-store-{i}")
+            for i in range(ShardedTable.DEFAULT_SHARDS)]
+            if self.store else None)
         if self.store is not None and self.store.tables():
             self._load_from_store()
         elif persist_path:
@@ -115,6 +126,7 @@ class GcsServer:
         for name in self._SNAPSHOT_TABLES:
             if name in data:
                 setattr(self, name, data[name])
+        self._reshard_tables()
         self._resume_pending("snapshot")
 
     def _load_from_store(self):
@@ -131,7 +143,19 @@ class GcsServer:
         for table in self.store.tables():
             if table.startswith("kv:"):
                 self.kv[table[3:]] = self.store.get_all(table)
+        self._reshard_tables()
         self._resume_pending("store")
+
+    def _reshard_tables(self):
+        """Restored tables arrive as plain dicts (store dumps, pre-shard
+        snapshots); rewrap the hot tables, keeping insertion order as the
+        recency order. A ShardedTable from a current snapshot unpickles
+        as itself and passes through."""
+        for name in ("actors", "task_events"):
+            table = getattr(self, name)
+            if not isinstance(table, ShardedTable):
+                setattr(self, name,
+                        ShardedTable.from_mapping(table, name=name))
 
     def _resume_pending(self, source: str):
         # resume interrupted placements: anything not terminal goes back
@@ -177,14 +201,17 @@ class GcsServer:
 
     def _persist(self, table: str, key: bytes, record) -> None:
         """Serialize on the loop thread (consistent view of the record),
-        write on the dedicated store thread (ordered per key — a single
-        writer thread keeps mutation order)."""
+        write on the key's shard-routed store thread (ordered per key —
+        one writer thread per shard keeps mutation order, and writes to
+        different shards no longer queue behind each other)."""
         if self.store is None:
             return
         import pickle
 
         blob = pickle.dumps(record)
-        self._store_pool.submit(self._store_put, table, key, blob)
+        pool = self._store_pools[
+            sharded_table.shard_index(key, len(self._store_pools))]
+        pool.submit(self._store_put, table, key, blob)
 
     def _store_put(self, table, key, blob):
         try:
@@ -195,7 +222,9 @@ class GcsServer:
     def _unpersist(self, table: str, key: bytes) -> None:
         if self.store is None:
             return
-        self._store_pool.submit(self.store.delete, table, key)
+        pool = self._store_pools[
+            sharded_table.shard_index(key, len(self._store_pools))]
+        pool.submit(self.store.delete, table, key)
 
     def _write_snapshot(self):
         self._write_snapshot_bytes(self._serialize_snapshot())
@@ -250,7 +279,11 @@ class GcsServer:
         ]
         for state, count in states.items():
             lines.append(f'gcs_actors{{state="{state}"}} {count}')
-        return "\n".join(lines) + "\n" + scheduling_mod.metrics_text()
+        return ("\n".join(lines) + "\n"
+                + self.actors.metrics_text()
+                + self.task_events.metrics_text()
+                + scheduling_mod.metrics_text()
+                + rpc.metrics_text())
 
     async def start(self, metrics_port: int | None = None):
         self.server.register_all(self)
@@ -457,7 +490,7 @@ class GcsServer:
                     "events": [],
                 }
                 while len(self.task_events) > self._TASK_EVENTS_CAP:
-                    self.task_events.popitem(last=False)
+                    self.task_events.popitem_oldest()
             rec["state"] = state
             rec["events"].append((state, ts))
         return None  # notify-only path
@@ -467,7 +500,7 @@ class GcsServer:
         name = req.get("name")
         state = req.get("state")
         out = []
-        for rec in reversed(self.task_events.values()):
+        for rec in self.task_events.iter_recent():
             if name and rec["name"] != name:
                 continue
             if state and rec["state"] != state:
@@ -476,6 +509,11 @@ class GcsServer:
             if len(out) >= limit:
                 break
         return out
+
+    async def rpc_metrics_text(self, req):
+        """Prometheus text over RPC: lets bench.py and tooling scrape
+        the shard/scheduler counters without a metrics port."""
+        return {"text": self._metrics_text()}
 
     async def rpc_get_cluster_load(self, req):
         """Aggregate demand/idleness snapshot for the autoscaler

@@ -29,6 +29,7 @@ from typing import Dict, List, Optional
 
 from ray_tpu._private import accelerators
 from ray_tpu._private import fault_injection as _fi
+from ray_tpu._private import rpc as rpc_mod
 from ray_tpu._private import task as task_mod
 from ray_tpu._private.config import Config
 from ray_tpu._private.ids import NodeID, ObjectID
@@ -186,11 +187,19 @@ class Raylet:
 
     # ------------------------------------------------------------------
 
+    # lease-cycle counters (attribution: lease churn vs push batching —
+    # the other half of the control-plane scrape next to rpc_coalescing)
+    _leases_granted = 0
+    _workers_returned = 0
+
     def _metrics_text(self) -> str:
         from ray_tpu._private import scheduling as scheduling_mod
 
         stats = self.store.stats()
         lines = [
+            "# TYPE raylet_leases_granted counter",
+            f"raylet_leases_granted {self._leases_granted}",
+            f"raylet_workers_returned {self._workers_returned}",
             "# TYPE raylet_pending_leases gauge",
             f"raylet_pending_leases {len(self._pending)}",
             # alias under the cross-daemon name the flight-recorder
@@ -211,7 +220,8 @@ class Raylet:
         # decision counters — computed at scrape time
         return ("\n".join(lines) + "\n"
                 + self.store.metrics_text()
-                + scheduling_mod.metrics_text())
+                + scheduling_mod.metrics_text()
+                + rpc_mod.metrics_text())
 
     async def start(self, metrics_port: int | None = None):
         self.server.register_all(self)
@@ -1122,6 +1132,7 @@ class Raylet:
         self._startup_failures.pop(key, None)
 
     def _grant(self, lease: Lease, worker: WorkerHandle):
+        self._leases_granted += 1
         lease.worker = worker
         if lease.spec.task_type == task_mod.ACTOR_CREATION_TASK:
             self._actor_workers[worker.worker_id] = lease.spec.actor_id
@@ -1166,6 +1177,7 @@ class Raylet:
         self._idle.setdefault(key, []).append(worker)
 
     async def rpc_return_worker(self, req):
+        self._workers_returned += 1
         lease = self._leases.get(req["lease_id"])
         if lease is None:
             return {"ok": False}
@@ -1560,6 +1572,10 @@ class Raylet:
             freed = await asyncio.get_event_loop().run_in_executor(
                 None, self._spill_up_to, req["needed"])
         return {"freed": freed}
+
+    async def rpc_metrics_text(self, req):
+        """Prometheus text over RPC (same rationale as the GCS twin)."""
+        return {"text": self._metrics_text()}
 
     async def rpc_get_store_stats(self, req):
         return self.store.stats()

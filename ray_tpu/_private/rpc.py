@@ -9,7 +9,20 @@ args, serialized objects) are msgpack `bytes` and are never copied through
 JSON/base64.
 
 Frame format:  u32_be length | msgpack [msgid, kind, method, payload]
-kinds: 0=request 1=reply_ok 2=reply_err 3=notify
+kinds: 0=request 1=reply_ok 2=reply_err 3=notify 4=batch
+
+A BATCH frame carries N logical messages in one wire frame: its payload is a
+list of individually msgpack-packed `[msgid, kind, method, payload]` bodies.
+Both sides run a per-connection write coalescer (`_WriteCoalescer`): the
+first message on a cold connection writes through immediately (serial
+request/response traffic pays no added latency) and opens a one-tick
+window; every message queued within that same event-loop tick — plus a
+size/count watermark — folds into one BATCH frame: one `_pack`, one
+syscall, one drain for N logical messages (reference: gRPC's stream write
+coalescing in `src/ray/rpc/`). A single queued message is emitted as a
+plain frame, byte-identical to the unbatched format. Fault injection (`rpc_send` /
+`rpc_recv`) acts per *logical* message, never per wire frame, so seeded
+FaultPlan replays stay valid with batching on.
 """
 
 from __future__ import annotations
@@ -18,7 +31,7 @@ import asyncio
 import itertools
 import logging
 import traceback
-from typing import Any, Awaitable, Callable, Dict, Optional
+from typing import Any, Awaitable, Callable, Dict, List, Optional
 
 import msgpack
 
@@ -26,7 +39,7 @@ from ray_tpu._private import fault_injection as _fi
 
 logger = logging.getLogger(__name__)
 
-REQUEST, REPLY_OK, REPLY_ERR, NOTIFY = 0, 1, 2, 3
+REQUEST, REPLY_OK, REPLY_ERR, NOTIFY, BATCH = 0, 1, 2, 3, 4
 
 _MAX_FRAME = 1 << 31
 
@@ -51,6 +64,187 @@ async def _read_frame(reader: asyncio.StreamReader):
         raise ValueError(f"frame too large: {length}")
     body = await reader.readexactly(length)
     return msgpack.unpackb(body, raw=False, strict_map_key=False)
+
+
+def _unbatch(bodies: List[bytes]):
+    RPC_STATS.batch_frames_recv += 1
+    RPC_STATS.messages_unbatched += len(bodies)
+    for body in bodies:
+        yield msgpack.unpackb(body, raw=False, strict_map_key=False)
+
+
+class _RpcStats:
+    """Process-wide frame-coalescing counters (every connection feeds the
+    same instance; per-connection figures live on each `_WriteCoalescer`).
+    `messages_sent / frames_sent` is the amortization factor the batching
+    win comes from — scraped through `/metrics` on every daemon and read
+    directly by `bench.py` for attribution."""
+
+    __slots__ = ("messages_sent", "frames_sent", "batches_sent",
+                 "messages_batched", "drain_backoffs", "batch_frames_recv",
+                 "messages_unbatched")
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        for name in self.__slots__:
+            setattr(self, name, 0)
+
+    def as_dict(self) -> Dict[str, int]:
+        return {name: getattr(self, name) for name in self.__slots__}
+
+
+RPC_STATS = _RpcStats()
+
+
+def metrics_text() -> str:
+    s = RPC_STATS
+    lines = ["# TYPE rpc_coalescing counter"]
+    lines += [f"rpc_{name} {getattr(s, name)}" for name in _RpcStats.__slots__]
+    return "\n".join(lines) + "\n"
+
+
+try:  # join every daemon's /metrics scrape (like the channel frame plane)
+    from ray_tpu.util import metrics as _metrics
+
+    _metrics.DEFAULT_REGISTRY.register_callback("rpc_coalescing", metrics_text)
+except Exception:  # noqa: BLE001 — metrics are never load-bearing
+    pass
+
+
+def _batch_knobs():
+    from ray_tpu._private.config import global_config
+
+    cfg = global_config()
+    return (max(1, cfg.rpc_batch_max_msgs), cfg.rpc_batch_max_bytes,
+            cfg.rpc_send_high_watermark)
+
+
+class _WriteCoalescer:
+    """Per-connection write-side coalescer. Loop-thread only.
+
+    Write-through first: on a cold connection (nothing queued, no open
+    tick window) the message is written immediately as a plain frame —
+    zero added latency for serial request/response traffic — and a
+    one-tick window opens; every message sent within that same
+    event-loop tick queues behind it and flushes as one BATCH frame on
+    the next tick (`call_soon`). Crossing the count or byte watermark
+    flushes immediately. The flush itself never runs under a lock — the
+    timer-started flush pattern from PR-2's pubsub batching fix. When
+    the transport buffer crosses the high-watermark the coalescer stops
+    writing and parks behind one awaited `drain()` (backpressure: a
+    slow peer queues messages here instead of growing the kernel send
+    buffer unboundedly); awaited senders can additionally park in
+    `send_wait()` until the drain clears."""
+
+    def __init__(self, writer: asyncio.StreamWriter):
+        self._writer = writer
+        self._loop = asyncio.get_event_loop()
+        self._max_msgs, self._max_bytes, self._high_watermark = _batch_knobs()
+        self._pending: List[bytes] = []
+        self._pending_bytes = 0
+        self._scheduled = False
+        self._tick_open = False
+        self._draining = False
+        self._drain_waiters: List[asyncio.Future] = []
+        # per-connection coalescing counters (aggregate lives in RPC_STATS)
+        self.messages_sent = 0
+        self.frames_sent = 0
+        self.batches_sent = 0
+
+    def send(self, msg) -> None:
+        """Queue one logical `[msgid, kind, method, payload]` message."""
+        body = msgpack.packb(msg, use_bin_type=True)
+        self.messages_sent += 1
+        RPC_STATS.messages_sent += 1
+        if (not self._pending and not self._tick_open and not self._draining
+                and not self._writer.is_closing()):
+            # cold connection: write through — serial round trips pay no
+            # coalescing latency; same-tick followers batch behind this
+            self._writer.write(len(body).to_bytes(4, "big") + body)
+            self.frames_sent += 1
+            RPC_STATS.frames_sent += 1
+            self._tick_open = True
+            self._loop.call_soon(self._close_tick)
+            self._check_watermark()
+            return
+        self._pending.append(body)
+        self._pending_bytes += len(body)
+        if (len(self._pending) >= self._max_msgs
+                or self._pending_bytes >= self._max_bytes):
+            self._flush()
+        elif not self._scheduled:
+            self._scheduled = True
+            self._loop.call_soon(self._tick_flush)
+
+    def _close_tick(self):
+        self._tick_open = False
+
+    def _tick_flush(self):
+        self._scheduled = False
+        self._flush()
+
+    def _flush(self):
+        if not self._pending or self._draining:
+            return  # draining: the drain task re-flushes when it clears
+        if self._writer.is_closing():
+            self._pending.clear()
+            self._pending_bytes = 0
+            return
+        bodies, self._pending = self._pending, []
+        self._pending_bytes = 0
+        if len(bodies) == 1:
+            body = bodies[0]  # plain frame — byte-identical to unbatched
+            self._writer.write(len(body).to_bytes(4, "big") + body)
+        else:
+            self._writer.write(_pack([0, BATCH, "", bodies]))
+            self.batches_sent += 1
+            RPC_STATS.batches_sent += 1
+            RPC_STATS.messages_batched += len(bodies)
+        self.frames_sent += 1
+        RPC_STATS.frames_sent += 1
+        self._check_watermark()
+
+    def _check_watermark(self):
+        transport = self._writer.transport
+        if (transport is not None
+                and transport.get_write_buffer_size() > self._high_watermark):
+            self._draining = True
+            RPC_STATS.drain_backoffs += 1
+            asyncio.ensure_future(self._drain_then_flush())
+
+    async def _drain_then_flush(self):
+        try:
+            await self._writer.drain()
+        except (ConnectionResetError, BrokenPipeError, OSError):
+            pass
+        self._draining = False
+        for fut in self._drain_waiters:
+            if not fut.done():
+                fut.set_result(None)
+        self._drain_waiters.clear()
+        self._flush()
+
+    async def wait_drained(self) -> None:
+        """Park until an in-progress backpressure drain clears."""
+        while self._draining:
+            fut = self._loop.create_future()
+            self._drain_waiters.append(fut)
+            await fut
+
+    async def send_wait(self, msg) -> None:
+        """Awaited variant: when the connection is parked behind a drain,
+        wait for it to clear before queueing (backpressure for `call` /
+        `notify` / server replies)."""
+        if self._draining:
+            await self.wait_drained()
+        self.send(msg)
+
+    def flush_now(self) -> None:
+        """Best-effort synchronous flush (connection teardown)."""
+        self._draining = False
+        self._flush()
 
 
 class RpcServer:
@@ -95,27 +289,30 @@ class RpcServer:
             await self._server.wait_closed()
 
     async def _handle_conn(self, reader, writer):
-        write_lock = asyncio.Lock()
+        coal = _WriteCoalescer(writer)
         conn_task = asyncio.current_task()
         self._conn_tasks.add(conn_task)
         try:
             while True:
                 try:
-                    msgid, kind, method, payload = await _read_frame(reader)
+                    msg = await _read_frame(reader)
                 except (asyncio.IncompleteReadError, ConnectionResetError):
                     return
-                task = asyncio.ensure_future(
-                    self._dispatch(msgid, kind, method, payload, writer, write_lock)
-                )
-                self._conn_tasks.add(task)
-                task.add_done_callback(self._conn_tasks.discard)
+                msgs = _unbatch(msg[3]) if msg[1] == BATCH else (msg,)
+                for msgid, kind, method, payload in msgs:
+                    task = asyncio.ensure_future(
+                        self._dispatch(msgid, kind, method, payload, coal)
+                    )
+                    self._conn_tasks.add(task)
+                    task.add_done_callback(self._conn_tasks.discard)
         except asyncio.CancelledError:
             pass
         finally:
             self._conn_tasks.discard(conn_task)
+            coal.flush_now()
             writer.close()
 
-    async def _dispatch(self, msgid, kind, method, payload, writer, write_lock):
+    async def _dispatch(self, msgid, kind, method, payload, coal):
         handler = self._handlers.get(method)
         try:
             if handler is None:
@@ -131,9 +328,11 @@ class RpcServer:
             reply = [msgid, REPLY_ERR, method, traceback.format_exc()]
         if kind == REQUEST:
             try:
-                async with write_lock:
-                    writer.write(_pack(reply))
-                    await writer.drain()
+                # replies completing in the same tick re-batch into one
+                # frame; only the backpressured path pays an await
+                if coal._draining:
+                    await coal.wait_drained()
+                coal.send(reply)
             except (ConnectionResetError, BrokenPipeError):
                 pass
 
@@ -151,7 +350,7 @@ class RpcClient:
         self._pending: Dict[int, asyncio.Future] = {}
         self._msgid = itertools.count(1)
         self._read_task = None
-        self._lock = asyncio.Lock()
+        self._coal: Optional[_WriteCoalescer] = None
         self._closed = False
         self._dead = False  # read loop saw EOF/reset — no replies can come
 
@@ -167,27 +366,46 @@ class RpcClient:
                 if asyncio.get_event_loop().time() > deadline:
                     raise
                 await asyncio.sleep(0.05)
+        self._coal = _WriteCoalescer(self._writer)
         self._read_task = asyncio.ensure_future(self._read_loop())
         return self
+
+    def _resolve(self, msgid, kind, payload) -> None:
+        fut = self._pending.pop(msgid, None)
+        if fut is None or fut.done():
+            return
+        if kind == REPLY_OK:
+            fut.set_result(payload)
+        else:
+            fut.set_exception(RpcError(payload))
+
+    async def _deliver(self, msgid, kind, method, payload):
+        # recv faults act per logical reply, even inside a BATCH frame
+        if _fi._PLAN is not None:
+            act = _fi._PLAN.rpc_recv(method)
+            if act is not None:
+                if act[1]:
+                    await asyncio.sleep(act[1])  # delayed delivery
+                if act[0]:
+                    return  # reply lost on the wire
+        self._resolve(msgid, kind, payload)
 
     async def _read_loop(self):
         try:
             while True:
-                msgid, kind, method, payload = await _read_frame(self._reader)
-                if _fi._PLAN is not None:
-                    act = _fi._PLAN.rpc_recv(method)
-                    if act is not None:
-                        if act[1]:
-                            await asyncio.sleep(act[1])  # delayed delivery
-                        if act[0]:
-                            continue  # reply lost on the wire
-                fut = self._pending.pop(msgid, None)
-                if fut is None or fut.done():
-                    continue
-                if kind == REPLY_OK:
-                    fut.set_result(payload)
+                msg = await _read_frame(self._reader)
+                # fault-free fast path resolves inline — one coroutine
+                # per reply is measurable at control-plane rates
+                if msg[1] == BATCH:
+                    for m in _unbatch(msg[3]):
+                        if _fi._PLAN is not None:
+                            await self._deliver(*m)
+                        else:
+                            self._resolve(m[0], m[1], m[3])
+                elif _fi._PLAN is not None:
+                    await self._deliver(*msg)
                 else:
-                    fut.set_exception(RpcError(payload))
+                    self._resolve(msg[0], msg[1], msg[3])
         except (asyncio.IncompleteReadError, ConnectionResetError, OSError,
                 asyncio.CancelledError):
             # the peer is gone: no reply will EVER arrive on this
@@ -207,7 +425,8 @@ class RpcClient:
         msgid = next(self._msgid)
         fut = asyncio.get_event_loop().create_future()
         self._pending[msgid] = fut
-        frame = _pack([msgid, REQUEST, method, payload])
+        msg = [msgid, REQUEST, method, payload]
+        dup = False
         if _fi._PLAN is not None:
             act = _fi._PLAN.rpc_send(method)
             if act is not None:
@@ -215,51 +434,59 @@ class RpcClient:
                 if delay:
                     await asyncio.sleep(delay)
                 if drop:
-                    frame = b""  # request lost: the pending future only
-                    # resolves via the caller's timeout / retry machinery
-                elif dup:
-                    frame = frame + frame  # at-least-once duplication;
-                    # the second reply's msgid is already popped, ignored
-        if frame:
-            async with self._lock:
-                self._writer.write(frame)
-                await self._writer.drain()
+                    # request lost: the pending future only resolves via
+                    # the caller's timeout / retry machinery
+                    if timeout is None:
+                        return await fut
+                    return await asyncio.wait_for(fut, timeout)
+        coal = self._coal
+        if coal._draining:
+            await coal.wait_drained()
+        coal.send(msg)
+        if dup:
+            # at-least-once duplication; the second reply's msgid is
+            # already popped, ignored
+            coal.send(msg)
         if timeout is None:
             return await fut
         return await asyncio.wait_for(fut, timeout)
 
     def call_nowait(self, method: str, payload: Any = None) -> asyncio.Future:
-        """Loop-thread-only fast path: write the request frame synchronously
-        (StreamWriter.write appends a whole frame atomically, so no lock and
-        no drain round-trip) and return the pending reply future."""
+        """Loop-thread-only fast path: queue the request on the write
+        coalescer synchronously (no drain round-trip; the coalescer's
+        transport high-watermark supplies backpressure) and return the
+        pending reply future."""
         if self._writer is None or self._dead:
             raise ConnectionLost(f"not connected: {self.address}")
         msgid = next(self._msgid)
         fut = asyncio.get_event_loop().create_future()
         self._pending[msgid] = fut
-        frame = _pack([msgid, REQUEST, method, payload])
+        msg = [msgid, REQUEST, method, payload]
         if _fi._PLAN is not None:
             act = _fi._PLAN.rpc_send(method)
             if act is not None:
                 drop, dup, delay = act
                 if drop:
                     return fut  # lost: resolves via caller timeout/retry
-                if dup:
-                    frame = frame + frame
                 if delay:
-                    # sync fast path cannot await: reschedule the write
-                    def _late_write(w=self._writer, f=frame):
-                        if not w.is_closing():
-                            w.write(f)
-                    asyncio.get_event_loop().call_later(delay, _late_write)
+                    # sync fast path cannot await: reschedule the queueing
+                    def _late_send(c=self._coal, m=msg, d=dup):
+                        if not c._writer.is_closing():
+                            c.send(m)
+                            if d:
+                                c.send(m)
+                    asyncio.get_event_loop().call_later(delay, _late_send)
                     return fut
-        self._writer.write(frame)
+                if dup:
+                    self._coal.send(msg)
+        self._coal.send(msg)
         return fut
 
     async def notify(self, method: str, payload: Any = None):
         if self._writer is None or self._dead:
             raise ConnectionLost(f"not connected: {self.address}")
-        frame = _pack([0, NOTIFY, method, payload])
+        msg = [0, NOTIFY, method, payload]
+        dup = False
         if _fi._PLAN is not None:
             act = _fi._PLAN.rpc_send(method)
             if act is not None:
@@ -267,17 +494,20 @@ class RpcClient:
                 if delay:
                     await asyncio.sleep(delay)
                 if drop:
-                    return  # fire-and-forget frame lost entirely
-                if dup:
-                    frame = frame + frame
-        async with self._lock:
-            self._writer.write(frame)
-            await self._writer.drain()
+                    return  # fire-and-forget message lost entirely
+        coal = self._coal
+        if coal._draining:
+            await coal.wait_drained()
+        coal.send(msg)
+        if dup:
+            coal.send(msg)
 
     async def close(self):
         self._closed = True
         if self._read_task:
             self._read_task.cancel()
+        if self._coal:
+            self._coal.flush_now()
         if self._writer:
             self._writer.close()
 
@@ -367,6 +597,10 @@ class ClientPool:
             asyncio.ensure_future(client.close())
 
     async def close_all(self):
-        for client in self._clients.values():
+        # snapshot first: an invalidate() racing with shutdown would
+        # otherwise mutate the dict mid-iteration; drop the per-address
+        # connect locks too (the dict grows forever on a churning pool)
+        clients, self._clients = list(self._clients.values()), {}
+        self._locks.clear()
+        for client in clients:
             await client.close()
-        self._clients.clear()

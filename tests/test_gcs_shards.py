@@ -1,0 +1,218 @@
+"""Sharded GCS tables: concurrent register/list consistency.
+
+The actor directory and the bounded task-event log are `ShardedTable`s
+(keyed shards + per-shard counters + shard-routed write-through
+persistence). These tests pin the dict contract the GCS code relies on,
+the recency/cap semantics the task-event log needs, and end-to-end
+consistency when many clients register and list concurrently over RPC.
+"""
+
+import asyncio
+import os
+import pickle
+
+import pytest
+
+from ray_tpu._private import task as task_mod
+from ray_tpu._private.gcs import GcsServer
+from ray_tpu._private.rpc import RpcClient
+from ray_tpu._private.sharded_table import ShardedTable, shard_index
+
+
+@pytest.fixture
+def loop():
+    loop = asyncio.new_event_loop()
+    yield loop
+    loop.close()
+
+
+# ---------------------------------------------------------------------------
+# table semantics
+# ---------------------------------------------------------------------------
+
+
+def test_sharded_table_dict_contract():
+    t = ShardedTable(name="t")
+    keys = [os.urandom(16) for _ in range(256)]
+    for i, k in enumerate(keys):
+        t[k] = {"i": i}
+    assert len(t) == 256
+    assert set(t) == set(keys)
+    assert t[keys[3]] == {"i": 3}
+    assert t.get(b"\x00missing") is None
+    assert keys[5] in t
+    t.pop(keys[5])
+    assert keys[5] not in t and len(t) == 255
+    # every key routes to the same shard every time
+    for k in keys:
+        assert shard_index(k, t.num_shards) == t.shard_of(k)
+    assert sum(t.shard_sizes()) == 255
+    assert sum(t.shard_ops()) >= 256
+
+
+def test_sharded_table_recency_and_eviction():
+    t = ShardedTable(name="ev")
+    keys = [os.urandom(16) for _ in range(100)]
+    for i, k in enumerate(keys):
+        t[k] = i
+    # newest-first across shards
+    assert list(t.iter_recent()) == list(range(99, -1, -1))
+    # global-oldest eviction, regardless of which shard holds it
+    for expect in range(10):
+        _, v = t.popitem_oldest()
+        assert v == expect
+    # an update does not change recency bookkeeping's membership
+    t[keys[50]] = "updated"
+    assert len(t) == 90
+
+
+def test_sharded_table_pickle_roundtrip_preserves_recency():
+    t = ShardedTable(name="snap")
+    keys = [os.urandom(16) for _ in range(64)]
+    for i, k in enumerate(keys):
+        t[k] = i
+    t2 = pickle.loads(pickle.dumps(t))
+    assert isinstance(t2, ShardedTable)
+    assert dict(t2) == dict(t)
+    assert list(t2.iter_recent()) == list(t.iter_recent())
+    _, oldest = t2.popitem_oldest()
+    assert oldest == 0
+
+
+def test_from_mapping_wraps_plain_dict():
+    plain = {os.urandom(16): i for i in range(32)}
+    t = ShardedTable.from_mapping(plain, name="restored")
+    assert dict(t) == plain
+    assert list(t.iter_recent())[-1] == 0  # insertion order = recency
+
+
+# ---------------------------------------------------------------------------
+# GCS end-to-end: concurrent registration + listing over RPC
+# ---------------------------------------------------------------------------
+
+
+def _creation_spec(i: int) -> dict:
+    return task_mod.TaskSpec(
+        task_id=os.urandom(16),
+        job_id=b"job0",
+        name=f"Actor{i}",
+        task_type=task_mod.ACTOR_CREATION_TASK,
+        owner_addr="127.0.0.1:0",
+        owner_worker_id=b"w0",
+        actor_id=os.urandom(16),
+        resources={"CPU": 1.0},
+    ).to_wire()
+
+
+def test_gcs_concurrent_register_and_list(loop):
+    """N clients registering actors while others list must observe a
+    consistent directory: every registration lands exactly once and the
+    per-shard counters account for all of them."""
+
+    async def main():
+        gcs = GcsServer()
+        await gcs.server.start()
+        gcs.server.register_all(gcs)
+        clients = [await RpcClient(gcs.server.address).connect()
+                   for _ in range(4)]
+        n_per_client = 50
+
+        async def register_burst(client):
+            return await asyncio.gather(*[
+                client.call("register_actor", {"spec": _creation_spec(i)})
+                for i in range(n_per_client)])
+
+        async def list_loop(client):
+            listings = []
+            for _ in range(10):
+                listings.append(await client.call("list_actors", {}))
+                await asyncio.sleep(0)
+            return listings
+
+        results = await asyncio.gather(
+            register_burst(clients[0]), register_burst(clients[1]),
+            register_burst(clients[2]), list_loop(clients[3]))
+        for replies in results[:3]:
+            assert all(r["ok"] for r in replies)
+        final = await clients[3].call("list_actors", {})
+        assert len(final) == 3 * n_per_client
+        assert len({a["actor_id"] for a in final}) == 3 * n_per_client
+        # interleaved listings saw monotonically growing prefixes
+        sizes = [len(l) for l in results[3]]
+        assert sizes == sorted(sizes)
+        # shard accounting covers the whole directory
+        assert sum(gcs.actors.shard_sizes()) == 3 * n_per_client
+        text = gcs._metrics_text()
+        assert 'gcs_table_shard_size{table="actors"' in text
+        assert 'gcs_table_shard_ops{table="task_events"' in text
+        for c in clients:
+            await c.close()
+        await gcs.server.stop()
+
+    loop.run_until_complete(main())
+
+
+def test_gcs_task_events_sharded_cap_and_recency(loop):
+    """Event ingestion through the vectorized add_task_events handler:
+    the bounded log evicts globally-oldest and lists newest-first across
+    shards."""
+
+    async def main():
+        gcs = GcsServer()
+        gcs._TASK_EVENTS_CAP = 100  # shrink the cap for the test
+        await gcs.server.start()
+        gcs.server.register_all(gcs)
+        client = await RpcClient(gcs.server.address).connect()
+        ids = [os.urandom(16) for _ in range(150)]
+        # two list payloads (one decode + one pass each), overlapping
+        await client.call("add_task_events", {"events": [
+            (tid, f"task{i}", "NORMAL_TASK", "RUNNING", float(i))
+            for i, tid in enumerate(ids[:100])]})
+        await client.call("add_task_events", {"events": [
+            (tid, f"task{i + 100}", "NORMAL_TASK", "FINISHED",
+             float(i + 100)) for i, tid in enumerate(ids[100:])]})
+        assert len(gcs.task_events) == 100  # cap held
+        listed = await client.call("list_task_events", {"limit": 1000})
+        # newest-first: the most recent insertion leads
+        assert listed[0]["name"] == "task149"
+        names = [r["name"] for r in listed]
+        assert names == [f"task{i}" for i in range(149, 49, -1)]
+        await client.close()
+        await gcs.server.stop()
+
+    loop.run_until_complete(main())
+
+
+def test_gcs_snapshot_roundtrip_with_sharded_tables(tmp_path, loop):
+    """Snapshot → restart keeps sharded tables sharded (and a plain-dict
+    snapshot from before sharding still loads via the rewrap path)."""
+
+    async def main():
+        path = str(tmp_path / "gcs_snapshot.pkl")
+        gcs = GcsServer(persist_path=path)
+        await gcs.server.start()
+        gcs.server.register_all(gcs)
+        client = await RpcClient(gcs.server.address).connect()
+        for i in range(20):
+            await client.call("register_actor", {"spec": _creation_spec(i)})
+        gcs._write_snapshot()
+        await client.close()
+        await gcs.server.stop()
+
+        revived = GcsServer(persist_path=path)
+        assert isinstance(revived.actors, ShardedTable)
+        assert len(revived.actors) == 20
+        assert len(revived._pending_actors) == 20  # PENDING resumes
+
+        # pre-shard snapshot shape: plain dicts get rewrapped on load
+        legacy = {name: (dict(getattr(revived, name))
+                         if name in ("actors", "task_events")
+                         else getattr(revived, name))
+                  for name in GcsServer._SNAPSHOT_TABLES}
+        with open(path, "wb") as f:
+            pickle.dump(legacy, f)
+        revived2 = GcsServer(persist_path=path)
+        assert isinstance(revived2.actors, ShardedTable)
+        assert len(revived2.actors) == 20
+
+    loop.run_until_complete(main())

@@ -260,6 +260,89 @@ class TestBlockingUnderLock:
         assert "blocking-under-lock" not in checks_of(findings)
 
 
+class TestCoalescerPattern:
+    """The write-coalescer idiom (`rpc._WriteCoalescer`, same shape as
+    PR-2's pubsub batching fix): enqueue under the lock, flush started
+    by a timer / loop callback and draining OUTSIDE any lock. The good
+    twin must stay silent; folding the blocking drain back under the
+    lock must flag — that exact regression is what these fixtures pin.
+    """
+
+    def test_timer_started_flush_outside_lock_clean(self):
+        findings = run("""
+            import threading
+
+            class Coalescer:
+                def __init__(self, writer):
+                    self._lock = threading.Lock()
+                    self._writer = writer
+                    self._pending = []
+                    self._timer = None
+
+                def send(self, body):
+                    with self._lock:
+                        self._pending.append(body)
+                        if self._timer is None:
+                            self._timer = threading.Timer(
+                                0.005, self._flush)
+                            self._timer.start()
+
+                def _flush(self):
+                    with self._lock:
+                        batch, self._pending = self._pending, []
+                        self._timer = None
+                    # the drain round-trip happens outside the lock
+                    self._writer.write_batch(batch).result()
+        """)
+        assert "blocking-under-lock" not in checks_of(findings), findings
+        assert "lock-discipline" not in checks_of(findings), findings
+
+    def test_flush_under_lock_flagged(self):
+        # the regression PR-2 fixed: drain performed while still
+        # holding the enqueue lock — every sender stalls behind I/O
+        findings = run("""
+            import threading
+
+            class Coalescer:
+                def __init__(self, writer):
+                    self._lock = threading.Lock()
+                    self._writer = writer
+                    self._pending = []
+
+                def send(self, body):
+                    with self._lock:
+                        self._pending.append(body)
+                        self._flush()
+
+                def _flush(self):
+                    batch, self._pending = self._pending, []
+                    self._writer.write_batch(batch).result()
+        """)
+        hit = [f for f in findings if f.check == "blocking-under-lock"
+               and f.scope == "Coalescer.send"]
+        assert hit and "Coalescer._flush" in hit[0].message, findings
+
+    def test_blocking_drain_inline_under_lock_flagged(self):
+        findings = run("""
+            import threading
+            import time
+
+            class Coalescer:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._pending = []
+
+                def send(self, body):
+                    with self._lock:
+                        self._pending.append(body)
+                        time.sleep(0.005)  # "wait for batchmates"
+        """)
+        assert any(f.check == "blocking-under-lock"
+                   and f.detail == "time.sleep"
+                   and f.scope == "Coalescer.send"
+                   for f in findings), findings
+
+
 # ---------------------------------------------------------------------------
 # checker 3: jit-purity
 # ---------------------------------------------------------------------------

@@ -1,0 +1,405 @@
+"""Batched RPC frames: coalescing, demux, per-logical-message faults.
+
+The write coalescer (`rpc._WriteCoalescer`) writes the first message on
+a cold connection straight through, then folds everything else queued
+within the same event-loop tick into a single BATCH wire frame. These
+tests
+pin the contract the rest of the stack leans on: logical-message
+ordering and reply demux survive batching, fault injection keeps acting
+per logical message (seeded FaultPlan replays stay valid), the
+high-watermark backpressure engages, and `ClientPool.close_all()`
+survives an `invalidate()` racing with shutdown.
+
+This module is listed in conftest's `_LOCKDEP_SUITES`, so everything
+here also runs under the runtime lock-order validator.
+"""
+
+import asyncio
+
+import pytest
+
+from ray_tpu._private import fault_injection as _fi
+from ray_tpu._private import rpc
+from ray_tpu._private.config import global_config
+from ray_tpu._private.rpc import ClientPool, RpcClient, RpcServer
+
+
+@pytest.fixture
+def loop():
+    loop = asyncio.new_event_loop()
+    yield loop
+    loop.close()
+
+
+@pytest.fixture
+def no_plan():
+    """Make sure no fault plan leaks between tests."""
+    _fi.uninstall()
+    yield
+    _fi.uninstall()
+
+
+def _echo_server():
+    server = RpcServer()
+    received = []
+
+    async def echo(payload):
+        received.append(payload["i"])
+        return payload["i"]
+
+    server.register("echo", echo)
+    return server, received
+
+
+# ---------------------------------------------------------------------------
+# coalescing + ordering + demux
+# ---------------------------------------------------------------------------
+
+
+def test_batch_roundtrip_ordering_and_demux(loop, no_plan):
+    """N concurrent callers in one tick share wire frames; every caller
+    gets its own reply back and the server sees submission order."""
+
+    async def main():
+        server, received = _echo_server()
+        await server.start()
+        client = await RpcClient(server.address).connect()
+        n = 200
+        results = await asyncio.gather(
+            *[client.call("echo", {"i": i}) for i in range(n)])
+        assert results == list(range(n))        # reply demux
+        assert received == list(range(n))       # arrival order = send order
+        # the burst actually coalesced (one frame would have sufficed for
+        # each tick's worth of messages)
+        assert client._coal.batches_sent >= 1
+        assert client._coal.frames_sent < n
+        await client.close()
+        await server.stop()
+
+    loop.run_until_complete(main())
+
+
+def test_call_nowait_single_tick_two_frames(loop, no_plan):
+    """call_nowait bursts issued in one tick: the first message writes
+    through (cold connection, no latency), the 63 followers ride one
+    BATCH frame."""
+
+    async def main():
+        server, _ = _echo_server()
+        await server.start()
+        client = await RpcClient(server.address).connect()
+        futs = [client.call_nowait("echo", {"i": i}) for i in range(64)]
+        results = await asyncio.gather(*futs)
+        assert results == list(range(64))
+        assert client._coal.frames_sent == 2
+        assert client._coal.batches_sent == 1
+        await client.close()
+        await server.stop()
+
+    loop.run_until_complete(main())
+
+
+def test_single_message_stays_plain_frame(loop, no_plan):
+    """A lone message is emitted as a plain frame — byte-identical wire
+    format to the pre-BATCH protocol, no batch overhead."""
+
+    async def main():
+        server, _ = _echo_server()
+        await server.start()
+        client = await RpcClient(server.address).connect()
+        assert await client.call("echo", {"i": 7}) == 7
+        assert client._coal.batches_sent == 0
+        assert client._coal.frames_sent == 1
+        await client.close()
+        await server.stop()
+
+    loop.run_until_complete(main())
+
+
+def test_reply_rebatching_on_server_tick(loop, no_plan):
+    """Replies completing in the same tick re-batch: a call_nowait burst
+    handled by a trivial handler produces fewer reply frames than
+    replies (visible through the global receive-side counters)."""
+
+    async def main():
+        before = rpc.RPC_STATS.batch_frames_recv
+        server, _ = _echo_server()
+        await server.start()
+        client = await RpcClient(server.address).connect()
+        futs = [client.call_nowait("echo", {"i": i}) for i in range(32)]
+        await asyncio.gather(*futs)
+        # the client decoded at least one batched reply frame
+        assert rpc.RPC_STATS.batch_frames_recv > before
+        await client.close()
+        await server.stop()
+
+    loop.run_until_complete(main())
+
+
+def test_oversize_burst_flushes_on_watermark(loop, no_plan):
+    """Crossing the byte watermark flushes immediately instead of
+    growing one giant frame."""
+
+    async def main():
+        server = RpcServer()
+
+        async def size(payload):
+            return len(payload)
+
+        server.register("size", size)
+        await server.start()
+        client = await RpcClient(server.address).connect()
+        blob = b"x" * (global_config().rpc_batch_max_bytes // 2)
+        futs = [client.call_nowait("size", blob) for _ in range(8)]
+        results = await asyncio.gather(*futs)
+        assert results == [len(blob)] * 8
+        # watermark split the burst across several frames
+        assert client._coal.frames_sent >= 4
+        await client.close()
+        await server.stop()
+
+    loop.run_until_complete(main())
+
+
+# ---------------------------------------------------------------------------
+# fault injection: per-logical-message semantics + replay determinism
+# ---------------------------------------------------------------------------
+
+
+def _run_send_drop_burst(loop, seed, n=48):
+    """Fire a one-tick call_nowait burst under a seeded drop plan; return
+    (set of dropped indices, recorded schedule)."""
+
+    async def main():
+        plan = _fi.install(_fi.FaultPlan(
+            f"seed={seed};rpc_drop=0.4;rpc_match=echo"))
+        try:
+            server, received = _echo_server()
+            await server.start()
+            client = await RpcClient(server.address).connect()
+            futs = [client.call_nowait("echo", {"i": i}) for i in range(n)]
+            done, pending = await asyncio.wait(
+                [asyncio.ensure_future(f) for f in futs], timeout=1.0)
+            dropped = {i for i, f in enumerate(futs) if not f.done()}
+            for f in pending:
+                f.cancel()
+            # surviving messages all round-tripped, in order
+            alive = [i for i in range(n) if i not in dropped]
+            assert received == alive
+            await client.close()
+            await server.stop()
+            return dropped, list(plan.schedule)
+        finally:
+            _fi.uninstall()
+
+    return loop.run_until_complete(main())
+
+
+def test_send_faults_act_per_logical_message(loop, no_plan):
+    """Messages sharing a BATCH frame are dropped individually — a drop
+    never takes down its batchmates."""
+    dropped, _ = _run_send_drop_burst(loop, seed=7)
+    assert dropped, "seeded plan must drop something at p=0.4"
+    assert len(dropped) < 48, "a dropped message must not kill the batch"
+
+
+def test_send_fault_replay_is_deterministic(loop, no_plan):
+    """Same seed → identical per-message fault schedule, with batching
+    on: the coalescer must not perturb the per-site draw order."""
+    d1, s1 = _run_send_drop_burst(loop, seed=1234)
+    d2, s2 = _run_send_drop_burst(loop, seed=1234)
+    assert d1 == d2
+    assert s1 == s2
+    d3, _ = _run_send_drop_burst(loop, seed=4321)
+    assert d3 != d1, "different seed should produce a different schedule"
+
+
+def test_dup_duplicates_one_logical_message(loop, no_plan):
+    """rpc_dup duplicates the logical message inside the batch: the
+    handler runs twice, the caller still resolves exactly once."""
+
+    async def main():
+        _fi.install(_fi.FaultPlan("seed=1;rpc_dup=1.0;rpc_match=echo"))
+        try:
+            server, received = _echo_server()
+            await server.start()
+            client = await RpcClient(server.address).connect()
+            futs = [client.call_nowait("echo", {"i": i}) for i in range(8)]
+            results = await asyncio.gather(*futs)
+            assert results == list(range(8))
+            assert len(received) == 16  # every message executed twice
+            await client.close()
+            await server.stop()
+        finally:
+            _fi.uninstall()
+
+    loop.run_until_complete(main())
+
+
+def test_send_delay_defers_one_logical_message(loop, no_plan):
+    """A delayed message leaves its batchmates' tick; everything still
+    arrives and resolves."""
+
+    async def main():
+        _fi.install(_fi.FaultPlan(
+            "seed=1;rpc_delay=0.5:0.05;rpc_match=echo"))
+        try:
+            server, received = _echo_server()
+            await server.start()
+            client = await RpcClient(server.address).connect()
+            futs = [client.call_nowait("echo", {"i": i}) for i in range(16)]
+            results = await asyncio.gather(*futs)
+            assert results == list(range(16))
+            assert sorted(received) == list(range(16))
+            await client.close()
+            await server.stop()
+        finally:
+            _fi.uninstall()
+
+    loop.run_until_complete(main())
+
+
+def test_recv_faults_act_per_logical_reply(loop, no_plan):
+    """Replies riding one BATCH frame are dropped individually, and the
+    drop pattern replays under the same seed."""
+
+    def run(seed):
+        async def main():
+            plan = _fi.install(_fi.FaultPlan(
+                f"seed={seed};rpc_recv_drop=0.4;rpc_match=echo"))
+            try:
+                server, _ = _echo_server()
+                await server.start()
+                client = await RpcClient(server.address).connect()
+                futs = [client.call_nowait("echo", {"i": i})
+                        for i in range(48)]
+                await asyncio.wait(
+                    [asyncio.ensure_future(f) for f in futs], timeout=1.0)
+                lost = frozenset(
+                    i for i, f in enumerate(futs) if not f.done())
+                for f in futs:
+                    if not f.done():
+                        f.cancel()
+                await client.close()
+                await server.stop()
+                return lost, list(plan.schedule)
+            finally:
+                _fi.uninstall()
+
+        return loop.run_until_complete(main())
+
+    lost1, sched1 = run(99)
+    lost2, sched2 = run(99)
+    assert lost1, "seeded recv-drop plan must lose some replies"
+    assert len(lost1) < 48, "one lost reply must not kill the batch"
+    assert lost1 == lost2
+    assert sched1 == sched2
+
+
+# ---------------------------------------------------------------------------
+# backpressure + pool shutdown
+# ---------------------------------------------------------------------------
+
+
+class _FakeTransport:
+    """Transport double whose buffer only shrinks on drain() — models a
+    peer that stopped reading."""
+
+    def __init__(self):
+        self.buffered = 0
+
+    def get_write_buffer_size(self):
+        return self.buffered
+
+
+class _FakeWriter:
+    """Writer double whose drain() blocks until the test releases it —
+    models a peer that stopped reading."""
+
+    def __init__(self):
+        self.transport = _FakeTransport()
+        self.frames = []
+        self.drains = 0
+        self.release = asyncio.Event()
+
+    def write(self, data: bytes):
+        self.frames.append(data)
+        self.transport.buffered += len(data)
+
+    def is_closing(self):
+        return False
+
+    async def drain(self):
+        self.drains += 1
+        await self.release.wait()
+        self.transport.buffered = 0
+
+
+def test_high_watermark_backpressure(loop, no_plan):
+    """Once the transport buffer crosses the high-watermark the
+    coalescer stops writing and falls back to one awaited drain();
+    awaited senders park until it clears, then everything goes out."""
+
+    async def main():
+        cfg = global_config()
+        old = cfg.rpc_send_high_watermark
+        cfg.rpc_send_high_watermark = 1024
+        before = rpc.RPC_STATS.drain_backoffs
+        try:
+            writer = _FakeWriter()
+            coal = rpc._WriteCoalescer(writer)
+            blob = b"y" * 2048
+            coal.send([1, rpc.REQUEST, "sink", blob])
+            # over the watermark: the coalescer is parked behind a drain
+            assert rpc.RPC_STATS.drain_backoffs == before + 1
+            assert len(writer.frames) == 1
+            # senders park behind the drain instead of writing
+            sends = [asyncio.ensure_future(
+                coal.send_wait([2 + i, rpc.REQUEST, "sink", b"z"]))
+                for i in range(4)]
+            await asyncio.sleep(0.01)
+            assert len(writer.frames) == 1
+            assert not any(s.done() for s in sends)
+            # peer reads again: drain clears, parked senders release —
+            # the first writes through, its same-tick followers batch
+            writer.release.set()
+            await asyncio.sleep(0.01)
+            assert writer.drains == 1
+            assert all(s.done() for s in sends)
+            assert len(writer.frames) == 3
+            assert coal.messages_sent == 5
+            assert coal.batches_sent == 1
+        finally:
+            cfg.rpc_send_high_watermark = old
+
+    loop.run_until_complete(main())
+
+
+def test_close_all_survives_racing_invalidate(loop, no_plan):
+    """An invalidate() landing while close_all() iterates must not blow
+    up the iteration, and the per-address lock table is dropped."""
+
+    async def main():
+        s1, _ = _echo_server()
+        s2, _ = _echo_server()
+        await s1.start()
+        await s2.start()
+        pool = ClientPool()
+        c1 = await pool.get(s1.address)
+        await pool.get(s2.address)
+        orig_close = c1.close
+
+        async def racing_close():
+            # simulates a ReconnectingClient invalidating a peer while
+            # shutdown iterates the client table
+            pool.invalidate(s2.address)
+            await orig_close()
+
+        c1.close = racing_close
+        await pool.close_all()
+        assert pool._clients == {}
+        assert pool._locks == {}
+        await s1.stop()
+        await s2.stop()
+
+    loop.run_until_complete(main())
