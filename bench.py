@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 import time
 
 BASELINES = {
@@ -1121,6 +1122,106 @@ def bench_control_plane():
     return out
 
 
+def bench_serve_llm():
+    """Inference-plane phase (ISSUE 9): closed-loop load over the
+    continuous-batching engine — `llm_clients` threads each keep one
+    request in flight until `llm_requests` complete. Measures request
+    throughput, tokens/s/chip and p50/p99 request latency, and holds
+    the plane to its two hard gates: ZERO executable-cache retraces in
+    steady state (every shape is a warmup-compiled bucket) and ZERO
+    leaked KV pages at quiesce. Scale with
+    RAY_TPU_SCALE_SIZES=llm_requests=1000000,llm_clients=32 (the
+    full-scale artifact run; defaults keep the bench budget on a small
+    box and are noted in the detail row)."""
+    import statistics
+
+    import jax
+
+    from ray_tpu import parallel
+    from ray_tpu.serve.llm import EngineConfig, LLMEngine
+
+    ncpu = os.cpu_count() or 1
+    scale = _scale_overrides()
+    n_requests = scale.get("llm_requests", min(4000, 1000 * ncpu))
+    n_clients = scale.get("llm_clients", min(16, 4 * ncpu))
+    max_new = 8
+
+    eng = LLMEngine(
+        model="llama",
+        engine_config=EngineConfig(batch_buckets=(1, 2, 4, 8, 16),
+                                   prefill_buckets=(8, 16)),
+        seed=0)
+    t0 = time.perf_counter()
+    eng.warmup()
+    warmup_s = time.perf_counter() - t0
+    eng.start()
+
+    stats_before = parallel.cache_stats()
+    prompts = [[3 + (i % 5)] * (1 + i % 8) for i in range(16)]
+    latencies = []
+    lat_lock = threading.Lock()
+    issued = iter(range(n_requests))
+
+    def client(cid):
+        mine = []
+        while True:
+            if next(issued, None) is None:  # GIL-atomic claim
+                break
+            req = eng.submit(prompts[cid % len(prompts)], max_new)
+            req.result(timeout=300)
+            mine.append(req.finish_ts - req.submit_ts)
+        with lat_lock:
+            latencies.extend(mine)
+
+    threads = [threading.Thread(target=client, args=(c,), daemon=True)
+               for c in range(n_clients)]
+    start = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    elapsed = time.perf_counter() - start
+
+    eng.quiesce(timeout=60)
+    stats_after = parallel.cache_stats()
+    m = eng.metrics()
+    leaked = eng.shutdown()
+    retraces = stats_after["retraces"] - stats_before["retraces"]
+    if retraces:
+        raise RuntimeError(
+            f"{retraces} retraces in steady-state decode")
+    if leaked:
+        raise RuntimeError(f"{leaked} KV pages leaked at quiesce")
+
+    n_done = len(latencies)
+    lat_sorted = sorted(latencies)
+    chips = max(1, jax.device_count())
+    detail = {
+        "requests": n_done,
+        "clients": n_clients,
+        "max_new_tokens": max_new,
+        "warmup_s": round(warmup_s, 2),
+        "elapsed_s": round(elapsed, 2),
+        "latency_p50_ms": round(1e3 * statistics.median(lat_sorted), 2),
+        "latency_p99_ms": round(
+            1e3 * lat_sorted[int(0.99 * (n_done - 1))], 2),
+        "tokens_generated": int(m["tokens_generated"]),
+        "prefill_steps": int(m["prefill_steps"]),
+        "decode_steps": int(m["decode_steps"]),
+        "retraces_steady_state": retraces,
+        "kv_pages_leaked": leaked,
+        "cache_hits_delta": stats_after["hits"] - stats_before["hits"],
+        "full_scale": n_requests >= 1_000_000,
+    }
+    return {
+        "serve_llm": detail,
+        # value-keyed: the >15% REGRESSION gate watches both rates
+        "serve_llm_requests_per_s": n_done / elapsed,
+        "serve_llm_tokens_per_s_per_chip":
+            m["tokens_generated"] / elapsed / chips,
+    }
+
+
 def main():
     suite = {}
     started = time.perf_counter()
@@ -1223,6 +1324,19 @@ def main():
             suite["scale_envelope_error"] = repr(e)[:300]
     else:
         suite["scale_envelope"] = {"skipped": "budget"}
+
+    # inference plane (ISSUE 9): cheap on CPU at default scale; the
+    # full 1M-request artifact run sets RAY_TPU_SCALE_SIZES
+    if remaining() > 60 or not on_tpu:
+        try:
+            sl = bench_serve_llm()
+            for k, v in sl.items():
+                suite[k] = v if isinstance(v, dict) else {
+                    "value": round(v, 2), "vs_baseline": None}
+        except Exception as e:  # noqa: BLE001
+            suite["serve_llm_error"] = repr(e)[:300]
+    else:
+        suite["serve_llm"] = {"skipped": "budget"}
 
     if "tokens_per_sec_per_chip" in gpt2 and gpt2.get("platform") == "tpu":
         headline = {

@@ -222,6 +222,33 @@ class Dashboard:
 
         app.router.add_get("/api/steps", j(steps_panel))
 
+        def serve_llm_panel():
+            # inference plane: per-replica queue depth + KV-page
+            # occupancy for every serve.llm deployment (empty when no
+            # serve controller is running)
+            try:
+                ctrl = ray_tpu.get_actor("SERVE_CONTROLLER")
+                deployments = ray_tpu.get(
+                    ctrl.list_deployments.remote(), timeout=10)
+            except Exception:  # noqa: BLE001 — serve not started
+                return {"deployments": []}
+            out = []
+            for name in deployments:
+                try:
+                    info = ray_tpu.get(
+                        ctrl.get_replicas.remote(name), timeout=10)
+                    rows = [ray_tpu.get(r.get_metrics.remote(),
+                                        timeout=10)
+                            for r in info["replicas"]]
+                except Exception:  # noqa: BLE001 — replica churn
+                    continue
+                rows = [r for r in rows if "kv_pages_total" in r]
+                if rows:
+                    out.append({"deployment": name, "replicas": rows})
+            return {"deployments": out}
+
+        app.router.add_get("/api/serve_llm", j(serve_llm_panel))
+
         loop = asyncio.new_event_loop()
         asyncio.set_event_loop(loop)
         runner = web.AppRunner(app)

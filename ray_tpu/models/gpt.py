@@ -95,6 +95,9 @@ class Block(nn.Module):
         q = nn.with_logical_constraint(q, ("batch", "seq", "heads", None))
         k = nn.with_logical_constraint(k, ("batch", "seq", "heads", None))
         v = nn.with_logical_constraint(v, ("batch", "seq", "heads", None))
+        # decode-cache tap (serve.llm prefill); no-op unless the caller
+        # passes mutable=["intermediates"]
+        self.sow("intermediates", "kv_cache", (k, v))
         attend = self.attention_fn or partial(full_attention, causal=True)
         att = attend(q, k, v).reshape(b, t, cfg.d_model)
         att = _dense(cfg.d_model, ("heads", "embed"), "attn_out", cfg)(att)
@@ -224,6 +227,93 @@ def chunked_cross_entropy(hidden, wte, targets, ignore_index: int = -1,
                          targets[:, n * chunk_size:])
         total, count = total + s, count + c
     return total / jnp.maximum(count, 1.0)
+
+
+# -- decode path (serve.llm) ----------------------------------------------
+# Same two-function split as `llama.py` (see the note there): prefill is
+# the flax module itself (kv sown per block), decode is a pure paged
+# single-token forward sharing `paged_attend` with Llama.
+
+
+def unboxed_params(variables):
+    p = variables["params"] if "params" in variables else variables
+    return nn.meta.unbox(p)
+
+
+def _ln(x, scale, bias, dtype, eps=1e-6):
+    # mirrors flax LayerNorm (f32 stats, fast-variance, eps 1e-6)
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    mean2 = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+    var = jnp.maximum(0.0, mean2 - jnp.square(mean))
+    y = (x32 - mean) * jax.lax.rsqrt(var + eps)
+    y = y * scale.astype(jnp.float32) + bias.astype(jnp.float32)
+    return y.astype(dtype)
+
+
+def prefill_step(variables, cfg: GPTConfig, tokens, true_len):
+    """Full forward over a padded prompt batch; returns
+    (next_logits [B, V], k [B, S, L, H, D], v [B, S, L, H, D])."""
+    model = GPT(dataclasses.replace(cfg, remat=False))
+    logits, state = model.apply(variables, tokens,
+                                mutable=["intermediates"])
+    inter = state["intermediates"]
+    k = jnp.stack([inter[f"h{i}"]["kv_cache"][0][0]
+                   for i in range(cfg.n_layer)], axis=2)
+    v = jnp.stack([inter[f"h{i}"]["kv_cache"][0][1]
+                   for i in range(cfg.n_layer)], axis=2)
+    idx = jnp.maximum(true_len - 1, 0)
+    next_logits = jnp.take_along_axis(
+        logits, idx[:, None, None], axis=1)[:, 0]
+    return next_logits, k, v
+
+
+def decode_step(variables, cfg: GPTConfig, tokens, positions,
+                k_pages, v_pages, page_table):
+    """Single-token decode over a paged KV cache (MHA: kv heads ==
+    query heads). Shapes as in `llama.decode_step`."""
+    from ray_tpu.models.llama import paged_attend  # avoids import cycle
+
+    p = unboxed_params(variables)
+    dtype = cfg.dtype
+    hd = cfg.d_model // cfg.n_head
+    b = tokens.shape[0]
+    block = k_pages.shape[2]
+    t_max = page_table.shape[1] * block
+    wte = p["wte"].astype(dtype)
+    x = wte[tokens] + p["wpe"].astype(dtype)[positions]
+    scale = hd ** -0.5
+    key_idx = jnp.arange(t_max + 1)
+    valid = (key_idx[None, :] < positions[:, None]) | \
+        (key_idx[None, :] == t_max)
+    new_ks, new_vs = [], []
+    for i in range(cfg.n_layer):
+        lp = p[f"h{i}"]
+        h = _ln(x, lp["ln_1"]["scale"], lp["ln_1"]["bias"], dtype)
+        qkv = h @ lp["attn_qkv"]["kernel"].astype(dtype) + \
+            lp["attn_qkv"]["bias"].astype(dtype)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(b, cfg.n_head, hd)
+        k = k.reshape(b, cfg.n_head, hd)
+        v = v.reshape(b, cfg.n_head, hd)
+        att = paged_attend(q, k, v, k_pages[:, i], v_pages[:, i],
+                           page_table, valid, scale)
+        att = att.reshape(b, cfg.d_model) @ \
+            lp["attn_out"]["kernel"].astype(dtype) + \
+            lp["attn_out"]["bias"].astype(dtype)
+        x = x + att
+        h = _ln(x, lp["ln_2"]["scale"], lp["ln_2"]["bias"], dtype)
+        h = h @ lp["mlp_up"]["kernel"].astype(dtype) + \
+            lp["mlp_up"]["bias"].astype(dtype)
+        h = nn.gelu(h)
+        h = h @ lp["mlp_down"]["kernel"].astype(dtype) + \
+            lp["mlp_down"]["bias"].astype(dtype)
+        x = x + h
+        new_ks.append(k)
+        new_vs.append(v)
+    x = _ln(x, p["ln_f"]["scale"], p["ln_f"]["bias"], dtype)
+    logits = jnp.einsum("bd,vd->bv", x, wte)
+    return logits, jnp.stack(new_ks, axis=1), jnp.stack(new_vs, axis=1)
 
 
 def count_params(params) -> int:

@@ -144,6 +144,10 @@ class LlamaBlock(nn.Module):
         q = nn.with_logical_constraint(q, ("batch", "seq", "heads", None))
         k = nn.with_logical_constraint(k, ("batch", "seq", "heads", None))
         v = nn.with_logical_constraint(v, ("batch", "seq", "heads", None))
+        # post-RoPE K/V are exactly what a decode cache needs; sow is a
+        # no-op unless the caller asks for mutable=["intermediates"]
+        # (serve.llm prefill), so the training path is unchanged
+        self.sow("intermediates", "kv_cache", (k, v))
         attend = self.attention_fn or partial(full_attention, causal=True)
         att = attend(q, k, v).reshape(b, t, cfg.d_model)
         x = x + _dense(cfg.d_model, ("heads", "embed"),
@@ -192,6 +196,152 @@ class Llama(nn.Module):
             return x, wte.astype(cfg.dtype)
         # tied LM head
         return jnp.einsum("btd,vd->btv", x, wte.astype(cfg.dtype))
+
+
+# -- decode path (serve.llm) ----------------------------------------------
+#
+# Inference splits the forward into two pure functions the engine can
+# AOT-compile per (batch, seq) bucket via `parallel.compiled_step`:
+#   prefill_step — full-sequence forward (the flax module itself, so the
+#     math is bit-identical to training) that also returns per-position
+#     K/V slabs for cache seeding, via the kv_cache sow above;
+#   decode_step — single-token forward over a paged KV cache: the kernel
+#     receives the whole page arena plus per-sequence gather indices
+#     (page-table rows) and never materializes a contiguous KV copy.
+
+NEG_INF = -1e30
+
+
+def unboxed_params(variables):
+    """Strip the {"params": ...} wrapper and nn.Partitioned boxes."""
+    p = variables["params"] if "params" in variables else variables
+    return nn.meta.unbox(p)
+
+
+def _rms(x, scale, eps, dtype):
+    # mirrors RMSNorm.__call__ op-for-op (float32 internals)
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+    y = x32 * jax.lax.rsqrt(var + eps)
+    return (y * scale.astype(jnp.float32)).astype(dtype)
+
+
+def _rope_at(x, cos_p, sin_p):
+    """apply_rope for a single position per sequence; x: [B, H, D],
+    cos_p/sin_p: [B, D/2] rows gathered at each sequence's position."""
+    x32 = x.astype(jnp.float32)
+    x1, x2 = jnp.split(x32, 2, axis=-1)
+    c = cos_p[:, None, :]
+    s = sin_p[:, None, :]
+    return jnp.concatenate(
+        [x1 * c - x2 * s, x2 * c + x1 * s], axis=-1).astype(x.dtype)
+
+
+def paged_attend(q, k_new, v_new, k_pages_l, v_pages_l, page_table,
+                 valid, scale):
+    """One decode token attending over its paged KV history + itself.
+
+    q: [B, H, D]; k_new/v_new: [B, KVH, D] (this token, post-RoPE);
+    k_pages_l/v_pages_l: [P, block, KVH, D] (one layer's arena);
+    page_table: [B, n_pages] gather indices; valid: [B, T+1] key mask
+    (True for cached positions < seq_len and for the appended self key).
+    Math matches `full_attention` (same einsums, NEG_INF mask, row-max
+    subtraction, 1e-20 sum floor) so decode logits track the full
+    forward to float tolerance.
+    """
+    b, h, d = q.shape
+    kvh = k_new.shape[1]
+    kc = k_pages_l[page_table].reshape(b, -1, kvh, d).astype(q.dtype)
+    vc = v_pages_l[page_table].reshape(b, -1, kvh, d).astype(q.dtype)
+    k_all = jnp.concatenate([kc, k_new[:, None]], axis=1)  # [B, T+1, KVH, D]
+    v_all = jnp.concatenate([vc, v_new[:, None]], axis=1)
+    if kvh != h:  # GQA: repeat KV query-side (expand_kv_heads)
+        k_all = jnp.repeat(k_all, h // kvh, axis=2)
+        v_all = jnp.repeat(v_all, h // kvh, axis=2)
+    logits = jnp.einsum("bhd,bkhd->bhk", q, k_all) * scale
+    logits = jnp.where(valid[:, None, :], logits, NEG_INF)
+    row_max = jnp.max(logits, axis=-1, keepdims=True)
+    p = jnp.exp(logits - row_max)
+    row_sum = jnp.sum(p, axis=-1, keepdims=True)
+    out = jnp.einsum("bhk,bkhd->bhd", p, v_all)
+    return out / jnp.maximum(row_sum, 1e-20)
+
+
+def prefill_step(variables, cfg: LlamaConfig, tokens, true_len):
+    """Prefill: full forward over a padded prompt batch.
+
+    tokens: [B, S_bucket] (entries at positions >= true_len are padding —
+    causal masking keeps them out of every valid position's receptive
+    field); true_len: [B] int32. Returns (next_logits [B, V],
+    k [B, S, L, KVH, D], v [B, S, L, KVH, D]) where k/v rows past
+    true_len are garbage the caller must not cache.
+    """
+    model = Llama(dataclasses.replace(cfg, remat=False))
+    logits, state = model.apply(variables, tokens,
+                                mutable=["intermediates"])
+    inter = state["intermediates"]
+    ks = [inter[f"layer{i}"]["kv_cache"][0][0]
+          for i in range(cfg.n_layer)]
+    vs = [inter[f"layer{i}"]["kv_cache"][0][1]
+          for i in range(cfg.n_layer)]
+    k = jnp.stack(ks, axis=2)  # [B, S, L, KVH, D]
+    v = jnp.stack(vs, axis=2)
+    idx = jnp.maximum(true_len - 1, 0)
+    next_logits = jnp.take_along_axis(
+        logits, idx[:, None, None], axis=1)[:, 0]
+    return next_logits, k, v
+
+
+def decode_step(variables, cfg: LlamaConfig, tokens, positions,
+                k_pages, v_pages, page_table):
+    """One decode iteration for a batch of sequences on a paged cache.
+
+    tokens: [B] current token ids; positions: [B] their 0-based
+    positions (== tokens already cached per sequence); k_pages/v_pages:
+    [P, L, block, KVH, D] arena views; page_table: [B, n_pages] page ids
+    per logical block (rows padded with any valid page id — masked).
+    Returns (logits [B, V], new_k [B, L, KVH, D], new_v [B, L, KVH, D]);
+    the caller appends new_k/new_v into each sequence's tail page.
+    """
+    p = unboxed_params(variables)
+    dtype = cfg.dtype
+    hd = cfg.head_dim
+    b = tokens.shape[0]
+    block = k_pages.shape[2]
+    t_max = page_table.shape[1] * block
+    wte = p["wte"].astype(dtype)
+    x = wte[tokens]  # [B, D]
+    cos_t, sin_t = rope_tables(cfg.max_seq_len, hd, cfg.rope_theta)
+    cos_p, sin_p = cos_t[positions], sin_t[positions]
+    scale = hd ** -0.5
+    key_idx = jnp.arange(t_max + 1)
+    valid = (key_idx[None, :] < positions[:, None]) | \
+        (key_idx[None, :] == t_max)
+    new_ks, new_vs = [], []
+    for i in range(cfg.n_layer):
+        lp = p[f"layer{i}"]
+        h = _rms(x, lp["attn_norm"]["scale"], cfg.norm_eps, dtype)
+        fused = h @ lp["attn_qkv"]["kernel"].astype(dtype)
+        q, k, v = jnp.split(
+            fused, [cfg.n_head * hd, (cfg.n_head + cfg.n_kv_head) * hd],
+            axis=-1)
+        q = _rope_at(q.reshape(b, cfg.n_head, hd), cos_p, sin_p)
+        k = _rope_at(k.reshape(b, cfg.n_kv_head, hd), cos_p, sin_p)
+        v = v.reshape(b, cfg.n_kv_head, hd)
+        att = paged_attend(q, k, v, k_pages[:, i], v_pages[:, i],
+                           page_table, valid, scale)
+        x = x + att.reshape(b, cfg.d_model) @ \
+            lp["attn_out"]["kernel"].astype(dtype)
+        h = _rms(x, lp["mlp_norm"]["scale"], cfg.norm_eps, dtype)
+        gu = h @ lp["mlp_gate_up"]["kernel"].astype(dtype)
+        gate, up = jnp.split(gu, 2, axis=-1)
+        x = x + (nn.silu(gate) * up) @ \
+            lp["mlp_down"]["kernel"].astype(dtype)
+        new_ks.append(k)
+        new_vs.append(v)
+    x = _rms(x, p["final_norm"]["scale"], cfg.norm_eps, dtype)
+    logits = jnp.einsum("bd,vd->bv", x, wte)
+    return logits, jnp.stack(new_ks, axis=1), jnp.stack(new_vs, axis=1)
 
 
 def flops_per_token(cfg: LlamaConfig, seq_len: int | None = None) -> float:

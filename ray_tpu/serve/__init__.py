@@ -21,11 +21,24 @@ from ray_tpu.serve.deployment import (
     DeploymentConfig,
     deployment,
 )
-from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
+from ray_tpu.serve.handle import (
+    DeploymentHandle,
+    DeploymentResponse,
+    RequestTimeoutError,
+)
 from ray_tpu.serve.multiplex import (
     get_multiplexed_model_id,
     multiplexed,
 )
+
+
+def __getattr__(name: str):
+    # `serve.llm` pulls in jax + the model zoo; load it lazily so plain
+    # serving (and `import ray_tpu`) stays light (PEP 562)
+    if name == "llm":
+        import ray_tpu.serve.llm as _llm
+        return _llm
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 _state: Dict[str, Any] = {"controller": None, "proxy": None}
 
@@ -189,7 +202,9 @@ __all__ = [
     "deploy_config",
     "deployment",
     "get_deployment_handle",
+    "RequestTimeoutError",
     "ingress",
+    "llm",
     "run",
     "shutdown",
     "status",

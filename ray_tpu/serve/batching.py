@@ -45,7 +45,17 @@ class _Batcher:
         # thread spawn and the batched call both stay outside the
         # critical section: the lock only guards the queue swap
         if timer is not None:
-            timer.start()
+            started = False
+            try:
+                timer.start()
+                started = True
+            finally:
+                if not started:
+                    # un-wedge the batcher: with the flag stuck True no
+                    # later submit would ever schedule a flush, hanging
+                    # every queued caller
+                    with self._lock:
+                        self._flush_scheduled = False
         if batch:
             self._run(instance, batch)
         if not entry["event"].wait(timeout=600.0):
@@ -72,8 +82,16 @@ class _Batcher:
                     f"batched fn returned {len(results)} results for "
                     f"{len(items)} inputs")
             for e, r in zip(batch, results):
-                e["result"] = r
-        except Exception as err:  # noqa: BLE001 — forwarded to callers
+                # per-item failure: a batched fn returns an Exception
+                # INSTANCE in an item's slot (reference semantics: one
+                # bad input fails its own caller, not its batch-mates)
+                if isinstance(r, Exception):
+                    e["error"] = r
+                else:
+                    e["result"] = r
+        except Exception as err:  # noqa: BLE001 — a raise (not a
+            # returned per-item error) still fails the whole batch:
+            # there is no way to know which input caused it
             for e in batch:
                 e["error"] = err
         finally:

@@ -68,6 +68,13 @@ class ServeController:
         # controller kills them on shutdown so a CLI-issued shutdown
         # from another process tears the whole instance down
         self._proxies: List[Any] = []
+        # last-known get_metrics payload per replica (keyed by actor
+        # identity): a replica that dies between polls is reclaimed from
+        # this cache — e.g. its serve.llm KV arena (kv_arena_id) is
+        # force-deleted from the node's shm store so the dead process's
+        # pages don't leak until eviction pressure
+        self._replica_metrics: Dict[int, Dict[str, Any]] = {}
+        self._reclaimed_arenas: List[str] = []
 
     # -- API ---------------------------------------------------------------
 
@@ -211,39 +218,75 @@ class ServeController:
                 continue
             try:
                 # liveness + load polls on the snapshot, outside the lock
-                alive, dead, total_ongoing = self._poll_replicas(replicas)
+                alive, dead, total_load, polled = \
+                    self._poll_replicas(replicas)
                 for r in dead:
                     self._kill(r)
+                    self._reclaim_dead_replica(r)
                 with self._lock:
+                    self._replica_metrics.update(polled)
+                    for r in dead:
+                        self._replica_metrics.pop(id(r), None)
                     if self._deployments.get(name) is not st:
                         continue  # deleted/replaced while polling
                     dead_ids = {id(r) for r in dead}
                     st.replicas = [r for r in st.replicas
                                    if id(r) not in dead_ids]
-                    self._autoscale(st, total_ongoing)
+                    self._autoscale(st, total_load)
                 self._scale_to_target(name, st)
             except Exception:
                 pass
 
     @staticmethod
     def _poll_replicas(replicas: List[Any]
-                       ) -> Tuple[List[Any], List[Any], float]:
+                       ) -> Tuple[List[Any], List[Any], float,
+                                  Dict[int, Dict[str, Any]]]:
         """One concurrent get_metrics round over a snapshot: liveness +
-        load in one RPC. Returns (alive, dead, total_ongoing); dead (or
-        unresponsive) replicas are killed by the caller so they can't
-        leak. Never called with a lock held."""
+        load in one RPC. Returns (alive, dead, total_load, metrics by
+        replica identity); total_load folds deployment-reported queue
+        depth (serve.llm engine backlog) into the ongoing count so
+        autoscaling sees queued work, not just dispatched work. Dead
+        (or unresponsive) replicas are killed by the caller so they
+        can't leak. Never called with a lock held."""
         refs = [(r, r.get_metrics.remote()) for r in replicas]
         alive: List[Any] = []
         dead: List[Any] = []
-        total_ongoing = 0.0
+        total_load = 0.0
+        polled: Dict[int, Dict[str, Any]] = {}
         for r, ref in refs:
             try:
                 m = ray_tpu.get(ref, timeout=10)
                 alive.append(r)
-                total_ongoing += m["ongoing"]
+                total_load += m["ongoing"] + \
+                    float(m.get("queue_depth", 0))
+                polled[id(r)] = m
             except Exception:
                 dead.append(r)
-        return alive, dead, total_ongoing
+        return alive, dead, total_load, polled
+
+    def _reclaim_dead_replica(self, replica: Any) -> None:
+        """Release node-side resources a dead replica can no longer
+        release itself, using its last polled metrics. Today: the
+        serve.llm KV arena (the dead process never dropped its creator
+        reference on the shm allocation). Single-node semantics — the
+        arena lives in this node's store; a multi-node controller would
+        route the delete through the owning raylet."""
+        with self._lock:
+            m = self._replica_metrics.pop(id(replica), None)
+        arena = (m or {}).get("kv_arena_id")
+        if not arena:
+            return
+        try:
+            from ray_tpu.serve.llm.kv_cache import reclaim_arena
+            if reclaim_arena(arena):
+                with self._lock:
+                    self._reclaimed_arenas.append(arena)
+        except Exception:
+            pass
+
+    def get_reclaimed_arenas(self) -> List[str]:
+        with self._lock:
+            return list(self._reclaimed_arenas)
 
     def _scale_to_target(self, name: str, st: _DeploymentState) -> None:
         """Converge replica count to st.target_replicas. State deltas are

@@ -16,6 +16,20 @@ import time
 from typing import Any, Dict, List, Optional
 
 import ray_tpu
+from ray_tpu.util import metrics as _metrics
+
+# module-level constructor (raylint: no metric objects on hot paths) —
+# counts requests shed because their deadline passed before dispatch
+REQUEST_TIMEOUTS = _metrics.Counter(
+    "serve_request_timeouts",
+    "requests rejected because handle.options(timeout_s=...) expired "
+    "before dispatch")
+
+
+class RequestTimeoutError(TimeoutError):
+    """The request's `timeout_s` deadline passed while it was still
+    queued client-side (router backlog / no replicas) — shed instead of
+    dispatched to serve a dead request."""
 
 
 class DeploymentResponse:
@@ -58,6 +72,7 @@ class DeploymentResponse:
             self._mark_done()
             resubmit, self._resubmit = self._resubmit, None
             if self._router is not None:
+                self._router.mark_dead(self._replica_idx)
                 self._router._refresh(force=True)
             retry = resubmit()
             self._ref = retry._ref
@@ -89,11 +104,13 @@ class DeploymentResponseGenerator:
     riding the streaming-generator protocol)."""
 
     def __init__(self, gen, router: Optional["Router"] = None,
-                 replica_idx: int = -1):
+                 replica_idx: int = -1, resubmit=None):
         self._gen = gen  # ObjectRefGenerator of chunk refs
         self._router = router
         self._replica_idx = replica_idx
         self._done = False
+        self._resubmit = resubmit
+        self._delivered = 0  # chunks already handed to the caller
 
     def _mark_done(self):
         if not self._done and self._router is not None:
@@ -104,16 +121,43 @@ class DeploymentResponseGenerator:
         return self
 
     def __next__(self) -> Any:
-        try:
-            ref = next(self._gen)
-        except StopIteration:
-            self._mark_done()
-            raise
-        try:
-            return ray_tpu.get(ref)
-        except Exception:
-            self._mark_done()
-            raise
+        while True:
+            try:
+                ref = next(self._gen)
+                val = ray_tpu.get(ref)
+            except StopIteration:
+                self._mark_done()
+                raise
+            except ray_tpu.ActorDiedError:
+                # replica died mid-stream: restart the stream on a
+                # freshly-routed replica and fast-forward past the
+                # chunks the caller already consumed (deployment
+                # streams are deterministic for a given request — the
+                # contract this replay rides on; serve.llm's greedy
+                # decode satisfies it). One retry, like the unary path.
+                if self._resubmit is None:
+                    self._mark_done()
+                    raise
+                self._mark_done()
+                resubmit, self._resubmit = self._resubmit, None
+                if self._router is not None:
+                    self._router.mark_dead(self._replica_idx)
+                    self._router._refresh(force=True)
+                retry = resubmit()
+                self._gen = retry._gen
+                self._router = retry._router
+                self._replica_idx = retry._replica_idx
+                self._done = False
+                retry._done = True  # accounting moved to this object
+                retry._router = None
+                for _ in range(self._delivered):  # replay dedup
+                    ray_tpu.get(next(self._gen))
+                continue
+            except Exception:
+                self._mark_done()
+                raise
+            self._delivered += 1
+            return val
 
     def close(self):
         """Cancel the stream: the replica's generator stops at its next
@@ -180,22 +224,39 @@ class Router:
             if idx in self._inflight and self._inflight[idx] > 0:
                 self._inflight[idx] -= 1
 
+    def mark_dead(self, idx: int):
+        """Evict a replica observed dead (ActorDiedError) from the local
+        view NOW — the controller's list stays stale until its next
+        reconcile, and a retry routed through it could land on the same
+        corpse. The next version bump (controller replacing the
+        replica) restores the authoritative list."""
+        with self._lock:
+            if 0 <= idx < len(self._replicas):
+                self._replicas = [r for i, r in
+                                  enumerate(self._replicas) if i != idx]
+                self._inflight = {i: 0
+                                  for i in range(len(self._replicas))}
+
 
 class DeploymentHandle:
     def __init__(self, controller, deployment_name: str,
-                 method: str = "__call__", stream: bool = False):
+                 method: str = "__call__", stream: bool = False,
+                 timeout_s: Optional[float] = None):
         self._controller = controller
         self._name = deployment_name
         self._method = method
         self._stream = stream
+        self._timeout_s = timeout_s
         self._router = Router(controller, deployment_name)
 
     def options(self, method_name: Optional[str] = None,
-                stream: Optional[bool] = None) -> "DeploymentHandle":
+                stream: Optional[bool] = None,
+                timeout_s: Optional[float] = None) -> "DeploymentHandle":
         h = DeploymentHandle(
             self._controller, self._name,
             method_name if method_name is not None else self._method,
-            stream if stream is not None else self._stream)
+            stream if stream is not None else self._stream,
+            timeout_s if timeout_s is not None else self._timeout_s)
         h._router = self._router  # share the local view
         return h
 
@@ -210,18 +271,40 @@ class DeploymentHandle:
                      for a in args)
         kwargs = {k: (v.ref if isinstance(v, DeploymentResponse) else v)
                   for k, v in kwargs.items()}
-        return self._submit(args, kwargs)
+        deadline = None if self._timeout_s is None else \
+            time.monotonic() + self._timeout_s
+        return self._submit(args, kwargs, deadline)
 
-    def _submit(self, args, kwargs):
+    def _check_deadline(self, deadline: Optional[float]):
+        """Shed a request whose per-request deadline passed while it was
+        still queued client-side — a saturated deployment serves live
+        requests instead of dead ones."""
+        if deadline is not None and time.monotonic() > deadline:
+            REQUEST_TIMEOUTS.inc()
+            raise RequestTimeoutError(
+                f"request to {self._name!r} timed out after "
+                f"{self._timeout_s}s before dispatch")
+
+    def _submit(self, args, kwargs, deadline: Optional[float] = None):
+        self._check_deadline(deadline)
         idx, replica = self._router.choose()
+        try:
+            # choose() can block waiting for replicas — re-check before
+            # committing the dispatch
+            self._check_deadline(deadline)
+        except RequestTimeoutError:
+            self._router.done(idx)
+            raise
         if self._stream:
             gen = replica.handle_request_streaming.options(
                 num_returns="streaming").remote(self._method, args, kwargs)
-            return DeploymentResponseGenerator(gen, self._router, idx)
+            return DeploymentResponseGenerator(
+                gen, self._router, idx,
+                resubmit=lambda: self._submit(args, kwargs, deadline))
         ref = replica.handle_request.remote(self._method, args, kwargs)
         return DeploymentResponse(
             ref, self._router, idx,
-            resubmit=lambda: self._submit(args, kwargs))
+            resubmit=lambda: self._submit(args, kwargs, deadline))
 
     def _submit_asgi(self, scope: dict, body: bytes
                      ) -> "DeploymentResponseGenerator":
@@ -253,4 +336,5 @@ class DeploymentHandle:
 
     def __reduce__(self):
         return (DeploymentHandle,
-                (self._controller, self._name, self._method, self._stream))
+                (self._controller, self._name, self._method, self._stream,
+                 self._timeout_s))

@@ -1,0 +1,34 @@
+"""serve.llm — decode-optimized LLM inference plane.
+
+Paged shm KV-cache (`kv_cache.py`) + continuous-batching engine on the
+AOT compile cache (`engine.py`) + a Serve deployment streaming tokens
+over `handle_request_streaming` (`deployment.py`). See the README
+"Inference plane" section for the engine loop and env knobs.
+"""
+
+from ray_tpu.serve.llm.kv_cache import (
+    KVCacheError,
+    OutOfPagesError,
+    PagedKVCache,
+    reclaim_arena,
+)
+from ray_tpu.serve.llm.engine import (
+    EngineConfig,
+    LLMEngine,
+    Request,
+    RequestRejected,
+)
+from ray_tpu.serve.llm.deployment import LLMDeployment, build_app
+
+__all__ = [
+    "EngineConfig",
+    "KVCacheError",
+    "LLMDeployment",
+    "LLMEngine",
+    "OutOfPagesError",
+    "PagedKVCache",
+    "Request",
+    "RequestRejected",
+    "build_app",
+    "reclaim_arena",
+]

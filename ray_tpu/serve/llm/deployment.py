@@ -1,0 +1,129 @@
+"""serve.llm.LLMDeployment — an LLMEngine behind the Serve stack.
+
+Each replica hosts one engine (pump thread + paged shm KV arena) and
+streams tokens over the existing `handle_request_streaming` path:
+
+    app = serve.llm.build_app(name="llm", num_replicas=2)
+    handle = serve.run(app)
+    for tok in handle.generate.options(stream=True).remote([1, 2, 3], 8):
+        ...
+
+The replica exports `get_autoscaling_metrics` so the controller's poll
+sees queue depth + KV-page occupancy (autoscaling pressure) and the KV
+arena id (dead-replica reclaim); the engine's own counters join the
+node's /metrics scrape via the registry callback it registers.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+
+class LLMDeployment:
+    """Deployment callable: one continuous-batching engine per replica.
+
+    `model` is the family ("llama" | "gpt"); `model_config` /
+    `engine_config` are plain dicts so deployments stay picklable
+    (resolved into the real config dataclasses replica-side). `seed`
+    fixes the weight init — replicas of one deployment must agree so
+    greedy streams are replayable across a replica death.
+    """
+
+    def __init__(self, model: str = "llama",
+                 model_config: Optional[Dict[str, Any]] = None,
+                 engine_config: Optional[Dict[str, Any]] = None,
+                 seed: int = 0):
+        from ray_tpu.serve.llm.engine import EngineConfig, LLMEngine
+
+        model_cfg = None
+        if model_config:
+            if model == "llama":
+                from ray_tpu.models.llama import LlamaConfig as _Cfg
+            else:
+                from ray_tpu.models.gpt import GPTConfig as _Cfg
+            model_cfg = _Cfg(**model_config)
+        store = self._node_store()
+        self.engine = LLMEngine(
+            model=model, model_cfg=model_cfg,
+            engine_config=EngineConfig(**(engine_config or {})),
+            store=store, seed=seed)
+        self.engine.warmup()
+        self.engine.start()
+
+    @staticmethod
+    def _node_store():
+        """The worker's shm store attachment, so KV pages live on the
+        object plane (None outside a cluster: plain numpy arena)."""
+        try:
+            from ray_tpu._private.object_ref import get_core_worker
+            cw = get_core_worker()
+            return cw.store if cw is not None else None
+        except Exception:
+            return None
+
+    # -- request path -----------------------------------------------------
+
+    def generate(self, prompt: List[int], max_new_tokens: int = 16,
+                 timeout_s: Optional[float] = None):
+        """Generator: yields {"index", "token"} per generated token.
+        Streamed to the caller chunk-by-chunk via
+        `handle.generate.options(stream=True)`."""
+        req = self.engine.submit([int(t) for t in prompt],
+                                 int(max_new_tokens),
+                                 timeout_s=timeout_s)
+        emitted = 0
+        while True:
+            kind, *rest = req.out_q.get(timeout=120.0)
+            if kind == "token":
+                yield {"index": rest[0], "token": rest[1]}
+                emitted += 1
+            elif kind == "done":
+                return
+            else:
+                raise RuntimeError(f"generation failed: {rest[0]}")
+
+    def generate_once(self, prompt: List[int],
+                      max_new_tokens: int = 16) -> List[int]:
+        """Unary variant: the full generated token list in one reply."""
+        req = self.engine.submit([int(t) for t in prompt],
+                                 int(max_new_tokens))
+        return req.result(timeout=120.0)
+
+    # -- control plane ----------------------------------------------------
+
+    def get_autoscaling_metrics(self) -> Dict[str, Any]:
+        m = self.engine.metrics()
+        return {
+            "queue_depth": float(m["queue_depth"]),
+            "llm_running": float(m["running"]),
+            "kv_pages_live": float(m["kv_pages_live"]),
+            "kv_pages_total": float(m["kv_pages_total"]),
+            "kv_arena_id": m["kv_arena_id"],
+        }
+
+    def engine_metrics(self) -> Dict[str, Any]:
+        return self.engine.metrics()
+
+    def check_health(self) -> bool:
+        return self.engine._thread is not None and \
+            self.engine._thread.is_alive()
+
+    def __del__(self):
+        try:
+            self.engine.shutdown()
+        except Exception:
+            pass
+
+
+def build_app(name: str = "llm", num_replicas: int = 1,
+              autoscaling_config: Optional[Dict[str, Any]] = None,
+              **init_kwargs):
+    """Bind LLMDeployment into a deployable app:
+    `serve.run(serve.llm.build_app(...))`."""
+    from ray_tpu import serve
+
+    deco = serve.deployment(
+        name=name,
+        num_replicas=None if autoscaling_config else num_replicas,
+        autoscaling_config=autoscaling_config)
+    return deco(LLMDeployment).bind(**init_kwargs)

@@ -1,0 +1,577 @@
+"""Continuous-batching LLM engine on the AOT compile cache.
+
+Orca-style iteration-level scheduling (reference: Orca OSDI'22, vllm
+`llm_engine.py`): every `step()` interleaves at most
+`max_prefills_per_step` prompt prefills with one decode iteration over
+the whole running set. Sequences join and leave the decode batch
+*between* steps — a finished sequence frees its KV pages immediately and
+the next step simply assembles a smaller batch; no request ever waits
+for a batch-mate to finish.
+
+Shape discipline is what makes this serveable on TPU: prompts pad into a
+small set of prefill buckets and the decode batch pads into a small set
+of batch buckets, and each bucket owns its own `parallel.compiled_step`
+wrapper compiled with ``on_retrace="error"`` — one abstract signature
+per executable, so steady-state serving can never silently retrace
+(`parallel.cache_stats()` proves it; the bench asserts retraces == 0
+across the run).
+
+The KV plane is a `PagedKVCache` (see kv_cache.py): decode dispatch
+hands the kernel the whole arena + per-sequence page-table rows; the
+host appends each new token's K/V into the sequence's tail page
+in place (a [n_layer, n_kv_head, head_dim] write per token).
+
+Greedy (argmax) sampling keeps generation deterministic — the property
+the continuous-batching equivalence test and the mid-stream chaos
+replay both lean on.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import os
+import queue
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ray_tpu.serve.llm.kv_cache import OutOfPagesError, PagedKVCache
+from ray_tpu.util import metrics as _metrics
+from ray_tpu.util import step_profiler as _sp
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def _env_tuple(name: str, default: Tuple[int, ...]) -> Tuple[int, ...]:
+    raw = os.environ.get(name)
+    if not raw:
+        return default
+    return tuple(sorted(int(x) for x in raw.split(",") if x.strip()))
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineConfig:
+    """Scheduler + cache knobs (env-overridable; see README)."""
+
+    block_size: int = 0            # RAY_TPU_LLM_BLOCK_SIZE (default 16)
+    num_pages: int = 0             # 0 -> worst case for max_running
+    batch_buckets: Tuple[int, ...] = ()    # RAY_TPU_LLM_BATCH_BUCKETS
+    prefill_buckets: Tuple[int, ...] = ()  # RAY_TPU_LLM_PREFILL_BUCKETS
+    max_running: int = 0           # RAY_TPU_LLM_MAX_RUNNING
+    max_prefills_per_step: int = 1
+    eos_token: Optional[int] = None
+
+    def resolved(self, max_seq_len: int) -> "EngineConfig":
+        block = self.block_size or _env_int("RAY_TPU_LLM_BLOCK_SIZE", 16)
+        batch = self.batch_buckets or _env_tuple(
+            "RAY_TPU_LLM_BATCH_BUCKETS", (1, 2, 4, 8))
+        prefill = self.prefill_buckets or _env_tuple(
+            "RAY_TPU_LLM_PREFILL_BUCKETS", (16, 32, 64, 128))
+        prefill = tuple(s for s in prefill if s <= max_seq_len) or \
+            (max_seq_len,)
+        max_running = self.max_running or _env_int(
+            "RAY_TPU_LLM_MAX_RUNNING", max(batch))
+        max_running = min(max_running, max(batch))
+        pages_per_seq = -(-max_seq_len // block)
+        num_pages = self.num_pages or max_running * pages_per_seq
+        return dataclasses.replace(
+            self, block_size=block, num_pages=num_pages,
+            batch_buckets=batch, prefill_buckets=prefill,
+            max_running=max_running)
+
+
+class RequestRejected(RuntimeError):
+    pass
+
+
+_req_counter = itertools.count(1)
+
+
+class Request:
+    """One generation request; tokens stream into `out_q` as produced.
+
+    Queue items: ("token", index, token_id) per generated token, then
+    one terminal ("done", reason) / ("error", message).
+    """
+
+    def __init__(self, prompt: List[int], max_new_tokens: int,
+                 deadline: Optional[float], request_id: str):
+        self.id = request_id
+        self.prompt = list(prompt)
+        self.max_new_tokens = max_new_tokens
+        self.deadline = deadline
+        self.out_q: "queue.Queue" = queue.Queue()
+        self.tokens: List[int] = []   # generated tokens, in order
+        self.done = threading.Event()
+        self.error: Optional[str] = None
+        self.finish_reason: Optional[str] = None
+        self.submit_ts = time.monotonic()
+        self.finish_ts: Optional[float] = None
+
+    def __repr__(self):
+        return f"Request({self.id})"
+
+    # -- consumer side ---------------------------------------------------
+
+    def result(self, timeout: Optional[float] = 60.0) -> List[int]:
+        """Block until generation finishes; returns the generated ids."""
+        if not self.done.wait(timeout):
+            raise TimeoutError(f"request {self.id} not done "
+                               f"after {timeout}s")
+        if self.error is not None:
+            raise RequestRejected(self.error)
+        return list(self.tokens)
+
+    def stream(self, timeout: float = 60.0):
+        """Yield generated token ids as the engine produces them."""
+        while True:
+            kind, *rest = self.out_q.get(timeout=timeout)
+            if kind == "token":
+                yield rest[1]
+            elif kind == "done":
+                return
+            else:
+                raise RequestRejected(rest[0])
+
+    # -- engine side -----------------------------------------------------
+
+    def _emit(self, token: int):
+        self.tokens.append(token)
+        self.out_q.put(("token", len(self.tokens) - 1, token))
+
+    def _finish(self, reason: str):
+        self.finish_reason = reason
+        self.finish_ts = time.monotonic()
+        self.out_q.put(("done", reason))
+        self.done.set()
+
+    def _fail(self, msg: str):
+        self.error = msg
+        self.finish_ts = time.monotonic()
+        self.out_q.put(("error", msg))
+        self.done.set()
+
+
+class _Sequence:
+    """A running request's decode state."""
+
+    __slots__ = ("req", "pages", "pos")
+
+    def __init__(self, req: Request, pages: List[int], pos: int):
+        self.req = req
+        self.pages = pages
+        self.pos = pos  # tokens already written to the KV cache
+
+    @property
+    def last_token(self) -> int:
+        toks = self.req.tokens
+        return toks[-1] if toks else self.req.prompt[-1]
+
+    @property
+    def n_generated(self) -> int:
+        return len(self.req.tokens)
+
+
+class LLMEngine:
+    """Continuous-batching engine for one model replica.
+
+    `model` selects the decode path ("llama" | "gpt"); `model_cfg`
+    defaults to the family's tiny config in float32 (the 1-core build
+    box target — a real deployment passes its own config + params).
+    `store=None` keeps the KV arena in process-local numpy; passing the
+    node's shm ObjectStore puts the pages on the object plane where a
+    controller can reclaim them if this replica dies.
+    """
+
+    def __init__(self, model: str = "llama", model_cfg=None, params=None,
+                 engine_config: Optional[EngineConfig] = None,
+                 store=None, seed: int = 0):
+        import jax
+        import jax.numpy as jnp
+        from ray_tpu.parallel import compiled_step
+
+        if model == "llama":
+            from ray_tpu.models import llama as mod
+            self.model_cfg = model_cfg or mod.LlamaConfig.tiny(
+                dtype=jnp.float32)
+            n_kv_head = self.model_cfg.n_kv_head
+            head_dim = self.model_cfg.head_dim
+        elif model == "gpt":
+            from ray_tpu.models import gpt as mod
+            self.model_cfg = model_cfg or mod.GPTConfig.tiny(
+                dtype=jnp.float32)
+            n_kv_head = self.model_cfg.n_head
+            head_dim = self.model_cfg.d_model // self.model_cfg.n_head
+        else:
+            raise ValueError(f"unknown model family {model!r}")
+        self.model_name = model
+        self._mod = mod
+        cfg = (engine_config or EngineConfig()).resolved(
+            self.model_cfg.max_seq_len)
+        self.config = cfg
+        self.max_pages_per_seq = -(-self.model_cfg.max_seq_len
+                                   // cfg.block_size)
+
+        if params is None:
+            net = (mod.Llama if model == "llama" else mod.GPT)(
+                self.model_cfg)
+            params = net.init(
+                jax.random.PRNGKey(seed),
+                jnp.ones((1, min(cfg.prefill_buckets)), jnp.int32))
+        self.params = params
+
+        self.kv = PagedKVCache(
+            cfg.num_pages, self.model_cfg.n_layer, cfg.block_size,
+            n_kv_head, head_dim,
+            dtype=jnp.dtype(self.model_cfg.dtype),
+            store=store)
+
+        # one compiled_step wrapper per bucket: each sees exactly one
+        # abstract signature, so on_retrace="error" turns any shape
+        # drift in steady-state serving into a loud failure
+        self._prefill_fns = {
+            s: compiled_step(self._make_prefill_fn(s),
+                             on_retrace="error")
+            for s in cfg.prefill_buckets}
+        self._decode_fns = {
+            b: compiled_step(self._make_decode_fn(b),
+                             on_retrace="error")
+            for b in cfg.batch_buckets}
+
+        self._waiting: List[Request] = []
+        self._running: List[_Sequence] = []
+        self._lock = threading.Lock()       # guards queues + counters
+        self._step_lock = threading.Lock()  # serializes step()
+        self._work = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._step_no = 0
+        self.counters: Dict[str, float] = {
+            "requests_submitted": 0, "requests_completed": 0,
+            "requests_failed": 0, "requests_timed_out": 0,
+            "tokens_generated": 0, "prefill_steps": 0,
+            "decode_steps": 0, "prefill_ms": 0.0, "decode_ms": 0.0,
+        }
+        _metrics.DEFAULT_REGISTRY.register_callback(
+            "serve_llm", self._metrics_text)
+
+    # -- compiled kernels -------------------------------------------------
+
+    def _make_prefill_fn(self, bucket: int):
+        mod, cfg = self._mod, self.model_cfg
+
+        def fn(variables, tokens, true_len):
+            return mod.prefill_step(variables, cfg, tokens, true_len)
+
+        fn.__name__ = f"llm_prefill_s{bucket}"
+        return fn
+
+    def _make_decode_fn(self, batch: int):
+        mod, cfg = self._mod, self.model_cfg
+
+        def fn(variables, tokens, positions, k_pages, v_pages,
+               page_table):
+            return mod.decode_step(variables, cfg, tokens, positions,
+                                   k_pages, v_pages, page_table)
+
+        fn.__name__ = f"llm_decode_b{batch}"
+        return fn
+
+    def warmup(self):
+        """Compile every bucket up front so steady state is all cache
+        hits (the bench snapshots `cache_stats()` after this). All call
+        sites feed numpy host arrays — the cache keys on leaf avals
+        including sharding, so mixing numpy and device arrays for the
+        same bucket would read as a retrace."""
+        for s, fn in self._prefill_fns.items():
+            fn(self.params, np.zeros((1, s), np.int32),
+               np.ones((1,), np.int32))
+        for b, fn in self._decode_fns.items():
+            fn(self.params,
+               np.zeros(b, np.int32), np.zeros(b, np.int32),
+               self.kv.k_pages, self.kv.v_pages,
+               np.zeros((b, self.max_pages_per_seq), np.int32))
+
+    # -- submission -------------------------------------------------------
+
+    def submit(self, prompt: List[int], max_new_tokens: int = 16, *,
+               request_id: Optional[str] = None,
+               timeout_s: Optional[float] = None) -> Request:
+        if not prompt:
+            raise RequestRejected("empty prompt")
+        limit = max(self.config.prefill_buckets)
+        if len(prompt) > limit:
+            raise RequestRejected(
+                f"prompt of {len(prompt)} tokens exceeds the largest "
+                f"prefill bucket ({limit})")
+        total = len(prompt) + max_new_tokens
+        if total > self.model_cfg.max_seq_len:
+            raise RequestRejected(
+                f"prompt+max_new_tokens {total} exceeds max_seq_len "
+                f"{self.model_cfg.max_seq_len}")
+        deadline = (time.monotonic() + timeout_s) if timeout_s else None
+        req = Request(prompt, max_new_tokens, deadline,
+                      request_id or f"llm-{next(_req_counter)}")
+        with self._lock:
+            self.counters["requests_submitted"] += 1
+            self._waiting.append(req)
+        self._work.set()
+        return req
+
+    # -- scheduler --------------------------------------------------------
+
+    def step(self) -> bool:
+        """One engine iteration: admit + prefill up to
+        `max_prefills_per_step` prompts, then one decode pass over the
+        running set. Returns False when there was nothing to do."""
+        with self._step_lock:
+            t0 = time.perf_counter()
+            prefill_ms = decode_ms = 0.0
+            tokens_out = 0
+            self._shed_expired()
+            for _ in range(self.config.max_prefills_per_step):
+                req = self._admit_one()
+                if req is None:
+                    break
+                t1 = time.perf_counter()
+                tokens_out += self._prefill(req)
+                prefill_ms += (time.perf_counter() - t1) * 1e3
+            if self._running:
+                t1 = time.perf_counter()
+                tokens_out += self._decode_once()
+                decode_ms += (time.perf_counter() - t1) * 1e3
+            did = bool(tokens_out)
+            if did:
+                self._step_no += 1
+                with self._lock:
+                    self.counters["prefill_ms"] += prefill_ms
+                    self.counters["decode_ms"] += decode_ms
+                    self.counters["tokens_generated"] += tokens_out
+                if _sp.enabled():
+                    _sp.record_step(
+                        self._step_no,
+                        (time.perf_counter() - t0) * 1e3,
+                        tokens=tokens_out, prefill_ms=prefill_ms,
+                        decode_ms=decode_ms,
+                        running=len(self._running))
+            return did
+
+    def _shed_expired(self):
+        now = time.monotonic()
+        with self._lock:
+            keep = []
+            for req in self._waiting:
+                if req.deadline is not None and now > req.deadline:
+                    self.counters["requests_timed_out"] += 1
+                    req._fail("deadline passed before admission")
+                else:
+                    keep.append(req)
+            self._waiting = keep
+
+    def _admit_one(self) -> Optional[Request]:
+        """Pop the oldest waiting request whose worst-case page demand
+        fits right now (pages reserved up front: a running sequence can
+        never hit OutOfPages mid-decode)."""
+        with self._lock:
+            if not self._waiting or \
+                    len(self._running) >= self.config.max_running:
+                return None
+            req = self._waiting[0]
+            need = self.kv.pages_for_tokens(
+                len(req.prompt) + req.max_new_tokens)
+            try:
+                pages = self.kv.alloc(need, req)
+            except OutOfPagesError:
+                return None
+            self._waiting.pop(0)
+        req._pages = pages
+        return req
+
+    def _prefill(self, req: Request) -> int:
+        pages = req._pages
+        s = len(req.prompt)
+        bucket = min(b for b in self.config.prefill_buckets if b >= s)
+        toks = np.zeros((1, bucket), np.int32)
+        toks[0, :s] = req.prompt
+        next_logits, k, v = self._prefill_fns[bucket](
+            self.params, toks, np.asarray([s], np.int32))
+        self.kv.write_prefill(pages, np.asarray(k[0]),
+                              np.asarray(v[0]), s)
+        seq = _Sequence(req, pages, pos=s)
+        with self._lock:
+            self.counters["prefill_steps"] += 1
+        tok = int(np.argmax(np.asarray(next_logits[0])))
+        req._emit(tok)
+        if self._seq_finished(seq, tok):
+            self._finish(seq)
+        else:
+            with self._lock:
+                self._running.append(seq)
+        return 1
+
+    def _decode_once(self) -> int:
+        with self._lock:
+            runs = list(self._running)
+        bb = min(b for b in self.config.batch_buckets
+                 if b >= len(runs))
+        tokens = np.zeros(bb, np.int32)
+        positions = np.zeros(bb, np.int32)
+        page_table = np.zeros((bb, self.max_pages_per_seq), np.int32)
+        for i, seq in enumerate(runs):
+            tokens[i] = seq.last_token
+            positions[i] = seq.pos
+            page_table[i, :len(seq.pages)] = seq.pages
+        logits, new_k, new_v = self._decode_fns[bb](
+            self.params, tokens, positions,
+            self.kv.k_pages, self.kv.v_pages, page_table)
+        logits = np.asarray(logits)
+        new_k = np.asarray(new_k)
+        new_v = np.asarray(new_v)
+        finished = []
+        for i, seq in enumerate(runs):
+            self.kv.append(seq.pages, seq.pos, new_k[i], new_v[i])
+            seq.pos += 1
+            tok = int(np.argmax(logits[i]))
+            seq.req._emit(tok)
+            if self._seq_finished(seq, tok):
+                finished.append(seq)
+        with self._lock:
+            self.counters["decode_steps"] += 1
+        for seq in finished:
+            self._finish(seq)
+        return len(runs)
+
+    def _seq_finished(self, seq: _Sequence, tok: int) -> bool:
+        if seq.n_generated >= seq.req.max_new_tokens:
+            seq.req.finish_reason = "length"
+            return True
+        if self.config.eos_token is not None and \
+                tok == self.config.eos_token:
+            seq.req.finish_reason = "stop"
+            return True
+        return False
+
+    def _finish(self, seq: _Sequence):
+        self.kv.free(seq.pages, seq.req)
+        with self._lock:
+            if seq in self._running:
+                self._running.remove(seq)
+            self.counters["requests_completed"] += 1
+        seq.req._finish(seq.req.finish_reason or "length")
+
+    # -- pump thread ------------------------------------------------------
+
+    def start(self):
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._pump, name="llm-engine", daemon=True)
+        self._thread.start()
+
+    def _pump(self):
+        while not self._stop.is_set():
+            if not self.step():
+                self._work.clear()
+                self._work.wait(0.02)
+
+    def stop(self):
+        self._stop.set()
+        self._work.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+
+    # -- lifecycle / introspection ---------------------------------------
+
+    def has_work(self) -> bool:
+        with self._lock:
+            return bool(self._waiting or self._running)
+
+    def run_until_idle(self, timeout: float = 60.0):
+        """Drive the engine inline (no pump thread) until drained."""
+        deadline = time.monotonic() + timeout
+        while self.has_work():
+            if time.monotonic() > deadline:
+                raise TimeoutError("engine did not drain")
+            if not self.step():
+                time.sleep(0.001)
+
+    def quiesce(self, timeout: float = 60.0):
+        """Wait for all in-flight work, then prove zero live KV pages."""
+        deadline = time.monotonic() + timeout
+        while self.has_work():
+            if time.monotonic() > deadline:
+                raise TimeoutError("engine did not quiesce")
+            if self._thread is None:
+                self.step()
+            else:
+                time.sleep(0.002)
+        # a request's done-event fires inside the step, before the
+        # step's own counter accounting lands — barrier on any
+        # in-flight step so metrics read after quiesce are settled
+        with self._step_lock:
+            pass
+        self.kv.assert_quiesced()
+
+    def shutdown(self) -> int:
+        """Stop the pump and drop the KV arena; returns leaked pages
+        (0 after a clean quiesce). Waiting requests are failed."""
+        self.stop()
+        with self._lock:
+            waiting, self._waiting = self._waiting, []
+        for req in waiting:
+            req._fail("engine shut down")
+        _metrics.DEFAULT_REGISTRY.register_callback(
+            "serve_llm", lambda: "")
+        return self.kv.close()
+
+    def metrics(self) -> Dict[str, Any]:
+        with self._lock:
+            out = dict(self.counters)
+            out.update(
+                queue_depth=len(self._waiting),
+                running=len(self._running),
+                kv_pages_live=self.kv.live_pages,
+                kv_pages_total=self.kv.num_pages,
+                kv_page_utilization=self.kv.utilization(),
+                kv_arena_id=self.kv.arena_id_hex,
+                model=self.model_name,
+            )
+        return out
+
+    def _metrics_text(self) -> str:
+        m = self.metrics()
+        lines = [
+            "# TYPE serve_llm_running_seqs gauge",
+            f"serve_llm_running_seqs {m['running']}",
+            "# TYPE serve_llm_waiting_seqs gauge",
+            f"serve_llm_waiting_seqs {m['queue_depth']}",
+            "# TYPE serve_llm_kv_pages_live gauge",
+            f"serve_llm_kv_pages_live {m['kv_pages_live']}",
+            "# TYPE serve_llm_kv_page_utilization gauge",
+            f"serve_llm_kv_page_utilization "
+            f"{m['kv_page_utilization']:.6f}",
+            "# TYPE serve_llm_tokens_generated_total counter",
+            f"serve_llm_tokens_generated_total "
+            f"{int(m['tokens_generated'])}",
+            "# TYPE serve_llm_requests_completed_total counter",
+            f"serve_llm_requests_completed_total "
+            f"{int(m['requests_completed'])}",
+            "# TYPE serve_llm_requests_timed_out_total counter",
+            f"serve_llm_requests_timed_out_total "
+            f"{int(m['requests_timed_out'])}",
+            "# TYPE serve_llm_prefill_ms_total counter",
+            f"serve_llm_prefill_ms_total {m['prefill_ms']:.3f}",
+            "# TYPE serve_llm_decode_ms_total counter",
+            f"serve_llm_decode_ms_total {m['decode_ms']:.3f}",
+        ]
+        return "\n".join(lines) + "\n"

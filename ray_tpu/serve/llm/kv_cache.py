@@ -1,0 +1,230 @@
+"""Paged KV-cache backed by the shm object plane.
+
+vLLM-style paged attention (reference: vllm `block_manager.py` /
+`PagedAttention`), mapped onto this repo's primitives: the backing arena
+is ONE shm-store allocation (`ObjectStore.create_buffer`) sliced into
+fixed-size pages of shape [n_layer, block_size, n_kv_head, head_dim] per
+K and V. The engine hands the kernel the whole arena plus per-sequence
+page tables (gather indices) — growing a sequence never moves bytes,
+only appends a page id, so decode dispatch is copy-free on the host
+side.
+
+Accounting is strict: every page is either on the free list or owned by
+exactly one sequence, `free()` of a foreign/unallocated page raises, and
+`assert_quiesced()` proves zero live pages — the leak gate the engine
+(and the chaos replica-kill test) hold the plane to.
+
+On a dead replica the arena is reclaimed store-side by id
+(`reclaim_arena`): the arena object is sealed at creation so peers on
+the node can see it via `contains` and force-delete it even though the
+dead process never released its creator reference (single-node reclaim;
+a multi-node controller would route this through the owning raylet).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+class KVCacheError(RuntimeError):
+    pass
+
+
+class OutOfPagesError(KVCacheError):
+    """Allocation would exceed the arena; caller should queue, not crash."""
+
+
+class PagedKVCache:
+    """Fixed-size K/V page allocator over a contiguous arena.
+
+    Arena layout: float array [2, num_pages, n_layer, block_size,
+    n_kv_head, head_dim]; index 0 is K, 1 is V. `k_pages`/`v_pages` are
+    zero-copy numpy views handed to the decode kernel together with
+    per-sequence gather indices (`page table` rows).
+
+    `store=None` backs the arena with plain process-local numpy (unit
+    tests, in-process bench); otherwise the arena lives in the shm
+    object store and is visible to — and reclaimable by — other workers
+    on the node.
+    """
+
+    def __init__(self, num_pages: int, n_layer: int, block_size: int,
+                 n_kv_head: int, head_dim: int, dtype=np.float32,
+                 store=None):
+        if num_pages <= 0 or block_size <= 0:
+            raise KVCacheError("num_pages and block_size must be positive")
+        self.num_pages = num_pages
+        self.n_layer = n_layer
+        self.block_size = block_size
+        self.n_kv_head = n_kv_head
+        self.head_dim = head_dim
+        self.dtype = np.dtype(dtype)
+        self._store = store
+        self._arena_id = None
+        self._lock = threading.Lock()
+        shape = (2, num_pages, n_layer, block_size, n_kv_head, head_dim)
+        nbytes = int(np.prod(shape)) * self.dtype.itemsize
+        if store is not None:
+            from ray_tpu._private.ids import ObjectID
+            self._arena_id = ObjectID.from_random()
+            buf = store.create_buffer(self._arena_id, nbytes)
+            # Seal immediately (contents stay mutable through our view —
+            # seal here only publishes the id so `contains`/`delete`
+            # work from peer processes for dead-replica reclaim). The
+            # creator reference is kept until close(), pinning the
+            # arena against eviction.
+            store.seal(self._arena_id)
+            self._arena = np.frombuffer(buf, dtype=self.dtype).reshape(shape)
+        else:
+            self._arena = np.zeros(shape, dtype=self.dtype)
+        self._arena[:] = 0
+        self.k_pages = self._arena[0]
+        self.v_pages = self._arena[1]
+        # LIFO free list: recently-freed pages are re-used first (warm)
+        self._free: List[int] = list(range(num_pages - 1, -1, -1))
+        self._owner: Dict[int, object] = {}
+        self._closed = False
+
+    # -- allocation -------------------------------------------------------
+
+    @property
+    def arena_id_hex(self) -> Optional[str]:
+        return self._arena_id.hex() if self._arena_id is not None else None
+
+    @property
+    def arena_nbytes(self) -> int:
+        return int(self._arena.nbytes)
+
+    @property
+    def free_pages(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    @property
+    def live_pages(self) -> int:
+        with self._lock:
+            return len(self._owner)
+
+    def utilization(self) -> float:
+        with self._lock:
+            return len(self._owner) / self.num_pages
+
+    def pages_for_tokens(self, n_tokens: int) -> int:
+        return -(-n_tokens // self.block_size)  # ceil div
+
+    def alloc(self, n: int, owner) -> List[int]:
+        """Take `n` pages for `owner`; raises OutOfPagesError when the
+        arena can't satisfy the request (nothing is partially taken)."""
+        with self._lock:
+            self._check_open()
+            if n > len(self._free):
+                raise OutOfPagesError(
+                    f"need {n} pages, {len(self._free)} free "
+                    f"of {self.num_pages}")
+            pages = [self._free.pop() for _ in range(n)]
+            for p in pages:
+                self._owner[p] = owner
+            return pages
+
+    def free(self, pages: List[int], owner) -> None:
+        """Return pages to the free list; raises on double-free or a
+        page the owner doesn't hold (accounting bugs fail loudly)."""
+        with self._lock:
+            self._check_open()
+            for p in pages:
+                if self._owner.get(p) is not owner:
+                    raise KVCacheError(
+                        f"free of page {p} not held by owner "
+                        f"(held by {self._owner.get(p)!r})")
+            for p in pages:
+                del self._owner[p]
+                self._free.append(p)
+
+    # -- data plane -------------------------------------------------------
+
+    def append(self, pages: List[int], pos: int, k, v) -> None:
+        """Write one token's K/V ([n_layer, n_kv_head, head_dim]) at
+        logical position `pos` of a sequence holding `pages`."""
+        page = pages[pos // self.block_size]
+        off = pos % self.block_size
+        # data-plane writes are lock-free by design: the engine's step
+        # thread is the single writer, and a page belongs to exactly
+        # one sequence (the lock guards only the allocator maps)
+        # raylint: disable=lock-discipline
+        self.k_pages[page, :, off] = k
+        # raylint: disable=lock-discipline
+        self.v_pages[page, :, off] = v
+
+    def write_prefill(self, pages: List[int], k_seq, v_seq, n: int) -> None:
+        """Bulk-write a prefill's K/V ([seq, n_layer, n_kv_head,
+        head_dim]) for positions [0, n) across the sequence's pages."""
+        bs = self.block_size
+        # arena page layout is [n_layer, block, kvh, hd]; the prefill
+        # slab is [seq, n_layer, kvh, hd] -> swap to [n_layer, seq, ...]
+        for start in range(0, n, bs):
+            stop = min(start + bs, n)
+            page = pages[start // bs]
+            # single-writer data plane, same as append()
+            # raylint: disable=lock-discipline
+            self.k_pages[page, :, :stop - start] = \
+                np.swapaxes(k_seq[start:stop], 0, 1)
+            # raylint: disable=lock-discipline
+            self.v_pages[page, :, :stop - start] = \
+                np.swapaxes(v_seq[start:stop], 0, 1)
+
+    # -- lifecycle --------------------------------------------------------
+
+    def assert_quiesced(self) -> None:
+        with self._lock:
+            if self._owner:
+                raise KVCacheError(
+                    f"KV page leak: {len(self._owner)} live pages at "
+                    f"quiesce (owners: "
+                    f"{sorted(set(map(repr, self._owner.values())))[:4]})")
+            if len(self._free) != self.num_pages:
+                raise KVCacheError(
+                    f"free-list corrupt: {len(self._free)} != "
+                    f"{self.num_pages}")
+
+    def close(self) -> int:
+        """Drop the arena. Returns the number of pages still live (0
+        when the engine quiesced cleanly)."""
+        with self._lock:
+            if self._closed:
+                return 0
+            self._closed = True
+            leaked = len(self._owner)
+            self.k_pages = self.v_pages = None
+            self._arena = None
+            if self._store is not None and self._arena_id is not None:
+                try:
+                    self._store.release(self._arena_id)
+                    self._store.delete(self._arena_id)
+                except Exception:
+                    pass  # store already torn down
+            return leaked
+
+    def _check_open(self):
+        if self._closed:
+            raise KVCacheError("KV cache is closed")
+
+
+def reclaim_arena(arena_id_hex: str, store=None) -> bool:
+    """Force-delete a (possibly dead) replica's KV arena by id from any
+    process attached to the same node store. Returns True when the arena
+    was present and is now gone."""
+    if store is None:
+        from ray_tpu._private.object_ref import get_core_worker
+        cw = get_core_worker()
+        if cw is None or cw.store is None:
+            return False
+        store = cw.store
+    from ray_tpu._private.ids import ObjectID
+    oid = ObjectID.from_hex(arena_id_hex)
+    if not store.contains(oid):
+        return False
+    store.delete(oid)
+    return not store.contains(oid)

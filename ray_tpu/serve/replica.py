@@ -251,10 +251,22 @@ class Replica:
         return target is not None and (
             inspect.isgeneratorfunction(target))
 
-    def get_metrics(self) -> Dict[str, float]:
+    def get_metrics(self) -> Dict[str, Any]:
         with self._lock:
-            return {"ongoing": float(self._ongoing),
-                    "total": float(self._total)}
+            out: Dict[str, Any] = {"ongoing": float(self._ongoing),
+                                   "total": float(self._total)}
+        if not self._is_function and hasattr(
+                self._callable, "get_autoscaling_metrics"):
+            # deployment-provided load signals (serve.llm: queue depth,
+            # KV-page occupancy, arena id for dead-replica reclaim) ride
+            # the same poll the controller already makes
+            try:
+                extra = self._callable.get_autoscaling_metrics()
+                if isinstance(extra, dict):
+                    out.update(extra)
+            except Exception:  # noqa: BLE001 — a bad user callable must
+                pass           # not break liveness polling
+        return out
 
     def reconfigure(self, user_config: Dict) -> None:
         if not self._is_function and hasattr(self._callable, "reconfigure"):

@@ -560,3 +560,86 @@ def test_commit_kill_walks_back_to_gang_durable(tmp_path):
                                                      abs=0.0)
     finally:
         ray_tpu.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# serve.llm: replica kill mid-stream (seeded, deterministic)
+# ---------------------------------------------------------------------------
+
+
+def test_chaos_llm_replica_kill_midstream():
+    """Kill the replica serving a token stream mid-generation. The
+    handle must fail over to the surviving replica and replay-skip the
+    already-delivered chunks (greedy decode is deterministic and both
+    replicas share a seed, so the resumed stream is the SAME stream) —
+    no accepted request is lost. Afterwards the controller reconciles
+    the death and force-reclaims the dead replica's KV arena from the
+    shm store: a killed replica leaks zero KV pages."""
+    from ray_tpu import serve
+    from ray_tpu._private.ids import ObjectID
+    from ray_tpu._private.object_ref import get_core_worker
+    from ray_tpu.serve.llm import LLMDeployment
+
+    ray_tpu.init(num_cpus=8, num_tpus=0,
+                 object_store_memory=256 * 1024 * 1024)
+    try:
+        class SlowLLM(LLMDeployment):
+            """Per-chunk delay so the kill reliably lands mid-stream."""
+
+            def generate(self, prompt, max_new_tokens=16,
+                         timeout_s=None):
+                for chunk in LLMDeployment.generate(
+                        self, prompt, max_new_tokens, timeout_s):
+                    time.sleep(0.05)
+                    yield chunk
+
+        app = serve.deployment(name="llm", num_replicas=2)(
+            SlowLLM).bind(seed=0)
+        handle = serve.run(app)
+        ctrl = ray_tpu.get_actor("SERVE_CONTROLLER")
+        # prime the controller's metrics cache (arena ids) pre-kill
+        ray_tpu.get(ctrl.reconcile_now.remote(), timeout=60)
+
+        n_tokens = 24
+        gen = handle.generate.options(stream=True).remote(
+            [5, 9, 3], n_tokens)
+        tokens = [next(gen)["token"] for _ in range(4)]
+
+        # find the replica carrying the stream (ongoing >= 1) and
+        # remember its arena id, then murder it
+        info = ray_tpu.get(ctrl.get_replicas.remote("llm"), timeout=30)
+        serving = dead_arena = None
+        for r in info["replicas"]:
+            m = ray_tpu.get(r.get_metrics.remote(), timeout=30)
+            if m["ongoing"] >= 1 and serving is None:
+                serving, dead_arena = r, m["kv_arena_id"]
+        assert serving is not None and dead_arena
+        ray_tpu.kill(serving)
+
+        # the stream completes on the survivor via replay
+        for chunk in gen:
+            tokens.append(chunk["token"])
+        assert len(tokens) == n_tokens
+
+        # ground truth: a fresh request (now served by the survivor)
+        rerun = handle.generate_once.remote([5, 9, 3], n_tokens).result(
+            timeout=120)
+        assert tokens == rerun  # the failed-over stream lost nothing
+
+        # reconcile notices the death and reclaims the dead arena
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            ray_tpu.get(ctrl.reconcile_now.remote(), timeout=60)
+            reclaimed = ray_tpu.get(
+                ctrl.get_reclaimed_arenas.remote(), timeout=30)
+            if dead_arena in reclaimed:
+                break
+            time.sleep(0.5)
+        else:
+            raise AssertionError("dead replica's KV arena never "
+                                 "reclaimed")
+        store = get_core_worker().store
+        assert not store.contains(ObjectID.from_hex(dead_arena))
+    finally:
+        serve.shutdown()
+        ray_tpu.shutdown()
