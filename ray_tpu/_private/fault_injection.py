@@ -55,20 +55,84 @@ Grammar (``;``-separated ``key=value`` pairs)::
   - ``commit_kill=rank:index`` kills a train worker whose session has no
     restore checkpoint (i.e. the first attempt) right after it persisted
     its shard for report ``index`` — inside the gang-commit window
+
+Timed schedule (wall-clock faults, PR 8): ``at=`` entries layer faults
+that fire at seeded wall-clock *offsets* instead of draw counts — the
+injection trigger a multi-hour soak needs (a preemption lands at minute
+37, not at heartbeat #6). Grammar::
+
+    at=<offset_s>:<fault>[:<arg>][@<role>]
+
+repeatable (``at=…;at=…``) or ``|``-separated inside one value. Faults:
+
+  - ``kill``              — ``os._exit(1)`` at the offset (abrupt death)
+  - ``crash_loop:<k>``    — re-arm ``spawn_fail`` for the next k spawns
+  - ``hb_brownout:<dur>`` — drop every GCS heartbeat for ``dur`` seconds
+  - ``data_stall:<dur>``  — data-plane block reads stall for ``dur`` s
+  - ``ckpt_fail[:<n>]``   — next n checkpoint persists raise ChaosError
+
+``@role`` scopes the entry to processes of that role (``driver``,
+``gcs``, ``raylet``, ``worker``, ``train`` — the last arms at train
+SESSION init, so it targets actual train ranks rather than idle task
+workers); unscoped entries arm in any process. Entries arm when
+:func:`set_role` (called by each daemon's ``__main__``) or
+:meth:`FaultPlan.arm_timed` runs. Offsets are anchored to the
+``RAY_TPU_CHAOS_EPOCH`` wall-clock timestamp when that env var is set
+(the soak driver exports it at run start, so ``at=37`` means 37 s into
+the SOAK regardless of when a restarted attempt re-arms the plan;
+entries whose fire time already passed at arm are recorded as expired
+and skipped); without the epoch, offsets run from arm time.
+A daemon timer thread sleeps to each offset and fires it; state flips
+happen under ``_timed_lock`` but the fire itself (record / export /
+exit) runs OUTSIDE the lock — raylint's blocking-under-lock checker
+flags the inverted shape. When ``RAY_TPU_CHAOS_LOG`` is set, each timed
+entry is gated by a once-sentinel file in that directory so a fault
+fires exactly once per soak run even though restarted attempts re-read
+the same plan from the environment and re-arm it.
+
+Post-mortem export: with ``RAY_TPU_CHAOS_LOG=<dir>`` every process
+dumps its replay artifact (spec, seed, the ``(site, draw_seq,
+decision)`` schedule, timed entries + actual fire timestamps) to
+``chaos-<role>-<pid>.json`` at exit — including synchronously before
+every ``os._exit`` path, which ``atexit`` would miss.
+:meth:`FaultPlan.from_artifact` rebuilds the identical plan from an
+artifact, so any soak failure replays exactly.
 """
 
 from __future__ import annotations
 
 import asyncio
+import atexit
+import json
 import logging
 import os
 import random
-from typing import Dict, List, Optional, Tuple
+import threading
+import time
+from typing import Any, Dict, List, NamedTuple, Optional, Tuple
 
 logger = logging.getLogger(__name__)
 
 ENV_VAR = "RAY_TPU_CHAOS"
+LOG_ENV = "RAY_TPU_CHAOS_LOG"
+EPOCH_ENV = "RAY_TPU_CHAOS_EPOCH"
 _LOG_CAP = 8192
+# an entry armed after its anchored fire time fires anyway if it is at
+# most this late (timer scheduling slop); later than this it expires
+_ARM_GRACE_S = 1.0
+
+_TIMED_FAULTS = ("kill", "crash_loop", "hb_brownout", "data_stall",
+                 "ckpt_fail")
+_ROLES = ("driver", "gcs", "raylet", "worker", "train")
+
+
+class TimedFault(NamedTuple):
+    """One wall-clock-scheduled fault: fires `offset` seconds after the
+    plan is armed in a process whose role matches (None = any)."""
+    offset: float
+    fault: str
+    arg: float
+    role: Optional[str]
 
 
 class ChaosError(RuntimeError):
@@ -92,6 +156,41 @@ def _parse_delay(value: str, key: str) -> Tuple[float, float]:
     return 1.0, float(value)
 
 
+def _parse_timed(value: str) -> List[TimedFault]:
+    """Parse one ``at=`` value: ``|``-separated
+    ``<offset>:<fault>[:<arg>][@<role>]`` entries."""
+    out: List[TimedFault] = []
+    for entry in filter(None, (e.strip() for e in value.split("|"))):
+        role: Optional[str] = None
+        body = entry
+        if "@" in entry:
+            body, role = entry.rsplit("@", 1)
+            if role not in _ROLES:
+                raise ValueError(
+                    f"at: unknown role {role!r} (supported: {_ROLES})")
+        parts = body.split(":")
+        if len(parts) < 2:
+            raise ValueError(
+                f"at: entry {entry!r} is not <offset>:<fault>[:<arg>]")
+        offset = float(parts[0])
+        fault = parts[1]
+        if fault not in _TIMED_FAULTS:
+            raise ValueError(f"at: unknown fault {fault!r} "
+                             f"(supported: {_TIMED_FAULTS})")
+        if fault == "kill":
+            if len(parts) > 2:
+                raise ValueError("at: kill takes no argument")
+            arg = 0.0
+        elif fault == "ckpt_fail":
+            arg = float(parts[2]) if len(parts) > 2 else 1.0
+        else:  # crash_loop / hb_brownout / data_stall need an argument
+            if len(parts) < 3:
+                raise ValueError(f"at: {fault} requires an argument")
+            arg = float(parts[2])
+        out.append(TimedFault(offset, fault, arg, role))
+    return out
+
+
 class FaultPlan:
     """A parsed, seeded fault schedule. Immutable configuration +
     per-site deterministic RNG streams and draw counters."""
@@ -113,6 +212,7 @@ class FaultPlan:
         self.pull_delay: Tuple[float, float] = (0.0, 0.0)
         self.kill_node: Optional[Tuple[str, int]] = None
         self.commit_kill: Optional[Tuple[int, int]] = None
+        self.timed: List[TimedFault] = []
         for part in filter(None, (p.strip() for p in spec.split(";"))):
             if "=" not in part:
                 raise ValueError(f"chaos spec entry {part!r} is not key=value")
@@ -158,6 +258,8 @@ class FaultPlan:
             elif key == "commit_kill":
                 rank, index = value.split(":", 1)
                 self.commit_kill = (int(rank), int(index))
+            elif key == "at":
+                self.timed.extend(_parse_timed(value))
             else:
                 raise ValueError(f"unknown chaos key {key!r}")
         self._send_active = (self.rpc_drop > 0 or self.rpc_dup > 0
@@ -170,6 +272,15 @@ class FaultPlan:
         self.schedule: List[Tuple[str, int, str]] = []
         self._spawn_attempts = 0
         self._heartbeats_sent = 0
+        # -- timed-schedule state (guarded by _timed_lock where noted) --
+        self.installed_ts = time.time()
+        self.timed_fired: List[Dict[str, Any]] = []
+        self._timed_lock = threading.Lock()
+        self._timed_stop = threading.Event()
+        self._armed: set = set()           # indices into self.timed
+        self._brownout_until = 0.0         # wall ts; write under lock
+        self._stall_until = 0.0            # wall ts; write under lock
+        self._ckpt_fail_pending = 0        # write under lock
 
     # -- deterministic draw machinery -----------------------------------
 
@@ -253,6 +364,9 @@ class FaultPlan:
         """Delay and/or swallow one heartbeat at the GCS handler. True
         means the heartbeat is dropped (handler must return without
         touching liveness state)."""
+        if time.time() < self._brownout_until:
+            self._record("gcs.heartbeat", "brownout-drop")
+            return True
         if self.heartbeat_delay > 0:
             self._record("gcs.heartbeat", f"delay={self.heartbeat_delay}")
             await asyncio.sleep(self.heartbeat_delay)
@@ -274,15 +388,17 @@ class FaultPlan:
     def spawn_attempt(self) -> None:
         """Raise ChaosError for the first `spawn_fail` worker spawns of
         this raylet process."""
-        if self.spawn_fail <= 0:
-            return
-        self._spawn_attempts += 1
-        if self._spawn_attempts <= self.spawn_fail:
-            self._record("raylet.spawn",
-                         f"fail#{self._spawn_attempts}")
+        # a timed crash_loop firing re-seeds spawn_fail/_spawn_attempts
+        # from the schedule's timer thread — count under the same lock
+        with self._timed_lock:
+            if self.spawn_fail <= 0:
+                return
+            self._spawn_attempts += 1
+            n, limit = self._spawn_attempts, self.spawn_fail
+        if n <= limit:
+            self._record("raylet.spawn", f"fail#{n}")
             raise ChaosError(
-                f"chaos: injected worker spawn failure "
-                f"{self._spawn_attempts}/{self.spawn_fail}")
+                f"chaos: injected worker spawn failure {n}/{limit}")
 
     async def lease_request(self) -> None:
         dp, ds = self.lease_delay
@@ -302,6 +418,7 @@ class FaultPlan:
                          f"heartbeat#{self._heartbeats_sent}")
             logger.warning("chaos: killing node after %d heartbeats",
                            self._heartbeats_sent)
+            self.export_artifact()  # atexit never runs past os._exit
             os._exit(1)
 
     # -- core worker -----------------------------------------------------
@@ -327,7 +444,185 @@ class FaultPlan:
                          f"kill rank={rank} index={index}")
             logger.warning("chaos: killing rank %d before gang commit of "
                            "report %d", rank, index)
+            self.export_artifact()  # atexit never runs past os._exit
             os._exit(1)
+
+    def checkpoint_persist(self) -> None:
+        """Raise ChaosError for the next `ckpt_fail` checkpoint persists
+        (armed by the timed schedule). The failure propagates out of
+        `report()` like a real storage fault, failing the attempt before
+        the gang commit — the retry walks back to the last durable
+        checkpoint."""
+        fire = False
+        with self._timed_lock:
+            if self._ckpt_fail_pending > 0:
+                self._ckpt_fail_pending -= 1
+                fire = True
+        if fire:
+            self._record("train.ckpt_persist", "fail")
+            raise ChaosError("chaos: injected checkpoint persist failure")
+
+    # -- data plane ------------------------------------------------------
+
+    def data_read_sync(self) -> None:
+        """Synchronous data-source stall: block-read paths sleep out the
+        remainder of an active `data_stall` window (models an ingest
+        source brownout — object-store pulls stop completing)."""
+        remaining = self._stall_until - time.time()
+        if remaining > 0:
+            self._record("data.read", f"stall={remaining:.3f}")
+            time.sleep(remaining)
+
+    # -- timed schedule (wall-clock offsets) -----------------------------
+
+    def arm_timed(self, role: str) -> None:
+        """Arm every not-yet-armed timed entry matching `role` (entries
+        with no role match any process). Offsets are anchored to
+        RAY_TPU_CHAOS_EPOCH when set (wall-clock soak time — a
+        restarted attempt re-arming the plan keeps the original
+        schedule), else to NOW. A daemon timer thread fires them.
+        Idempotent per entry; entries already more than _ARM_GRACE_S
+        past their anchored fire time expire instead of firing into the
+        middle of a fresh attempt."""
+        epoch = os.environ.get(EPOCH_ENV, "")
+        now = time.time()
+        try:
+            base = float(epoch) if epoch else now
+        except ValueError:
+            base = now
+        due: List[Tuple[int, TimedFault]] = []
+        expired: List[TimedFault] = []
+        with self._timed_lock:
+            for i, tf in enumerate(self.timed):
+                if i in self._armed:
+                    continue
+                if tf.role is not None and tf.role != role:
+                    continue
+                self._armed.add(i)
+                if now - (base + tf.offset) > _ARM_GRACE_S:
+                    expired.append(tf)
+                else:
+                    due.append((i, tf))
+        for tf in expired:
+            self._record(f"timed.{tf.fault}",
+                         f"expired:t+{tf.offset:g}")
+        if not due:
+            return
+        thread = threading.Thread(
+            target=self._timed_run, args=(due, base),
+            daemon=True, name=f"chaos-timed-{role}")
+        thread.start()
+
+    def _timed_run(self, due: List[Tuple[int, TimedFault]],
+                   base: float) -> None:
+        """Timer loop: sleep to each anchored fire time, then fire. All
+        sleeping and firing happens OUTSIDE _timed_lock — only the
+        state flip inside _fire_timed takes it."""
+        for _, tf in sorted(due, key=lambda d: d[1].offset):
+            while not self._timed_stop.is_set():
+                remaining = base + tf.offset - time.time()
+                if remaining <= 0:
+                    break
+                self._timed_stop.wait(min(remaining, 0.05))
+            if self._timed_stop.is_set():
+                return
+            self._fire_timed(tf)
+
+    def _fire_timed(self, tf: TimedFault) -> None:
+        if not self._claim_once(tf):
+            return
+        now = time.time()
+        with self._timed_lock:
+            if tf.fault == "hb_brownout":
+                self._brownout_until = now + tf.arg
+            elif tf.fault == "data_stall":
+                self._stall_until = now + tf.arg
+            elif tf.fault == "ckpt_fail":
+                self._ckpt_fail_pending += int(tf.arg)
+            elif tf.fault == "crash_loop":
+                self.spawn_fail = int(tf.arg)
+                self._spawn_attempts = 0
+        # record / log / export / exit OUTSIDE the lock: _record appends,
+        # export does file IO, and os._exit never returns
+        self._record(f"timed.{tf.fault}", f"t+{tf.offset}:{tf.arg}")
+        self.timed_fired.append(
+            {"fault": tf.fault, "offset": tf.offset, "arg": tf.arg,
+             "ts": now})
+        logger.warning("chaos: timed fault %s fired at t+%.1fs (role=%s)",
+                       tf.fault, tf.offset, _ROLE)
+        if tf.fault == "kill":
+            self.export_artifact()  # atexit never runs past os._exit
+            os._exit(1)
+
+    def _claim_once(self, tf: TimedFault) -> bool:
+        """With RAY_TPU_CHAOS_LOG set, each timed entry fires exactly
+        once per soak run — restarted attempts re-arm the same plan from
+        the environment, and the sentinel file (atomic O_EXCL create)
+        makes the re-armed copy a no-op. For `kill` the sentinel also
+        picks a single victim when several processes of the role armed
+        the same entry. Without a log dir, fire once per process."""
+        log_dir = os.environ.get(LOG_ENV, "")
+        if not log_dir:
+            return True
+        tag = f"{tf.fault}-{tf.offset:g}-{tf.role or 'any'}"
+        try:
+            os.makedirs(log_dir, exist_ok=True)
+            fd = os.open(os.path.join(log_dir, f"once-{tag}"),
+                         os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            os.close(fd)
+            return True
+        except FileExistsError:
+            return False
+        except OSError:
+            return True  # unwritable dir: fall back to per-process fire
+
+    # -- post-mortem artifact -------------------------------------------
+
+    def export_artifact(self, path: Optional[str] = None
+                        ) -> Optional[str]:
+        """Dump the replay artifact to JSON: the full spec + seed (enough
+        to rebuild the plan), the (site, draw_seq, decision) schedule,
+        and the timed entries with their actual fire timestamps. Default
+        destination: `$RAY_TPU_CHAOS_LOG/chaos-<role>-<pid>.json`
+        (no-op when neither a path nor the env dir is given)."""
+        if path is None:
+            log_dir = os.environ.get(LOG_ENV, "")
+            if not log_dir:
+                return None
+            path = os.path.join(
+                log_dir, f"chaos-{_ROLE}-{os.getpid()}.json")
+        data = {
+            "version": 1,
+            "spec": self.spec,
+            "seed": self.seed,
+            "role": _ROLE,
+            "pid": os.getpid(),
+            "installed_ts": self.installed_ts,
+            "exported_ts": time.time(),
+            "schedule": [list(s) for s in self.schedule],
+            "counts": dict(self._counts),
+            "timed": [tf._asdict() for tf in self.timed],
+            "timed_fired": list(self.timed_fired),
+        }
+        try:
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(data, f, indent=1)
+            os.replace(tmp, path)
+            return path
+        except OSError:
+            logger.exception("chaos: artifact export to %s failed", path)
+            return None
+
+    @classmethod
+    def from_artifact(cls, path: str) -> "FaultPlan":
+        """Rebuild the exact plan a previous run used from its exported
+        artifact: same spec → same seed → same per-site decision streams
+        and the same timed schedule, so the failure replays."""
+        with open(path) as f:
+            data = json.load(f)
+        return cls(data["spec"])
 
 
 # ---------------------------------------------------------------------------
@@ -335,6 +630,8 @@ class FaultPlan:
 # ---------------------------------------------------------------------------
 
 _PLAN: Optional[FaultPlan] = None
+_ROLE = "driver"
+_ATEXIT_REGISTERED = False
 
 
 def plan() -> Optional[FaultPlan]:
@@ -345,15 +642,47 @@ def active() -> bool:
     return _PLAN is not None
 
 
+def role() -> str:
+    return _ROLE
+
+
+def set_role(r: str) -> None:
+    """Declare this process's role (driver/gcs/raylet/worker) — called by
+    each daemon's `__main__` before serving. Arms any role-scoped (and
+    still-unarmed unscoped) timed entries of the active plan; offsets
+    run from now."""
+    global _ROLE
+    if r not in _ROLES:
+        raise ValueError(f"unknown chaos role {r!r}")
+    _ROLE = r
+    if _PLAN is not None:
+        _PLAN.arm_timed(r)
+
+
+def _atexit_export() -> None:
+    p = _PLAN
+    if p is not None:
+        p.export_artifact()
+
+
 def install(p: FaultPlan) -> FaultPlan:
-    global _PLAN
+    global _PLAN, _ATEXIT_REGISTERED
+    if _PLAN is not None:
+        _PLAN._timed_stop.set()
     _PLAN = p
+    if not _ATEXIT_REGISTERED:
+        # registered once; the hook reads the CURRENT plan, so it also
+        # covers plans installed later in this process
+        atexit.register(_atexit_export)
+        _ATEXIT_REGISTERED = True
     logger.warning("chaos plane active: %s", p.spec or "<programmatic>")
     return p
 
 
 def uninstall() -> None:
     global _PLAN
+    if _PLAN is not None:
+        _PLAN._timed_stop.set()
     _PLAN = None
 
 

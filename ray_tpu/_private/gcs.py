@@ -1046,6 +1046,7 @@ async def main(host: str, port: int, metrics_port=None,
     import os
     import signal
 
+    _fi.set_role("gcs")  # arm gcs-scoped timed faults (offsets from now)
     server = GcsServer(host, port, persist_path=persist_path,
                        store_path=store_path)
     await server.start(metrics_port=metrics_port)

@@ -1603,6 +1603,7 @@ class Raylet:
 
 
 async def main(args):
+    _fi.set_role("raylet")  # arm raylet-scoped timed faults
     resources = json.loads(args.resources) if args.resources else None
     raylet = Raylet(
         gcs_addr=args.gcs_addr,

@@ -38,10 +38,12 @@ def main():
 
     apply_jax_platforms(os.environ.get("JAX_PLATFORMS"))
 
+    from ray_tpu._private import fault_injection as _fi
     from ray_tpu._private.core_worker import CoreWorker
     from ray_tpu._private.ids import JobID
     from ray_tpu._private.object_store import ObjectStore
 
+    _fi.set_role("worker")  # arm worker-scoped timed faults
     chips = tuple(int(c) for c in args.tpu_chips.split(",") if c != "")
     store = ObjectStore.attach(args.store_name)
     cw = CoreWorker(

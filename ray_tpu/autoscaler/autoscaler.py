@@ -55,6 +55,11 @@ class Autoscaler:
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # serializes reconcile rounds: the background loop and direct
+        # update() callers (tests, drivers poking the scaler after a
+        # fault) must not interleave a snapshot with another round's
+        # provisioning — that is one half of the double-replacement bug
+        self._reconcile_lock = threading.Lock()
         #: instance_id -> max host count ever seen registered; a drop
         #: below it means a host DIED (vs never booted) — the slice is
         #: broken, not booting
@@ -68,7 +73,8 @@ class Autoscaler:
 
     def update(self) -> Dict[str, int]:
         """Run one reconcile round; returns {"launched": n, "terminated": m}."""
-        return asyncio.run(self._update_async())
+        with self._reconcile_lock:
+            return asyncio.run(self._update_async())
 
     async def _update_async(self) -> Dict[str, int]:
         gcs = await self._clients.get(self.gcs_addr)
@@ -105,6 +111,9 @@ class Autoscaler:
         avail_pool = [dict(n["available"]) for n in load["nodes"]]
         registered = {self._node_id(n) for n in load["nodes"]}
         instances = self.provider.non_terminated_nodes()
+        # ids at snapshot time — the staleness re-check before
+        # provisioning compares against this set
+        snapshot_ids = {i.instance_id for i in instances}
         # slice_type -> number of instances still booting: each booting
         # slice absorbs exactly ONE pending topology demand (a set here
         # would collapse N concurrently-provisioning slices into one and
@@ -188,7 +197,7 @@ class Autoscaler:
         for inst in instances:
             type_counts[inst.node_type] = \
                 type_counts.get(inst.node_type, 0) + 1
-        launched = 0
+        planned_launches: List[NodeType] = []
 
         def may_launch(ntype: NodeType) -> bool:
             return (host_count + ntype.num_hosts <= self.max_workers
@@ -215,9 +224,8 @@ class Autoscaler:
                 continue
             logger.info("scaling up: slice %s (%d hosts)", topology,
                         ntype.num_hosts)
-            self.provider.create_node(ntype)
+            planned_launches.append(ntype)
             record_launch(ntype)
-            launched += ntype.num_hosts
 
         # bin-pack loose demands largest-first (reference:
         # ResourceDemandScheduler's utilization-based packing)
@@ -244,10 +252,38 @@ class Autoscaler:
             planned.append(fresh)
             planned_types.append(ntype)
             record_launch(ntype)
-        for ntype in planned_types:
             logger.info("scaling up: %s %s", ntype.name, ntype.resources)
+            planned_launches.append(ntype)
+        return self._provision(planned_launches, snapshot_ids)
+
+    def _provision(self, planned: List[NodeType],
+                   snapshot_ids: set) -> int:
+        """Launch the planned nodes, after a STALENESS RE-CHECK on the
+        provider listing: the plan was computed from a snapshot, and a
+        node that joined since (a concurrent recovery path replacing a
+        node killed mid-poll, an operator's manual launch) must absorb a
+        planned launch of its type instead of being doubled. Without
+        this, a kill landing between snapshot and provisioning is
+        replaced twice — once by whoever reacted first and once by this
+        round's stale plan (PR-2 controller pattern: re-validate state
+        immediately before acting on it)."""
+        if not planned:
+            return 0
+        fresh_counts: Dict[str, int] = {}
+        for inst in self.provider.non_terminated_nodes():
+            if inst.instance_id not in snapshot_ids:
+                fresh_counts[inst.node_type] = \
+                    fresh_counts.get(inst.node_type, 0) + 1
+        launched = 0
+        for ntype in planned:
+            if fresh_counts.get(ntype.name, 0) > 0:
+                fresh_counts[ntype.name] -= 1
+                logger.info(
+                    "skipping launch of %s: an instance of that type "
+                    "appeared since the demand snapshot", ntype.name)
+                continue
             self.provider.create_node(ntype)
-            launched += 1
+            launched += ntype.num_hosts if ntype.slice_type else 1
         return launched
 
     def _smallest_fitting_type(self, demand: Dict[str, float]

@@ -13,7 +13,16 @@ from typing import Any, Dict, Iterator, List, Optional
 import numpy as np
 
 import ray_tpu
+from ray_tpu._private import fault_injection as _fi
 from ray_tpu.data.block import Block, BlockAccessor
+
+
+def _maybe_stall() -> None:
+    # chaos plane: an active `data_stall` window makes every block read
+    # sleep it out (models an ingest-source brownout)
+    p = _fi._PLAN
+    if p is not None:
+        p.data_read_sync()
 
 
 class DataIterator:
@@ -23,6 +32,7 @@ class DataIterator:
     def _iter_blocks(self, prefetch: int = 0) -> Iterator[Block]:
         if prefetch <= 0:
             for ref in self._block_refs:
+                _maybe_stall()
                 yield ray_tpu.get(ref, timeout=600)
             return
         # Resolve up to `prefetch` blocks AHEAD of the consumer: the
@@ -43,6 +53,7 @@ class DataIterator:
                         break
                 if not window:
                     return
+                _maybe_stall()
                 yield window.popleft().result(timeout=600)
         finally:
             for f in window:
@@ -57,9 +68,27 @@ class DataIterator:
         prefetch_batches: int = 1,
         local_shuffle_buffer_size: Optional[int] = None,
         local_shuffle_seed: Optional[int] = None,
+        start_batch_index: int = 0,
     ) -> Iterator[Any]:
         """Yield dict-of-numpy (or pandas) batches of exactly batch_size
-        (except possibly the last)."""
+        (except possibly the last).
+
+        `start_batch_index` resumes consumption mid-shard: the first
+        `start_batch_index` batches (= `start_batch_index * batch_size`
+        rows of the deterministic block stream) are skipped, so an
+        elastic restore that persisted its read offset in the checkpoint
+        continues exactly where the committed step left off — no batch
+        duplicated, none skipped. Requires deterministic order
+        (incompatible with local shuffle); exact only for iterators with
+        a static block list (a `streaming_split` rebalances dynamically,
+        so its offsets are best-effort counts, not content-stable)."""
+        if start_batch_index < 0:
+            raise ValueError("start_batch_index must be >= 0")
+        if start_batch_index and local_shuffle_buffer_size:
+            raise ValueError(
+                "start_batch_index requires deterministic batch order; "
+                "disable local_shuffle_buffer_size")
+        skip_rows = start_batch_index * batch_size
         carry: Optional[Block] = None
         rng = (np.random.default_rng(local_shuffle_seed)
                if local_shuffle_buffer_size else None)
@@ -91,6 +120,13 @@ class DataIterator:
                 yield acc.take(rng.permutation(acc.num_rows()))
 
         for block in shuffled_blocks():
+            if skip_rows:
+                n_rows = BlockAccessor(block).num_rows()
+                if skip_rows >= n_rows:
+                    skip_rows -= n_rows
+                    continue
+                block = BlockAccessor(block).slice(skip_rows, n_rows)
+                skip_rows = 0
             if carry is not None:
                 block = BlockAccessor.concat([carry, block])
                 carry = None
@@ -221,6 +257,7 @@ class StreamSplitDataIterator(DataIterator):
                 pending.append(self._coord.next_block.remote())
             if not pending:
                 return
+            _maybe_stall()
             ref = ray_tpu.get(pending.popleft(), timeout=600)
             if ref is None:
                 done = True

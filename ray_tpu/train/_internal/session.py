@@ -68,6 +68,15 @@ class _TrainSession:
         self._commit_index = -1
         self._commit_abort: Optional[str] = None
         os.makedirs(config.trial_dir, exist_ok=True)
+        if config.gang_commit:
+            # chaos: this process now hosts a GANG train rank — arm
+            # train-scoped timed faults (RAY_TPU_CHAOS_LOG
+            # once-sentinels keep re-armed plans in restarted attempts
+            # from re-firing). Tune trial sessions (gang_commit=False,
+            # e.g. the Trainable controller hosting a nested Train run)
+            # must NOT arm: the controller would claim the sentinel and
+            # the fault would land outside any train rank.
+            _fi.set_role("train")
 
     # called from the user's train-fn thread
     def report(self, metrics: Dict[str, Any],
@@ -85,6 +94,11 @@ class _TrainSession:
 
                 from ray_tpu.util import step_profiler as _sp
 
+                if _fi._PLAN is not None:
+                    # chaos: injected persist failure (storage fault) —
+                    # raises before anything lands, failing the attempt
+                    # ahead of the gang commit
+                    _fi._PLAN.checkpoint_persist()
                 _t0 = _time.perf_counter()
                 persisted_path = self._persist_checkpoint(checkpoint)
                 # flight recorder: checkpoint persist time folds into

@@ -327,3 +327,96 @@ def test_boot_timeout_replaces_wedged_slice(scaling_cluster):
     autoscaler.update()  # past boot_timeout_s: terminated
     assert provider.non_terminated_nodes() == []
     assert api._qrs[inst.instance_id]["state"] == "DELETED"
+
+
+# -- replacement idempotence (staleness re-check before provisioning) -------
+
+
+class _StubProvider:
+    """In-memory NodeProvider: `_provision`'s staleness re-check is pure
+    provider accounting, so no raylets need to spawn to pin it."""
+
+    def __init__(self):
+        from ray_tpu.autoscaler.node_provider import Instance
+
+        self._Instance = Instance
+        self._instances = {}
+        self._n = 0
+
+    def create_node(self, node_type):
+        self._n += 1
+        inst = self._Instance(f"stub-{self._n}", node_type.name, [])
+        self._instances[inst.instance_id] = inst
+        return inst
+
+    def terminate_node(self, instance):
+        self._instances.pop(instance.instance_id, None)
+
+    def non_terminated_nodes(self):
+        return list(self._instances.values())
+
+
+def test_provision_absorbs_node_launched_after_snapshot():
+    """A launch plan computed from a stale provider snapshot must be
+    absorbed by a node of the same type that appeared since (a
+    concurrent recovery path, an operator's manual launch) — provisioning
+    on the stale plan would double-replace the node."""
+    provider = _StubProvider()
+    nt = NodeType("cpu4", {"CPU": 4.0})
+    # the reconciler never contacts the GCS in _provision, so a bogus
+    # address keeps this a pure unit test
+    autoscaler = Autoscaler("127.0.0.1:1", provider, [nt],
+                            max_workers=4, idle_timeout_s=9999)
+
+    # snapshot taken while the provider was empty ...
+    stale_snapshot = {i.instance_id for i in provider.non_terminated_nodes()}
+    # ... then a node of the planned type appears behind the plan's back
+    provider.create_node(nt)
+    launched = autoscaler._provision([nt], stale_snapshot)
+    assert launched == 0, "fresh node must absorb the planned launch"
+    assert len(provider.non_terminated_nodes()) == 1
+
+    # a node already IN the snapshot is old capacity the plan has seen
+    # (and found insufficient) — it must NOT absorb a new launch
+    current = {i.instance_id for i in provider.non_terminated_nodes()}
+    launched = autoscaler._provision([nt], current)
+    assert launched == 1
+    assert len(provider.non_terminated_nodes()) == 2
+
+    # one fresh node absorbs only ONE planned launch of its type
+    snapshot2 = {i.instance_id for i in provider.non_terminated_nodes()}
+    provider.create_node(nt)
+    launched = autoscaler._provision([nt, nt], snapshot2)
+    assert launched == 1
+    assert len(provider.non_terminated_nodes()) == 4
+
+
+def test_concurrent_updates_do_not_double_launch(scaling_cluster):
+    """Two reconcile rounds racing on the same unmet demand (the
+    background loop + a driver poking update() after a fault) must
+    launch ONE replacement, not two: rounds are serialized and the
+    later round sees the earlier one's launch as booting capacity."""
+    import threading
+
+    cluster, provider = scaling_cluster
+    autoscaler = Autoscaler(
+        cluster.gcs_addr, provider,
+        [NodeType("cpu4", {"CPU": 4.0})],
+        max_workers=4, idle_timeout_s=9999)
+
+    pg = ray_tpu.placement_group([{"CPU": 4.0}], strategy="PACK")
+    assert not pg.ready(timeout=2.0)  # infeasible on the 1-CPU head
+    _drain_heartbeat()
+
+    results = []
+    threads = [threading.Thread(
+        target=lambda: results.append(autoscaler.update()))
+        for _ in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert sum(r["launched"] for r in results) == 1
+    assert len(provider.non_terminated_nodes()) == 1
+    assert pg.ready(timeout=30.0)
+    ray_tpu.remove_placement_group(pg)

@@ -643,3 +643,164 @@ def test_chaos_llm_replica_kill_midstream():
     finally:
         serve.shutdown()
         ray_tpu.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# timed wall-clock fault schedules (`at=` grammar) + post-mortem replay
+# ---------------------------------------------------------------------------
+
+
+def test_timed_schedule_parsing():
+    p = fi.FaultPlan(
+        "seed=4;at=5:kill@train|3.5:data_stall:2.5@worker|7:ckpt_fail:2"
+        "|9:hb_brownout:1.5@gcs|11:crash_loop:3@raylet")
+    assert p.timed == [
+        fi.TimedFault(5.0, "kill", 0.0, "train"),
+        fi.TimedFault(3.5, "data_stall", 2.5, "worker"),
+        fi.TimedFault(7.0, "ckpt_fail", 2.0, None),
+        fi.TimedFault(9.0, "hb_brownout", 1.5, "gcs"),
+        fi.TimedFault(11.0, "crash_loop", 3.0, "raylet"),
+    ]
+    # bare ckpt_fail defaults to one persist; repeated at= keys accumulate
+    q = fi.FaultPlan("at=1:ckpt_fail;at=2:kill@train")
+    assert q.timed == [fi.TimedFault(1.0, "ckpt_fail", 1.0, None),
+                       fi.TimedFault(2.0, "kill", 0.0, "train")]
+
+    with pytest.raises(ValueError, match="unknown role"):
+        fi.FaultPlan("at=1:kill@mainframe")
+    with pytest.raises(ValueError, match="unknown fault"):
+        fi.FaultPlan("at=1:meteor")
+    with pytest.raises(ValueError, match="kill takes no argument"):
+        fi.FaultPlan("at=1:kill:2")
+    with pytest.raises(ValueError, match="requires an argument"):
+        fi.FaultPlan("at=1:data_stall")
+    with pytest.raises(ValueError, match="not <offset>"):
+        fi.FaultPlan("at=5")
+
+
+def test_timed_fire_once_and_replay(tmp_path, monkeypatch):
+    """Timed entries fire at their offsets, flip the injection state the
+    fault sites consume, are gated to ONE fire per soak run by the
+    once-sentinels, and the post-mortem artifact rebuilds the identical
+    plan via `from_artifact`."""
+    monkeypatch.setenv(fi.LOG_ENV, str(tmp_path))
+    spec = "seed=2;at=0.05:ckpt_fail:2|0.1:data_stall:0.2|0.1:hb_brownout:30"
+    p = fi.FaultPlan(spec)
+    p.arm_timed("worker")   # unroled entries arm in any process
+    deadline = time.monotonic() + 5
+    while len(p.timed_fired) < 3 and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert sorted(f["fault"] for f in p.timed_fired) == \
+        ["ckpt_fail", "data_stall", "hb_brownout"]
+
+    # state the fault sites consume: two persist failures, then clean
+    with pytest.raises(fi.ChaosError, match="chaos"):
+        p.checkpoint_persist()
+    with pytest.raises(fi.ChaosError, match="chaos"):
+        p.checkpoint_persist()
+    p.checkpoint_persist()   # pending exhausted
+    # brownout window active: the GCS handler drops the heartbeat
+    assert asyncio.run(p.gcs_heartbeat()) is True
+
+    # once-sentinels: a second plan (a restarted attempt re-reading the
+    # same env spec) re-arms but never re-fires
+    q = fi.FaultPlan(spec)
+    q.arm_timed("worker")
+    time.sleep(0.4)
+    assert q.timed_fired == []
+    q._timed_stop.set()
+
+    # post-mortem artifact -> exact replay
+    path = p.export_artifact(str(tmp_path / "chaos-test.json"))
+    r = fi.FaultPlan.from_artifact(path)
+    assert r.spec == spec and r.seed == p.seed and r.timed == p.timed
+    p._timed_stop.set()
+
+
+def test_timed_epoch_anchor_expiry(tmp_path, monkeypatch):
+    """With RAY_TPU_CHAOS_EPOCH set, offsets are wall-clock soak time:
+    a process arming AFTER an entry's fire time (a restarted attempt)
+    records it as expired instead of firing it into the fresh attempt;
+    a still-future entry fires at its original wall-clock slot."""
+    monkeypatch.setenv(fi.LOG_ENV, str(tmp_path))
+    monkeypatch.setenv(fi.EPOCH_ENV, repr(time.time() - 10.0))
+    p = fi.FaultPlan("seed=3;at=5:data_stall:1|10.3:ckpt_fail")
+    p.arm_timed("train")
+    time.sleep(0.7)
+    # offset 5 was 5 s in the past at arm -> expired, never fired
+    assert [f["fault"] for f in p.timed_fired] == ["ckpt_fail"]
+    assert any(site == "timed.data_stall" and "expired" in decision
+               for site, _, decision in p.schedule)
+    # and the anchored entry fired ~0.3 s after arm, not 10.3 s after
+    p._timed_stop.set()
+
+
+def test_timed_stop_event_cancels():
+    p = fi.FaultPlan("seed=1;at=0.3:ckpt_fail")
+    p.arm_timed("worker")
+    p._timed_stop.set()      # uninstall()/install() path
+    time.sleep(0.5)
+    assert p.timed_fired == []
+
+
+def test_timed_two_fault_smoke(tmp_path):
+    """Seeded two-fault timed schedule against a live 2-worker train
+    run: the stall fires first (harmless), the persist failure fails the
+    attempt and FailureConfig walks training back to the last
+    gang-committed checkpoint. Both firings are exported as replayable
+    post-mortem artifacts. Gated N-of-N by tools/flake_gate.py."""
+    from ray_tpu import train
+    from ray_tpu.air import FailureConfig, RunConfig, ScalingConfig
+    from ray_tpu.train.backend import JaxConfig
+
+    log_dir = tmp_path / "chaos"
+    spec = "seed=12;at=1.0:data_stall:0.5@train|2.5:ckpt_fail@train"
+    os.environ[fi.LOG_ENV] = str(log_dir)
+    with chaos_env(spec):
+        ray_tpu.init(num_cpus=8, object_store_memory=256 * 1024 * 1024)
+    try:
+        def loop(config):
+            from ray_tpu import train as train_mod
+            from ray_tpu.air.checkpoint import Checkpoint
+
+            start, resumed = 0, None
+            ckpt = train_mod.get_checkpoint()
+            if ckpt is not None:
+                start = resumed = ckpt.to_dict()["step"]
+            for i in range(start, 25):
+                time.sleep(0.2)
+                train_mod.report(
+                    {"step": i + 1, "resumed_from": resumed},
+                    checkpoint=Checkpoint.from_dict({"step": i + 1}))
+
+        trainer = train.JaxTrainer(
+            loop,
+            backend_config=JaxConfig(distributed="off", platform="cpu"),
+            scaling_config=ScalingConfig(num_workers=2),
+            run_config=RunConfig(
+                storage_path=str(tmp_path / "results"), name="timed",
+                failure_config=FailureConfig(max_failures=2)),
+        )
+        result = trainer.fit()
+        # the run completed across the injected walk-back
+        assert result.metrics["step"] == 25
+        assert result.metrics["resumed_from"] is not None
+        assert result.metrics["resumed_from"] >= 1
+
+        # both entries fired exactly once (once-sentinels), and every
+        # faulted process exported an artifact that replays the plan
+        import glob as glob_mod
+        fired = []
+        for path in glob_mod.glob(str(log_dir / "chaos-*.json")):
+            import json
+            art = json.loads(open(path).read())
+            fired += [f["fault"] for f in art["timed_fired"]]
+            replay = fi.FaultPlan.from_artifact(path)
+            assert replay.spec == spec
+            assert replay.timed == fi.FaultPlan(spec).timed
+        assert sorted(fired) == ["ckpt_fail", "data_stall"]
+        assert (log_dir / "once-ckpt_fail-2.5-train").exists()
+        assert (log_dir / "once-data_stall-1-train").exists()
+    finally:
+        os.environ.pop(fi.LOG_ENV, None)
+        ray_tpu.shutdown()

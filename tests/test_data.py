@@ -755,3 +755,45 @@ def test_webdataset_binary_and_heterogeneous(tmp_path):
     assert rows[1]["bin"] == b"\x05\x06"
     assert rows[0]["cls"] is None       # union schema, missing -> None
     assert rows[1]["cls"] == 7
+
+
+# -- start_batch_index: elastic resume-from-offset --------------------------
+
+
+def test_iter_batches_start_batch_index_exact_resume():
+    """Resuming at batch k replays the deterministic stream's suffix
+    exactly — no batch duplicated, none skipped (the soak driver's
+    watermark audit relies on this)."""
+    ds = rd.range(100, parallelism=3)
+    full = [b["id"].tolist() for b in ds.iter_batches(batch_size=32)]
+    for k in range(len(full) + 1):
+        resumed = [b["id"].tolist() for b in
+                   ds.iter_batches(batch_size=32, start_batch_index=k)]
+        assert resumed == full[k:], f"resume at batch {k} diverged"
+
+
+def test_iter_batches_start_batch_index_crosses_blocks():
+    # 4 blocks of 25 rows; skipping 3 batches of 10 lands 5 rows INTO
+    # block 1 — the first emitted batch stitches a mid-block slice
+    ds = rd.range(100, parallelism=4)
+    batches = list(ds.iter_batches(batch_size=10, start_batch_index=3))
+    assert batches[0]["id"].tolist() == list(range(30, 40))
+    assert [len(b["id"]) for b in batches] == [10] * 7
+    assert batches[-1]["id"].tolist() == list(range(90, 100))
+
+
+def test_iter_batches_start_batch_index_past_end():
+    ds = rd.range(20, parallelism=2)
+    assert list(ds.iter_batches(batch_size=8, start_batch_index=3)) == []
+    # partial last batch is itself resumable
+    last = list(ds.iter_batches(batch_size=8, start_batch_index=2))
+    assert len(last) == 1 and last[0]["id"].tolist() == [16, 17, 18, 19]
+
+
+def test_iter_batches_start_batch_index_validation():
+    ds = rd.range(10, parallelism=1)
+    with pytest.raises(ValueError, match=">= 0"):
+        list(ds.iter_batches(batch_size=4, start_batch_index=-1))
+    with pytest.raises(ValueError, match="deterministic"):
+        list(ds.iter_batches(batch_size=4, start_batch_index=1,
+                             local_shuffle_buffer_size=8))

@@ -343,6 +343,77 @@ class TestCoalescerPattern:
                    for f in findings), findings
 
 
+class TestTimedSchedulePattern:
+    """The timed fault-schedule idiom (`fault_injection.arm_timed`):
+    partition the due entries while holding the schedule lock, then
+    hand them to a daemon thread that sleeps out each offset and fires
+    OUTSIDE any lock. The good twin must stay silent; sleeping out the
+    offsets while still holding the schedule lock (which would stall
+    every other arm/record for the whole schedule) must flag.
+    """
+
+    def test_timer_fire_outside_lock_clean(self):
+        findings = run("""
+            import threading
+            import time
+
+            class Plan:
+                def __init__(self, entries):
+                    self._lock = threading.Lock()
+                    self._entries = entries
+                    self._armed = []
+
+                def arm(self, role, base):
+                    with self._lock:
+                        due = [e for e in self._entries
+                               if e.role in (None, role)
+                               and e not in self._armed]
+                        self._armed.extend(due)
+                    t = threading.Thread(
+                        target=self._run, args=(due, base), daemon=True)
+                    t.start()
+
+                def _run(self, due, base):
+                    # waits + firing happen on the timer thread with no
+                    # lock held; only bookkeeping re-takes the lock
+                    for e in due:
+                        remaining = base + e.offset - time.time()
+                        if remaining > 0:
+                            time.sleep(remaining)
+                        e.fire()
+                        with self._lock:
+                            self._armed.remove(e)
+        """)
+        assert "blocking-under-lock" not in checks_of(findings), findings
+        assert "lock-discipline" not in checks_of(findings), findings
+
+    def test_timer_fire_under_lock_flagged(self):
+        # the shape the clean twin exists to prevent: sleeping out the
+        # schedule while holding the lock serializes every arm/record
+        # behind the full fault schedule's wall-clock span
+        findings = run("""
+            import threading
+            import time
+
+            class Plan:
+                def __init__(self, entries):
+                    self._lock = threading.Lock()
+                    self._entries = entries
+
+                def arm(self, role, base):
+                    with self._lock:
+                        for e in self._entries:
+                            remaining = base + e.offset - time.time()
+                            if remaining > 0:
+                                time.sleep(remaining)
+                            e.fire()
+        """)
+        assert any(f.check == "blocking-under-lock"
+                   and f.detail == "time.sleep"
+                   and f.scope == "Plan.arm"
+                   for f in findings), findings
+
+
 # ---------------------------------------------------------------------------
 # checker 3: jit-purity
 # ---------------------------------------------------------------------------
