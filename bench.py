@@ -1310,6 +1310,303 @@ def bench_soak():
     return out
 
 
+# Fairness submitter: one competing tenant. SPREAD tasks take one lease
+# each, so the raylet's weighted-fair queue arbitrates EVERY task (the
+# default pipelining would drain a whole backlog through one lease and
+# hide the queue). Completions are counted by worker-side timestamp
+# inside the shared [t0, t0+window] measurement interval — same-machine
+# clocks, so no cross-process skew.
+_MT_SUBMITTER = """
+import json, sys, time
+import ray_tpu
+
+addr, weight, t0, window = (sys.argv[1], float(sys.argv[2]),
+                            float(sys.argv[3]), float(sys.argv[4]))
+ray_tpu.init(address=addr, job_quotas={"weight": weight})
+
+@ray_tpu.remote(scheduling_strategy="SPREAD")
+def work():
+    import time as _t
+    _t.sleep(0.005)
+    return _t.time()
+
+late_start = time.time() >= t0
+refs = [work.remote() for _ in range(8)]
+count = warm = 0
+end = t0 + window
+while time.time() < end:
+    done, refs = ray_tpu.wait(refs, num_returns=1, timeout=30)
+    for r in done:
+        ts = ray_tpu.get(r)
+        if t0 <= ts <= end:
+            count += 1
+        elif ts < t0:
+            warm += 1
+        refs.append(work.remote())
+print(json.dumps({"job": ray_tpu.get_runtime_context().get_job_id(),
+                  "weight": weight, "count": count, "warm": warm,
+                  "late_start": late_start}))
+ray_tpu.shutdown()
+"""
+
+# Overload offender: registers a byte quota at init, waits until the
+# raylet has stamped it into the shared arena (the pubsub propagation
+# under test), then fires the chaos `quota_flood` fault in-process. The
+# flood hammers the CoreWorker-registered put target for the window; the
+# store must cap the job at its quota (self-eviction first, then
+# SS_QUOTA) without touching any other job's bytes.
+_MT_OFFENDER = """
+import sys, time
+import ray_tpu
+from ray_tpu._private import fault_injection as _fi
+from ray_tpu._private.worker_api import _require_state
+
+addr, jobfile, quota, flood_s = (sys.argv[1], sys.argv[2],
+                                 int(sys.argv[3]), float(sys.argv[4]))
+ray_tpu.init(address=addr,
+             job_quotas={"weight": 1.0, "object_store_bytes": quota})
+cw = _require_state().core_worker
+with open(jobfile, "w") as f:
+    f.write(cw.job_id.hex())
+deadline = time.time() + 30
+while time.time() < deadline:
+    st = cw.store.job_stats(cw.job_id.binary())
+    if st is not None and st["quota"] == quota:
+        break
+    time.sleep(0.05)
+else:
+    raise RuntimeError("byte quota never reached the store arena")
+plan = _fi.install(_fi.FaultPlan(f"at=0.2:quota_flood:{flood_s}@driver"))
+_fi.set_role("driver")  # arm the driver-scoped timed entry
+deadline = time.time() + flood_s + 5
+while time.time() < deadline and not any(
+        s[0] == "timed.quota_flood.done" for s in plan.schedule):
+    time.sleep(0.05)
+done = [s for s in plan.schedule if s[0] == "timed.quota_flood.done"]
+print("FLOOD=" + (done[0][2] if done else "missing"))
+ray_tpu.shutdown()
+"""
+
+
+def bench_multitenant():
+    """Multi-tenant isolation (ISSUE 11): three competing jobs with
+    fair-share weights 1/2/4 submit backlogged SPREAD tasks against one
+    1-CPU cluster — per-job throughput shares must land within 10%
+    (relative) of the weight ratio. Then a quota-flood variant: an
+    offender job with a byte quota floods the shared object store via
+    the `quota_flood` chaos fault while the head job probes put latency
+    — the offender stays capped at its quota, zero bytes are evicted
+    from any other job, and the victim's put p99 regresses <15% vs its
+    pre-flood window. Scale with RAY_TPU_SCALE_SIZES=
+    mt_window_s=30,mt_flood_s=10 for the full artifact run."""
+    import subprocess
+    import sys
+    import tempfile
+
+    import ray_tpu
+    from ray_tpu._private.ids import ObjectID
+    from ray_tpu._private.worker_api import _require_state
+    from ray_tpu.util import state as state_api
+
+    scale = _scale_overrides()
+    window = float(scale.get("mt_window_s", 10))
+    warmup = float(scale.get("mt_warmup_s", 10))
+    flood_s = float(scale.get("mt_flood_s", 4))
+    quota = int(scale.get("mt_quota_mb", 8)) * 1024 * 1024
+    weights = (1.0, 2.0, 4.0)
+
+    ray_tpu.init(num_cpus=1, num_tpus=0,
+                 object_store_memory=128 * 1024 * 1024,
+                 job_quotas={"weight": 1.0})
+    try:
+        from ray_tpu._private import worker_api
+
+        gcs_addr = worker_api._global_state.cluster.gcs_addr
+        cw = _require_state().core_worker
+        store = cw.store
+        env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+        here = os.path.dirname(os.path.abspath(__file__))
+
+        # -- phase 1: weighted-fair throughput shares -------------------
+        t0 = time.time() + warmup
+        procs = [
+            subprocess.Popen(
+                [sys.executable, "-c", _MT_SUBMITTER, gcs_addr, str(w),
+                 str(t0), str(window)],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True, cwd=here, env=env)
+            for w in weights
+        ]
+        tenants = []
+        for p in procs:
+            out, err = p.communicate(timeout=warmup + window + 120)
+            if p.returncode != 0:
+                raise RuntimeError(f"submitter failed: {err[-500:]}")
+            tenants.append(json.loads(out.strip().splitlines()[-1]))
+        total = sum(t["count"] for t in tenants)
+        total_w = sum(weights)
+        if total < 20 * len(weights):
+            raise RuntimeError(
+                f"undersampled fairness window: {total} grants")
+        fairness = []
+        worst = 0.0
+        for t in tenants:
+            expected = t["weight"] / total_w
+            share = t["count"] / total
+            rel_err = abs(share / expected - 1.0)
+            worst = max(worst, rel_err)
+            fairness.append({
+                "job": t["job"][:8], "weight": t["weight"],
+                "tasks": t["count"], "warmup_tasks": t["warm"],
+                "share": round(share, 4),
+                "expected_share": round(expected, 4),
+                "rel_err": round(rel_err, 4),
+            })
+        if worst > 0.10:
+            raise RuntimeError(
+                "fairness: share deviates >10% from weight: "
+                f"{fairness}")
+
+        # -- phase 2: quota-flood containment ---------------------------
+        def put_p99(n):
+            # victim probe: 64 KiB put+delete round trips on the shared
+            # arena, p99 over the window
+            lat = []
+            payload = b"\x00" * 65536
+            for _ in range(n):
+                oid = ObjectID.from_random()
+                t = time.perf_counter()
+                store.put_value(oid, payload)
+                lat.append(time.perf_counter() - t)
+                store.delete(oid)
+            lat.sort()
+            return lat[int(0.99 * (len(lat) - 1))], len(lat)
+
+        base_p99, base_n = put_p99(400)
+        victim_before = store.job_stats(cw.job_id.binary()) or {}
+
+        jobfile = tempfile.mktemp(prefix="ray_tpu_mt_job_")
+        offender = subprocess.Popen(
+            [sys.executable, "-c", _MT_OFFENDER, gcs_addr, jobfile,
+             str(quota), str(flood_s)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True, cwd=here, env=env)
+        deadline = time.time() + 30
+        offender_job = None
+        while time.time() < deadline and offender_job is None:
+            try:
+                with open(jobfile) as f:
+                    offender_job = bytes.fromhex(f.read().strip())
+            except (OSError, ValueError):
+                time.sleep(0.05)
+        if offender_job is None:
+            offender.kill()
+            raise RuntimeError("offender never registered its job")
+
+        # probe while the flood runs, sampling the offender's usage
+        max_used = 0
+        flood_lat = []
+        payload = b"\x00" * 65536
+        end = time.time() + flood_s + 1.0
+        while time.time() < end:
+            oid = ObjectID.from_random()
+            t = time.perf_counter()
+            store.put_value(oid, payload)
+            flood_lat.append(time.perf_counter() - t)
+            store.delete(oid)
+            st = store.job_stats(offender_job)
+            if st is not None:
+                max_used = max(max_used, st["used"])
+        out, err = offender.communicate(timeout=flood_s + 60)
+        if offender.returncode != 0:
+            raise RuntimeError(f"offender failed: {err[-500:]}")
+        flood_line = [ln for ln in out.splitlines()
+                      if ln.startswith("FLOOD=")][0]
+        try:
+            os.unlink(jobfile)
+        except OSError:
+            pass
+
+        flood_lat.sort()
+        flood_p99 = flood_lat[int(0.99 * (len(flood_lat) - 1))]
+        off_stats = store.job_stats(offender_job) or {}
+        victim_after = store.job_stats(cw.job_id.binary()) or {}
+
+        # hard gates: containment must hold EVERY run, not on average.
+        # The store reserves `used` with a fetch_add BEFORE admission
+        # (check-and-reserve is one RMW), so a concurrent sample may
+        # read up to one in-flight reservation over quota while a
+        # create is inside its self-evict/recheck window; the quiesced
+        # value is the strict cap.
+        slack = 128 * 1024  # one aligned 64 KiB flood frame in flight
+        if max_used > quota + slack:
+            raise RuntimeError(
+                f"offender exceeded its byte quota: {max_used} > {quota}")
+        if off_stats.get("used", 0) > quota:
+            raise RuntimeError(
+                "offender over quota at quiesce: "
+                f"{off_stats.get('used')} > {quota}")
+        if off_stats.get("evicted_bytes", 0) + \
+                off_stats.get("quota_rejects", 0) <= 0:
+            raise RuntimeError(
+                f"flood never hit the quota boundary: {off_stats}")
+        if victim_after.get("evicted_bytes", 0) != \
+                victim_before.get("evicted_bytes", 0):
+            raise RuntimeError(
+                "cross-job eviction: victim bytes were reclaimed for "
+                f"the offender: {victim_before} -> {victim_after}")
+        # latency floor guards micro-noise on sub-ms p99s
+        p99_floor = max(base_p99, 0.0005)
+        if flood_p99 > 1.15 * p99_floor:
+            raise RuntimeError(
+                f"victim put p99 regressed >15% under flood: "
+                f"{base_p99 * 1e3:.3f}ms -> {flood_p99 * 1e3:.3f}ms")
+
+        # per-job accounting rows as the dashboard /api/jobs serves them
+        job_rows = []
+        for jb in state_api.list_jobs():
+            job_rows.append({
+                "job_id": jb["job_id"][:8],
+                "quotas": jb.get("quotas"),
+                "finished": jb["finished"],
+                "object_store": store.job_stats(
+                    bytes.fromhex(jb["job_id"])),
+            })
+
+        detail = {
+            "window_s": window,
+            "tenants": fairness,
+            "fairness_worst_rel_err": round(worst, 4),
+            "flood": {
+                "quota_bytes": quota,
+                "flood_s": flood_s,
+                "result": flood_line.split("=", 1)[1],
+                "offender_max_used": max_used,
+                "offender_stats": off_stats,
+                "victim_put_p99_ms_base": round(base_p99 * 1e3, 3),
+                "victim_put_p99_ms_flood": round(flood_p99 * 1e3, 3),
+                "victim_probe_puts": base_n + len(flood_lat),
+                "victim_evicted_bytes": victim_after.get(
+                    "evicted_bytes", 0),
+            },
+            "jobs": job_rows,
+            "full_scale": window >= 30,
+        }
+        return {
+            "multitenant": detail,
+            # value-keyed: the >15% REGRESSION gate watches the fairness
+            # score (1.0 = shares exactly track weights), aggregate
+            # fair-queue throughput, and victim put speed under flood
+            # (1/p99 — a drop flags p99 growth)
+            "multitenant_fairness_score": round(1.0 - worst, 4),
+            "multitenant_tasks_per_s": round(total / window, 2),
+            "multitenant_victim_put_speed_under_flood_per_s":
+                round(1.0 / flood_p99, 1),
+        }
+    finally:
+        ray_tpu.shutdown()
+
+
 def main():
     suite = {}
     started = time.perf_counter()
@@ -1439,6 +1736,20 @@ def main():
             suite["soak_error"] = repr(e)[:300]
     else:
         suite["soak"] = {"skipped": "budget"}
+
+    # multi-tenant fairness + quota-flood containment; the full
+    # MULTITENANT_r*.json artifact run sets
+    # RAY_TPU_SCALE_SIZES=mt_window_s=30,mt_flood_s=10
+    if remaining() > 90 or not on_tpu:
+        try:
+            mt = bench_multitenant()
+            for k, v in mt.items():
+                suite[k] = v if isinstance(v, dict) else {
+                    "value": round(v, 3), "vs_baseline": None}
+        except Exception as e:  # noqa: BLE001
+            suite["multitenant_error"] = repr(e)[:300]
+    else:
+        suite["multitenant"] = {"skipped": "budget"}
 
     if "tokens_per_sec_per_chip" in gpt2 and gpt2.get("platform") == "tpu":
         headline = {

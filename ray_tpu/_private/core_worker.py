@@ -289,11 +289,24 @@ class CoreWorker:
         self.mode = mode
         self.config = config or Config.from_env()
         self.worker_id = WorkerID.from_random()
-        self.job_id = job_id or JobID.from_int(0)
+        # Never default to a shared job 0: an unlabelled CoreWorker gets
+        # its own bucket so per-job accounting (fair queue lanes, store
+        # quotas) can't silently merge tenants.
+        self.job_id = job_id if job_id is not None else JobID.from_random()
         self.gcs_addr = gcs_addr
         self.raylet_addr = raylet_addr
         self.node_id_hex = node_id_hex
         self.store = store
+        if store is not None:
+            # stamp this process's puts with its job for per-job byte
+            # accounting in the shm store (drivers and workers alike)
+            store.set_current_job(self.job_id.binary())
+            # quota_flood@<role> chaos victimizer: one job-charged put
+            # per call, QuotaExceededError propagating to the flood
+            # loop's rejection counter
+            _fi.set_quota_flood_target(
+                lambda: store.put_value(ObjectID.from_random(),
+                                        b"\x00" * 65536))
         self.tpu_chips = tpu_chips
         # Per-PROCESS random base task id, NOT a job-deterministic one:
         # submissions from non-task threads (driver main thread, worker

@@ -122,7 +122,7 @@ _LOG_CAP = 8192
 _ARM_GRACE_S = 1.0
 
 _TIMED_FAULTS = ("kill", "crash_loop", "hb_brownout", "data_stall",
-                 "ckpt_fail")
+                 "ckpt_fail", "quota_flood")
 _ROLES = ("driver", "gcs", "raylet", "worker", "train")
 
 
@@ -183,6 +183,10 @@ def _parse_timed(value: str) -> List[TimedFault]:
             arg = 0.0
         elif fault == "ckpt_fail":
             arg = float(parts[2]) if len(parts) > 2 else 1.0
+        elif fault == "quota_flood":
+            # window seconds; the flood hammers the registered target
+            # (object-store puts) for the whole window
+            arg = float(parts[2]) if len(parts) > 2 else 5.0
         else:  # crash_loop / hb_brownout / data_stall need an argument
             if len(parts) < 3:
                 raise ValueError(f"at: {fault} requires an argument")
@@ -281,6 +285,7 @@ class FaultPlan:
         self._brownout_until = 0.0         # wall ts; write under lock
         self._stall_until = 0.0            # wall ts; write under lock
         self._ckpt_fail_pending = 0        # write under lock
+        self._flood_until = 0.0            # wall ts; write under lock
 
     # -- deterministic draw machinery -----------------------------------
 
@@ -473,6 +478,34 @@ class FaultPlan:
             self._record("data.read", f"stall={remaining:.3f}")
             time.sleep(remaining)
 
+    # -- quota flood (multi-tenant overload containment) -----------------
+
+    def flooding(self) -> bool:
+        """True while a `quota_flood` window is active in this process."""
+        return time.time() < self._flood_until
+
+    def _quota_flood_run(self) -> None:
+        """Hammer the registered flood target (an object-store put bound
+        to this process's job — see set_quota_flood_target) for the
+        window. The point is to PROVE containment: the offending job's
+        puts get capped at its byte quota (rejections count up) while
+        other jobs' objects and latency stay untouched."""
+        puts = rejects = 0
+        while not self._timed_stop.is_set() and \
+                time.time() < self._flood_until:
+            target = _FLOOD_TARGET
+            if target is None:
+                time.sleep(0.01)  # no store attached yet in this process
+                continue
+            try:
+                target()
+                puts += 1
+            except Exception:  # noqa: BLE001 — QuotaExceeded/store full
+                rejects += 1
+            time.sleep(0.0005)  # hammer, but never a pure busy-spin
+        self._record("timed.quota_flood.done",
+                     f"puts={puts}:rejects={rejects}")
+
     # -- timed schedule (wall-clock offsets) -----------------------------
 
     def arm_timed(self, role: str) -> None:
@@ -542,6 +575,8 @@ class FaultPlan:
             elif tf.fault == "crash_loop":
                 self.spawn_fail = int(tf.arg)
                 self._spawn_attempts = 0
+            elif tf.fault == "quota_flood":
+                self._flood_until = now + tf.arg
         # record / log / export / exit OUTSIDE the lock: _record appends,
         # export does file IO, and os._exit never returns
         self._record(f"timed.{tf.fault}", f"t+{tf.offset}:{tf.arg}")
@@ -550,6 +585,9 @@ class FaultPlan:
              "ts": now})
         logger.warning("chaos: timed fault %s fired at t+%.1fs (role=%s)",
                        tf.fault, tf.offset, _ROLE)
+        if tf.fault == "quota_flood":
+            threading.Thread(target=self._quota_flood_run,
+                             daemon=True, name="chaos-quota-flood").start()
         if tf.fault == "kill":
             self.export_artifact()  # atexit never runs past os._exit
             os._exit(1)
@@ -632,6 +670,18 @@ class FaultPlan:
 _PLAN: Optional[FaultPlan] = None
 _ROLE = "driver"
 _ATEXIT_REGISTERED = False
+# quota_flood victimizer: a zero-arg callable that performs one
+# job-stamped object-store put; registered by CoreWorker once a store is
+# attached, consumed by FaultPlan._quota_flood_run
+_FLOOD_TARGET = None
+
+
+def set_quota_flood_target(fn) -> None:
+    """Register (or clear, with None) this process's quota-flood target.
+    The callable must do ONE put charged to the process's job and let
+    QuotaExceededError propagate — the flood loop counts rejections."""
+    global _FLOOD_TARGET
+    _FLOOD_TARGET = fn
 
 
 def plan() -> Optional[FaultPlan]:

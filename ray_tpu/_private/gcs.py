@@ -639,9 +639,14 @@ class GcsServer:
             "driver_addr": req.get("driver_addr", ""),
             "start_time": time.time(),
             "finished": False,
+            # per-job quota/weight dict (multi-tenant isolation plane);
+            # fanned out to every raylet via the jobs channel and pulled
+            # by late-joining raylets through list_jobs
+            "quotas": req.get("quotas") or None,
         }
         self._persist("jobs", job_id, self.jobs[job_id])
-        await self.publish("jobs", {"event": "started", "job_id": job_id})
+        await self.publish("jobs", {"event": "started", "job_id": job_id,
+                                    "quotas": req.get("quotas") or None})
         return {"ok": True}
 
     async def rpc_finish_job(self, req):
@@ -844,6 +849,7 @@ class GcsServer:
                 "start_time": jb.get("start_time"),
                 "end_time": jb.get("end_time"),
                 "finished": jb.get("finished", False),
+                "quotas": jb.get("quotas"),
             }
             for jb in self.jobs.values()
         ]

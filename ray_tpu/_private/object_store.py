@@ -27,6 +27,7 @@ SS_NO_MEMORY = -3
 SS_TABLE_FULL = -4
 SS_TIMEOUT = -5
 SS_NOT_SEALED = -6
+SS_QUOTA = -9
 
 
 class ObjectStoreError(Exception):
@@ -39,6 +40,23 @@ class ObjectStoreFullError(ObjectStoreError):
 
 class ObjectTimeoutError(ObjectStoreError):
     pass
+
+
+class QuotaExceededError(ObjectStoreError):
+    """The creating job is at its per-job object-store byte quota and
+    has no evictable objects of its own left to reclaim. Only the
+    offending job sees this — other tenants' puts and objects are
+    untouched (the quota sweep never crosses job boundaries)."""
+
+
+def job_key(job_id_binary: bytes) -> int:
+    """Fold a 16-byte JobID into the u64 accounting key the native
+    store tracks. XOR of the two halves so small `JobID.from_int`
+    values (big-endian, value in the tail) still map to nonzero keys;
+    key 0 (the nil job) means untracked — v2 semantics, no quota."""
+    a = int.from_bytes(job_id_binary[:8], "little")
+    b = int.from_bytes(job_id_binary[8:16], "little")
+    return a ^ b
 
 
 class PlasmaBuffer:
@@ -98,6 +116,8 @@ class ObjectStore:
         self._name = name
         self._lib = lib
         self._h = handle
+        self._job_key = 0       # creator attribution for puts (0 = none)
+        self._job_labels = {}   # job key -> short hex label for /metrics
         self._data_off = lib.ss_data_offset(handle)
         map_size = lib.ss_map_size(handle)
         fd = os.open(f"/dev/shm{name}", os.O_RDWR)
@@ -166,16 +186,30 @@ class ObjectStore:
         start = self._data_off + offset
         return self._view[start : start + size]
 
+    def set_current_job(self, job_id_binary: bytes, label: str = "") -> None:
+        """Stamp every subsequent create/put from this process with the
+        job as creator (per-job byte accounting + quota enforcement).
+        Called once after attach by workers/drivers with their JobID."""
+        key = job_key(job_id_binary)
+        self._job_key = key
+        if key:
+            self._job_labels[key] = label or job_id_binary.hex()[:8]
+
     def create_buffer(self, object_id: ObjectID, size: int) -> memoryview:
         if self._lib is None or self._h < 0:
             raise ObjectStoreError("store is closed")
-        off = self._lib.ss_create(self._h, object_id.binary(), size)
+        off = self._lib.ss_create_job(
+            self._h, object_id.binary(), size, self._job_key)
         if off == SS_EXISTS:
             raise ObjectStoreError(f"object already exists: {object_id}")
         if off in (SS_NO_MEMORY, SS_TABLE_FULL):
             raise ObjectStoreFullError(
                 f"object store out of {'memory' if off == SS_NO_MEMORY else 'table slots'}"
             )
+        if off == SS_QUOTA:
+            raise QuotaExceededError(
+                f"job {self._job_labels.get(self._job_key, self._job_key)} "
+                f"is at its object-store byte quota")
         if off < 0:
             raise ObjectStoreError(f"create failed: {off}")
         return self._slice(off, size)
@@ -275,6 +309,72 @@ class ObjectStore:
             return 0
         return self._lib.ss_evict(self._h, nbytes)
 
+    # -- per-job accounting (multi-tenant quota plane) --------------------
+
+    def set_job_quota(self, job_id_binary: bytes, quota_bytes: int,
+                      label: str = "") -> None:
+        """Set (0 = clear) a job's object-store byte quota on this
+        arena. A job at its quota reclaims its own evictable objects
+        first, then gets QuotaExceededError — never another job's
+        bytes."""
+        if self._lib is None or self._h < 0:
+            raise ObjectStoreError("store is closed")
+        key = job_key(job_id_binary)
+        if not key:
+            return  # nil job: untracked by design
+        self._job_labels[key] = label or job_id_binary.hex()[:8]
+        rc = self._lib.ss_set_job_quota(self._h, key, quota_bytes)
+        if rc == SS_TABLE_FULL:
+            raise ObjectStoreError("job accounting table full")
+        if rc != SS_OK:
+            raise ObjectStoreError(f"set_job_quota failed: {rc}")
+
+    def job_stats(self, job_id_binary: bytes) -> dict | None:
+        """This job's accounting row, or None if it never touched the
+        store (and has no quota)."""
+        if self._lib is None or self._h < 0:
+            return None
+        key = job_key(job_id_binary)
+        return self._job_stats_by_key(key)
+
+    def _job_stats_by_key(self, key: int) -> dict | None:
+        if not key:
+            return None
+        row = (ctypes.c_uint64 * 5)()
+        if self._lib.ss_job_stats(self._h, key, row) != SS_OK:
+            return None
+        return {
+            "quota": row[0],
+            "used": row[1],
+            "evicted_bytes": row[2],
+            "quota_rejects": row[3],
+            "num_objects": row[4],
+        }
+
+    def jobs(self) -> dict:
+        """All active accounting rows keyed by job label (hex prefix of
+        the JobID when known, else the raw key)."""
+        out = {}
+        if self._lib is None or self._h < 0:
+            return out
+        keys = (ctypes.c_uint64 * 32)()
+        n = self._lib.ss_job_list(self._h, keys, 32)
+        for i in range(max(n, 0)):
+            st = self._job_stats_by_key(keys[i])
+            if st is not None:
+                label = self._job_labels.get(keys[i], f"{keys[i]:016x}")
+                out[label] = st
+        return out
+
+    def evict_job(self, nbytes: int, job_id_binary: bytes) -> int:
+        """Reclaim up to nbytes from ONE job's own evictable objects."""
+        if self._lib is None or self._h < 0:
+            return 0
+        key = job_key(job_id_binary)
+        if not key:
+            return 0
+        return self._lib.ss_evict_job(self._h, nbytes, key)
+
     @property
     def num_shards(self) -> int:
         if self._lib is None or self._h < 0:
@@ -330,6 +430,22 @@ class ObjectStore:
             "# TYPE object_store_shards gauge",
             f"object_store_shards {self.num_shards}",
         ]
+        job_rows = self.jobs()
+        if job_rows:
+            lines.append("# TYPE object_store_job_used_bytes gauge")
+            for label, jst in sorted(job_rows.items()):
+                lines.append(
+                    f'object_store_job_used_bytes{{job="{label}"}} '
+                    f"{jst['used']}")
+                lines.append(
+                    f'object_store_job_quota_bytes{{job="{label}"}} '
+                    f"{jst['quota']}")
+                lines.append(
+                    f'object_store_job_evicted_bytes{{job="{label}"}} '
+                    f"{jst['evicted_bytes']}")
+                lines.append(
+                    f'object_store_job_quota_rejects{{job="{label}"}} '
+                    f"{jst['quota_rejects']}")
         shard_rows = self.shard_stats()
         if shard_rows:
             lines.append("# TYPE object_store_shard_lock_wait_ns gauge")

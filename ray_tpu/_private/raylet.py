@@ -41,7 +41,15 @@ from ray_tpu._private.rpc import (
     RpcError,
     RpcServer,
 )
-from ray_tpu._private.scheduling import ClusterView, pick_node
+from ray_tpu._private import scheduling as scheduling_mod
+from ray_tpu._private.scheduling import (
+    ClusterView,
+    FairDispatchQueue,
+    SCHED_STATS,
+    job_label,
+    job_quota,
+    pick_node,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -145,7 +153,15 @@ class Raylet:
         self._register_waiters: Dict[tuple, List[asyncio.Future]] = {}
 
         self._leases: Dict[int, Lease] = {}
-        self._pending: List[Lease] = []
+        # Weighted-fair dispatch queue keyed by job: contended dispatch
+        # drains per-job lanes in deficit-round-robin order (grant cost =
+        # CPU+TPU demand over the job's quota weight) instead of global
+        # FIFO, so one flooding tenant cannot starve the others.
+        self._pending: FairDispatchQueue = FairDispatchQueue(
+            cost_of=lambda lease: max(
+                1.0,
+                float(lease.resources.get("CPU", 0.0) or 0.0)
+                + float(lease.resources.get("TPU", 0.0) or 0.0)))
         self._lease_seq = itertools.count(1)
         self._bundles: Dict[tuple, Dict[str, float]] = {}  # committed PG bundles
         self._bundle_available: Dict[tuple, Dict[str, float]] = {}
@@ -193,8 +209,6 @@ class Raylet:
     _workers_returned = 0
 
     def _metrics_text(self) -> str:
-        from ray_tpu._private import scheduling as scheduling_mod
-
         stats = self.store.stats()
         lines = [
             "# TYPE raylet_leases_granted counter",
@@ -206,6 +220,10 @@ class Raylet:
             # dashboards key on (same value as raylet_pending_leases)
             "# TYPE scheduler_queue_depth gauge",
             f"scheduler_queue_depth {len(self._pending)}",
+        ]
+        for job, depth in sorted(self._pending.depths().items()):
+            lines.append(f'scheduler_queue_depth{{job="{job}"}} {depth}')
+        lines += [
             f"raylet_workers {len(self._workers)}",
             f"raylet_pinned_objects {len(self._pinned)}",
             f"raylet_spilled_objects {len(self._spilled)}",
@@ -245,6 +263,14 @@ class Raylet:
         })
         await self.gcs.call("subscribe",
                             {"channel": "jobs", "addr": self.server.address})
+        # quotas of jobs that registered before this raylet joined: the
+        # "started" publishes already happened, so pull the job table
+        try:
+            for jb in await self.gcs.call("list_jobs", {}, timeout=10.0):
+                if not jb.get("finished"):
+                    self._apply_job_quota(jb["job_id"], jb.get("quotas"))
+        except (ConnectionLost, RpcError, OSError, asyncio.TimeoutError):
+            pass  # pubsub still delivers future jobs' quotas
         # push-based resource gossip: availability deltas arrive the
         # moment another node's heartbeat reports a change (reference:
         # ray_syncer.h:88 streaming sync), so spillback sees fresh state
@@ -330,7 +356,7 @@ class Raylet:
                     # reporting them too would double-count the demand.
                     "pending_demands": [
                         lease.resources
-                        for lease in self._pending[:64]
+                        for lease in self._pending.head(64)
                         if not lease.acquired
                     ],
                     # workers bound to actors or running leases (warm
@@ -612,13 +638,32 @@ class Raylet:
                 pass
         self._dispatch()
 
+    def _apply_job_quota(self, job_id: bytes, quotas: dict | None):
+        """Install a job's quota row into both consumers on this node:
+        the scheduler registry (weights + cpu/memory admission) and the
+        shm store (object byte quota)."""
+        if not quotas:
+            return
+        q = scheduling_mod.JobQuota.from_dict(quotas)
+        scheduling_mod.set_job_quota(job_id, q)
+        if q.object_store_bytes > 0:
+            try:
+                self.store.set_job_quota(job_id, q.object_store_bytes)
+            except Exception:  # noqa: BLE001 — accounting table full
+                logger.warning("object quota for job %s not applied "
+                               "(job table full)", job_id.hex()[:8])
+
     async def rpc_pubsub(self, msg):
-        if msg["channel"] == "jobs" and msg["data"].get("event") == "finished":
-            job_id = msg["data"]["job_id"]
-            for worker in list(self._workers.values()):
-                if worker.job_id == job_id and worker.proc \
-                        and worker.proc.returncode is None:
-                    worker.proc.terminate()
+        if msg["channel"] == "jobs":
+            data = msg["data"]
+            if data.get("event") == "started":
+                self._apply_job_quota(data["job_id"], data.get("quotas"))
+            elif data.get("event") == "finished":
+                job_id = data["job_id"]
+                for worker in list(self._workers.values()):
+                    if worker.job_id == job_id and worker.proc \
+                            and worker.proc.returncode is None:
+                        worker.proc.terminate()
         elif msg["channel"] == "resources":
             d = msg["data"]
             if d.get("node_id") == self.node_id.binary():
@@ -811,7 +856,7 @@ class Raylet:
         if spec.placement_group_id is not None:
             lease.pg_key = (spec.placement_group_id, spec.bundle_index)
         self._leases[lease.lease_id] = lease
-        self._pending.append(lease)
+        self._pending.push(spec.job_id, lease)
         asyncio.ensure_future(self._localize_deps(lease))
         self._dispatch()
         return await lease.reply_fut
@@ -915,19 +960,61 @@ class Raylet:
                         return True
         return reclaimable > 0
 
+    def _job_usage(self) -> Dict[bytes, Dict[str, float]]:
+        """Resources currently held per job (acquired leases). Recomputed
+        from the lease table each dispatch — O(leases), no incremental
+        counters to tear when `_grant` rewrites an actor's held set."""
+        usage: Dict[bytes, Dict[str, float]] = {}
+        for lease in self._leases.values():
+            if not lease.acquired:
+                continue
+            row = usage.setdefault(lease.spec.job_id, {})
+            for k, v in lease.resources.items():
+                row[k] = row.get(k, 0.0) + v
+        return usage
+
+    def _over_quota(self, job_id: bytes, demand: Dict[str, float],
+                    usage: Dict[bytes, Dict[str, float]]) -> bool:
+        """Admission control: would granting `demand` push the job past
+        its cpu/memory quota? Over-quota leases stay queued behind
+        in-quota work (containment degrades, never fails)."""
+        q = job_quota(job_id)
+        if q.cpu <= 0 and q.memory <= 0:
+            return False
+        held = usage.get(job_id, {})
+        if q.cpu > 0 and held.get("CPU", 0.0) \
+                + float(demand.get("CPU", 0.0) or 0.0) > q.cpu + 1e-9:
+            return True
+        if q.memory > 0 and held.get("memory", 0.0) \
+                + float(demand.get("memory", 0.0) or 0.0) > q.memory + 1e-9:
+            return True
+        return False
+
     def _dispatch(self):
-        """Dispatch queue scan (reference: LocalTaskManager::
-        ScheduleAndDispatchTasks)."""
+        """Dispatch queue scan in weighted-fair order (reference:
+        LocalTaskManager::ScheduleAndDispatchTasks, drained through the
+        per-job FairDispatchQueue instead of FIFO)."""
         from ray_tpu._private.runtime_env import env_hash as _env_hash
 
         # key -> (shortfall count, runtime_env wire) for leases that hold
         # resources but lack a worker.
         spawn_needed: Dict[tuple, list] = {}
+        usage = self._job_usage()
         for lease in list(self._pending):
             if not lease.deps_ready:
                 continue
-            if not lease.acquired and not self._try_acquire(lease):
-                continue
+            job_id = lease.spec.job_id
+            if not lease.acquired:
+                if self._over_quota(job_id, lease.resources, usage):
+                    label = job_label(job_id)
+                    SCHED_STATS.job_deferred[label] = \
+                        SCHED_STATS.job_deferred.get(label, 0) + 1
+                    continue
+                if not self._try_acquire(lease):
+                    continue
+                row = usage.setdefault(job_id, {})
+                for k, v in lease.resources.items():
+                    row[k] = row.get(k, 0.0) + v
             renv = lease.spec.runtime_env
             ehash = _env_hash(renv)
             n_chips = int(lease.resources.get("TPU", 0))
@@ -935,6 +1022,7 @@ class Raylet:
                 worker = self._find_idle_tpu_worker(
                     lease.spec.job_id, n_chips, ehash)
                 if worker is not None:
+                    self._pending.charge(job_id, lease)
                     self._grant(lease, worker)
                     self._pending.remove(lease)
                     continue
@@ -959,6 +1047,7 @@ class Raylet:
             idle = self._idle.get(key, [])
             if idle:
                 worker = idle.pop()
+                self._pending.charge(job_id, lease)
                 self._grant(lease, worker)
                 self._pending.remove(lease)
             else:

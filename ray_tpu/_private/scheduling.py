@@ -9,9 +9,11 @@ and the GCS (actor creation, placement-group bundle placement).
 
 from __future__ import annotations
 
+import heapq
 import random
+from collections import deque
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Callable, Dict, Iterable, List, Optional
 
 from ray_tpu._private import fault_injection as _fi
 from ray_tpu._private import task as task_mod
@@ -27,16 +29,30 @@ _DEFAULT_RNG = random.Random()
 class _SchedStats:
     """Process-wide scheduling counters (flight-recorder plane): plain
     integer increments on the decision path, exposed at scrape time via
-    `metrics_text()` from the daemons' /metrics extra_text."""
+    `metrics_text()` from the daemons' /metrics extra_text.
 
-    __slots__ = ("pick_calls", "no_feasible", "bundle_placements",
-                 "bundle_failures")
+    `no_feasible` counts demands NO alive node could ever satisfy
+    (total < demand everywhere — the autoscaler must add bigger nodes);
+    `no_capacity` counts demands that fit some node's total but nothing
+    RIGHT NOW (transiently full — more of the same nodes, or just wait).
+    Conflating the two made the autoscaler size for phantom demand.
+    """
+
+    __slots__ = ("pick_calls", "no_feasible", "no_capacity",
+                 "bundle_placements", "bundle_failures", "job_granted",
+                 "job_deferred")
 
     def __init__(self):
         self.pick_calls = 0
         self.no_feasible = 0
+        self.no_capacity = 0
         self.bundle_placements = 0
         self.bundle_failures = 0
+        # per-job rows ({job=} labels in /metrics): leases granted in
+        # fair-queue order, and dispatches deferred by admission control
+        # because the job was over its cpu/memory quota
+        self.job_granted: Dict[str, int] = {}
+        self.job_deferred: Dict[str, int] = {}
 
 
 SCHED_STATS = _SchedStats()
@@ -44,14 +60,26 @@ SCHED_STATS = _SchedStats()
 
 def metrics_text() -> str:
     s = SCHED_STATS
-    return (
-        "# TYPE scheduler_pick_node_total counter\n"
-        f"scheduler_pick_node_total {s.pick_calls}\n"
-        "# TYPE scheduler_no_feasible_total counter\n"
-        f"scheduler_no_feasible_total {s.no_feasible}\n"
-        "# TYPE scheduler_bundle_placements_total counter\n"
-        f"scheduler_bundle_placements_total {s.bundle_placements}\n"
-        f"scheduler_bundle_failures_total {s.bundle_failures}\n")
+    lines = [
+        "# TYPE scheduler_pick_node_total counter",
+        f"scheduler_pick_node_total {s.pick_calls}",
+        "# TYPE scheduler_no_feasible_total counter",
+        f"scheduler_no_feasible_total {s.no_feasible}",
+        "# TYPE scheduler_no_capacity_total counter",
+        f"scheduler_no_capacity_total {s.no_capacity}",
+        "# TYPE scheduler_bundle_placements_total counter",
+        f"scheduler_bundle_placements_total {s.bundle_placements}",
+        f"scheduler_bundle_failures_total {s.bundle_failures}",
+    ]
+    if s.job_granted:
+        lines.append("# TYPE scheduler_job_granted_total counter")
+        for job, n in sorted(s.job_granted.items()):
+            lines.append(f'scheduler_job_granted_total{{job="{job}"}} {n}')
+    if s.job_deferred:
+        lines.append("# TYPE scheduler_job_deferred_total counter")
+        for job, n in sorted(s.job_deferred.items()):
+            lines.append(f'scheduler_job_deferred_total{{job="{job}"}} {n}')
+    return "\n".join(lines) + "\n"
 
 
 def _tiebreak_rng() -> random.Random:
@@ -59,6 +87,176 @@ def _tiebreak_rng() -> random.Random:
     if plan is not None:
         return plan.rng_for("scheduling.tiebreak")
     return _DEFAULT_RNG
+
+
+# ---------------------------------------------------------------------------
+# per-job quotas + weighted-fair dispatch (multi-tenant isolation plane)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class JobQuota:
+    """Per-job resource limits + fair-share weight, registered at job
+    submission (`ray_tpu.init(job_quotas=...)` → GCS `register_job` →
+    every raylet via the jobs pubsub channel). Zero means unlimited for
+    the quota fields; `weight` sets the job's share of contended
+    dispatch (a weight-2 job drains twice as fast as a weight-1 job
+    when both are backlogged)."""
+
+    weight: float = 1.0
+    cpu: float = 0.0
+    memory: float = 0.0
+    object_store_bytes: int = 0
+
+    @classmethod
+    def from_dict(cls, d: Dict) -> "JobQuota":
+        return cls(
+            weight=float(d.get("weight", 1.0) or 1.0),
+            cpu=float(d.get("cpu", 0.0) or 0.0),
+            memory=float(d.get("memory", 0.0) or 0.0),
+            object_store_bytes=int(d.get("object_store_bytes", 0) or 0),
+        )
+
+    def to_dict(self) -> Dict:
+        return {"weight": self.weight, "cpu": self.cpu,
+                "memory": self.memory,
+                "object_store_bytes": self.object_store_bytes}
+
+
+_DEFAULT_QUOTA = JobQuota()
+JOB_QUOTAS: Dict[bytes, JobQuota] = {}
+
+
+def set_job_quota(job_id: bytes, quota: JobQuota) -> None:
+    JOB_QUOTAS[job_id] = quota
+
+
+def job_quota(job_id: bytes) -> JobQuota:
+    return JOB_QUOTAS.get(job_id, _DEFAULT_QUOTA)
+
+
+def job_label(job_id: bytes) -> str:
+    """Short stable {job=} label for /metrics rows."""
+    return job_id.hex()[:8] if job_id else "none"
+
+
+class FairDispatchQueue:
+    """Weighted-fair queue over per-job FIFO lanes.
+
+    Replaces the raylet's FIFO `_pending` list: each job owns a lane,
+    and contended dispatch drains lanes deficit-round-robin — every
+    grant advances the job's virtual clock by `cost / weight`, and
+    `fair_scan()` orders all queued items lowest-clock-first (the
+    job with the largest accumulated deficit relative to its weight
+    goes first). Long-run grant shares therefore track weights: a
+    weight-4 lane drains 4× a weight-1 lane while both are backlogged,
+    and within a lane FIFO order is preserved.
+
+    A job (re)entering the queue is floored to the current backlogged
+    minimum clock — or, when nothing is backlogged, to the highest
+    clock ever charged — so idle time banks no credit in EITHER
+    direction: an idle incumbent cannot burst on return, and a
+    late-arriving job cannot claim catch-up service for time before it
+    existed. Single-threaded like the raylet event loop — no internal
+    locking.
+    """
+
+    def __init__(self, cost_of: Optional[Callable] = None,
+                 weight_of: Optional[Callable] = None):
+        self._lanes: Dict[bytes, deque] = {}
+        self._vtime: Dict[bytes, float] = {}
+        self._vmax = 0.0  # highest clock ever charged (idle-entry floor)
+        self._cost_of = cost_of or (lambda item: 1.0)
+        self._weight_of = weight_of or (
+            lambda job: job_quota(job).weight)
+
+    # -- list-compatible surface (the raylet's _pending call sites) ----
+
+    def __len__(self) -> int:
+        return sum(len(lane) for lane in self._lanes.values())
+
+    def __bool__(self) -> bool:
+        return any(self._lanes.values())
+
+    def __iter__(self) -> Iterable:
+        return iter(self.fair_scan())
+
+    def __contains__(self, item) -> bool:
+        return any(any(it is item for it in lane)
+                   for lane in self._lanes.values())
+
+    def push(self, job: bytes, item) -> None:
+        lane = self._lanes.get(job)
+        if lane is None:
+            lane = self._lanes[job] = deque()
+        if not lane:
+            # joining the backlog: start at the backlogged frontier, or
+            # at the global high-water clock when the queue is idle (a
+            # brand-new job must not out-deficit an incumbent that
+            # already drained its work)
+            active = [self._vtime.get(j, 0.0)
+                      for j, l in self._lanes.items() if l and j != job]
+            floor = min(active) if active else self._vmax
+            self._vtime[job] = max(self._vtime.get(job, 0.0), floor)
+        lane.append(item)
+
+    def remove(self, item) -> bool:
+        """Remove by identity (leases are mutable dataclasses — equality
+        would be both slow and wrong here)."""
+        for job, lane in self._lanes.items():
+            for i, it in enumerate(lane):
+                if it is item:
+                    del lane[i]
+                    if not lane:
+                        del self._lanes[job]
+                    return True
+        return False
+
+    # -- fair order ----------------------------------------------------
+
+    def fair_scan(self) -> List:
+        """Every queued item in weighted-fair order. Pure simulation:
+        the real per-job clocks only advance on `charge()` (an actual
+        grant), so skipped items (deps not ready, node full) cost their
+        job nothing."""
+        heap = []
+        pos: Dict[bytes, int] = {}
+        for k, (job, lane) in enumerate(self._lanes.items()):
+            if lane:
+                heapq.heappush(heap, (self._vtime.get(job, 0.0), k, job))
+                pos[job] = 0
+        out: List = []
+        while heap:
+            v, k, job = heapq.heappop(heap)
+            lane = self._lanes[job]
+            item = lane[pos[job]]
+            out.append(item)
+            pos[job] += 1
+            v += self._cost_of(item) / max(self._weight_of(job), 1e-9)
+            if pos[job] < len(lane):
+                heapq.heappush(heap, (v, k, job))
+        return out
+
+    def head(self, n: int) -> List:
+        """First n items in fair order (heartbeat demand reporting)."""
+        return self.fair_scan()[:n]
+
+    def charge(self, job: bytes, item) -> None:
+        """Commit a grant: advance the job's virtual clock and its
+        {job=} grant counter."""
+        w = max(self._weight_of(job), 1e-9)
+        v = self._vtime.get(job, 0.0) + self._cost_of(item) / w
+        self._vtime[job] = v
+        if v > self._vmax:
+            self._vmax = v
+        label = job_label(job)
+        SCHED_STATS.job_granted[label] = \
+            SCHED_STATS.job_granted.get(label, 0) + 1
+
+    def depths(self) -> Dict[str, int]:
+        """Queue depth per job label (scheduler_queue_depth{job=})."""
+        return {job_label(job): len(lane)
+                for job, lane in self._lanes.items() if lane}
 
 
 @dataclass
@@ -131,7 +329,14 @@ def pick_node(
     node = _pick_node_impl(view, spec_resources, strategy, local_node_id,
                            target_node_id, soft, spread_threshold, rng)
     if node is None:
-        SCHED_STATS.no_feasible += 1
+        # Split the failure signal the autoscaler sizes from: a demand
+        # some alive node could EVENTUALLY satisfy (total fits, just
+        # busy now) is lack of capacity; a demand no node's total can
+        # ever hold (or an empty cluster) is genuinely infeasible.
+        if any(n.feasible(spec_resources) for n in view.alive_nodes()):
+            SCHED_STATS.no_capacity += 1
+        else:
+            SCHED_STATS.no_feasible += 1
     return node
 
 

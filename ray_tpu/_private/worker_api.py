@@ -70,10 +70,18 @@ def init(
     resources: Dict[str, float] | None = None,
     object_store_memory: int | None = None,
     runtime_env: dict | None = None,
+    job_quotas: dict | None = None,
     _system_config: dict | None = None,
     ignore_reinit_error: bool = False,
 ):
-    """Start (or connect to) a ray_tpu cluster and attach this driver."""
+    """Start (or connect to) a ray_tpu cluster and attach this driver.
+
+    ``job_quotas`` registers this driver's job with the multi-tenant
+    isolation plane: ``{"weight": 2.0, "cpu": 8.0, "memory": 2**30,
+    "object_store_bytes": 256 * 2**20}`` — weight sets the job's share
+    of contended dispatch; the quota fields (0/absent = unlimited) cap
+    concurrently held CPU/memory and shm-store bytes (see README
+    "Multi-tenancy")."""
     global _global_state
     with _global_lock:
         if _global_state is not None:
@@ -171,7 +179,15 @@ def init(
         cw._run_sync(cw.gcs.call("register_job", {
             "job_id": job_id.binary(),
             "driver_addr": cw.address,
+            "quotas": dict(job_quotas) if job_quotas else None,
         }))
+        if job_quotas:
+            # the driver-local scheduler registry too: this process's
+            # raylet learns via pubsub, but client-side bits (e.g. the
+            # fair queue weight default) read the local registry
+            from ray_tpu._private import scheduling as _sched
+            _sched.set_job_quota(job_id.binary(),
+                                 _sched.JobQuota.from_dict(job_quotas))
         if runtime_env is not None:
             # job-level default applied to every task/actor without its
             # own runtime_env (reference: ray.init(runtime_env=...))

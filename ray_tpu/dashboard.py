@@ -196,11 +196,36 @@ class Dashboard:
             # produce negative runtimes
             now = time.time()
             out = state_api.list_jobs()
+            # node-local per-job shm-store accounting (this process is
+            # attached to the head node's arena; remote nodes' usage
+            # shows on their raylet /metrics)
+            try:
+                from ray_tpu._private.worker_api import _require_state
+
+                store = _require_state().core_worker.store
+            except Exception:  # noqa: BLE001 — no store in this process
+                store = None
+            live_weights = sum(
+                float((jb.get("quotas") or {}).get("weight", 1.0) or 1.0)
+                for jb in out if not jb.get("finished"))
             for jb in out:
                 start = jb.get("start_time")
                 end = jb["end_time"] if jb.get("finished") else now
                 jb["runtime_s"] = (round(end - start, 1)
                                    if start is not None else None)
+                q = jb.get("quotas") or {}
+                w = float(q.get("weight", 1.0) or 1.0)
+                jb["weight"] = w
+                jb["fair_share"] = (round(w / live_weights, 4)
+                                    if live_weights and
+                                    not jb.get("finished") else 0.0)
+                st = None
+                if store is not None:
+                    try:
+                        st = store.job_stats(bytes.fromhex(jb["job_id"]))
+                    except Exception:  # noqa: BLE001 — store detached
+                        st = None
+                jb["object_store"] = st
             return out
 
         app.router.add_get("/api/jobs", j(jobs_with_runtime))

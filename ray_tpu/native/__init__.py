@@ -42,6 +42,37 @@ def load_shm_store() -> ctypes.CDLL:
     lib.ss_attach.restype = ctypes.c_int
     lib.ss_create.argtypes = [ctypes.c_int, ctypes.c_char_p, ctypes.c_uint64]
     lib.ss_create.restype = ctypes.c_int64
+    lib.ss_create_job.argtypes = [
+        ctypes.c_int,
+        ctypes.c_char_p,
+        ctypes.c_uint64,
+        ctypes.c_uint64,  # job key (0 = untracked)
+    ]
+    lib.ss_create_job.restype = ctypes.c_int64
+    lib.ss_set_job_quota.argtypes = [
+        ctypes.c_int,
+        ctypes.c_uint64,  # job key
+        ctypes.c_uint64,  # byte quota (0 = unlimited)
+    ]
+    lib.ss_set_job_quota.restype = ctypes.c_int
+    lib.ss_job_stats.argtypes = [
+        ctypes.c_int,
+        ctypes.c_uint64,  # job key
+        ctypes.POINTER(ctypes.c_uint64),  # 5-element row
+    ]
+    lib.ss_job_stats.restype = ctypes.c_int
+    lib.ss_job_list.argtypes = [
+        ctypes.c_int,
+        ctypes.POINTER(ctypes.c_uint64),
+        ctypes.c_int,
+    ]
+    lib.ss_job_list.restype = ctypes.c_int
+    lib.ss_evict_job.argtypes = [
+        ctypes.c_int,
+        ctypes.c_uint64,  # nbytes
+        ctypes.c_uint64,  # job key
+    ]
+    lib.ss_evict_job.restype = ctypes.c_uint64
     lib.ss_seal.argtypes = [ctypes.c_int, ctypes.c_char_p]
     lib.ss_seal.restype = ctypes.c_int
     lib.ss_get.argtypes = [

@@ -70,10 +70,11 @@
 namespace {
 
 constexpr uint64_t kMagic = 0x52415953544f5245ULL;  // "RAYSTORE"
-constexpr uint32_t kVersion = 2;
+constexpr uint32_t kVersion = 3;  // v3: per-job accounting plane
 constexpr uint64_t kAlign = 64;
 constexpr uint32_t kIdSize = 16;
 constexpr uint32_t kMaxShards = 16;
+constexpr uint32_t kMaxJobs = 32;
 // A data region below this is not worth slicing further: objects are
 // large, and tiny regions would push every big create onto the
 // all-locks spanning path. Small (test) stores auto-degrade to one
@@ -94,6 +95,7 @@ enum : int64_t {
   SS_NOT_SEALED = -6,
   SS_SYS = -7,
   SS_BAD_HANDLE = -8,
+  SS_QUOTA = -9,
 };
 
 struct Slot {
@@ -105,7 +107,7 @@ struct Slot {
   // LRU doubly-linked list (per shard), values are slot_index + 1 (0 = nil).
   uint32_t lru_prev;
   uint32_t lru_next;
-  uint32_t _pad;
+  uint32_t job;         // creator job slot + 1 (0 = untagged); shard-locked
   // hi 32 bits: generation, bumped on every tombstone/reuse; lo 32:
   // refcount. One atomic word so the lock-free release can
   // decrement-iff-same-incarnation with a single CAS.
@@ -145,6 +147,23 @@ struct RegionState {
 };
 static_assert(sizeof(RegionState) == 128, "pad regions to two cache lines");
 
+// Per-job accounting row (v3). The table is lock-free: rows are claimed
+// by CAS on `key` (first 8 bytes of the job id, 0 = free) and all byte
+// counters are atomic fetch-add/sub, so creators in different processes
+// never serialize on a job mutex. `used` is RESERVED before allocation
+// (fetch_add, refunded on failure) — the quota check and the reservation
+// are one atomic RMW, not a read-then-write across a lock release.
+struct JobState {
+  uint64_t key;            // atomic: job key; 0 = row free
+  uint64_t quota;          // byte quota; 0 = unlimited
+  uint64_t used;           // atomic: bytes currently allocated by the job
+  uint64_t evicted_bytes;  // atomic: bytes evicted from the job's objects
+  uint64_t quota_rejects;  // atomic: creates rejected with SS_QUOTA
+  uint64_t num_objects;    // atomic
+  uint8_t _pad[16];
+};
+static_assert(sizeof(JobState) == 64, "one cache line per job row");
+
 struct Header {
   uint64_t magic;
   uint32_t version;
@@ -160,6 +179,7 @@ struct Header {
   pthread_cond_t sealed_cv;
   ShardState shards[kMaxShards];
   RegionState regions[kMaxShards];
+  JobState jobs[kMaxJobs];
 };
 
 struct FreeBlock {
@@ -252,6 +272,44 @@ inline uint32_t shard_of(Store* s, const uint8_t* id) {
   // high hash bits pick the shard, low bits the in-shard slot — the two
   // must not be correlated or every shard collapses onto a few buckets
   return static_cast<uint32_t>((hash_id(id) >> 32) % s->hdr->num_shards);
+}
+
+// --- per-job accounting (v3) ---
+
+// Resolve the job row for `key`, claiming a free row when `create`.
+// Returns the row index, or -1 (key 0 / unknown / table full — the job
+// runs untracked, which keeps an overfull job table degrading to v2
+// semantics instead of failing creates).
+int job_slot(Store* s, uint64_t key, bool create) {
+  if (key == 0) return -1;
+  Header* h = s->hdr;
+  for (uint32_t i = 0; i < kMaxJobs; ++i) {
+    uint64_t k = __atomic_load_n(&h->jobs[i].key, __ATOMIC_ACQUIRE);
+    if (k == key) return static_cast<int>(i);
+    if (k == 0) {
+      if (!create) return -1;
+      uint64_t expect = 0;
+      if (__atomic_compare_exchange_n(&h->jobs[i].key, &expect, key, false,
+                                      __ATOMIC_ACQ_REL, __ATOMIC_ACQUIRE))
+        return static_cast<int>(i);
+      if (expect == key) return static_cast<int>(i);  // lost a benign race
+      // row claimed by a different key between load and CAS: keep scanning
+    }
+  }
+  return -1;
+}
+
+// Charge an object's bytes off its creator job when it leaves the store
+// (delete / abort / eviction). Caller holds the object's shard mutex, so
+// sl->job is stable; the job counters themselves are atomic.
+inline void job_uncharge(Store* s, Slot* sl, bool evicted) {
+  if (sl->job == 0) return;
+  JobState* j = &s->hdr->jobs[sl->job - 1];
+  __atomic_fetch_sub(&j->used, sl->alloc_size, __ATOMIC_ACQ_REL);
+  __atomic_fetch_sub(&j->num_objects, 1, __ATOMIC_ACQ_REL);
+  if (evicted)
+    __atomic_fetch_add(&j->evicted_bytes, sl->alloc_size, __ATOMIC_ACQ_REL);
+  sl->job = 0;
 }
 
 // --- atomic slot field access (lock-free probe side) ---
@@ -533,7 +591,13 @@ void scrub_tombstones(Store* s, uint32_t shard, Slot* sl) {
 
 // Evict LRU sealed refcount==0 objects from ONE shard until at least
 // `need` bytes were reclaimed (or nothing evictable remains in it).
-uint64_t evict_shard(Store* s, uint32_t shard, uint64_t need) {
+// `job_filter` 0 evicts any evictable object; a job row index + 1
+// restricts the sweep to that job's own objects — the quota path uses
+// this so an over-quota job reclaims ITS evictable data first and can
+// never push another tenant's objects out (referenced objects are
+// additionally protected by the refcount==0 test, filter or not).
+uint64_t evict_shard(Store* s, uint32_t shard, uint64_t need,
+                     uint32_t job_filter = 0) {
   ShardGuard g(s, shard);
   ShardState* sh = &s->hdr->shards[shard];
   uint64_t evicted = 0;
@@ -541,7 +605,8 @@ uint64_t evict_shard(Store* s, uint32_t shard, uint64_t need) {
   while (cur && evicted < need) {
     Slot* sl = &s->slots[cur - 1];
     uint32_t next = sl->lru_prev;
-    if (__atomic_load_n(&sl->state, __ATOMIC_RELAXED) == SEALED &&
+    if ((job_filter == 0 || sl->job == job_filter) &&
+        __atomic_load_n(&sl->state, __ATOMIC_RELAXED) == SEALED &&
         (__atomic_load_n(&sl->refgen, __ATOMIC_ACQUIRE) & 0xffffffffULL) ==
             0) {
       lru_unlink(s, sh, sl);
@@ -549,6 +614,7 @@ uint64_t evict_shard(Store* s, uint32_t shard, uint64_t need) {
       evicted += sl->alloc_size;
       sh->evicted_objects++;
       sh->evicted_bytes += sl->alloc_size;
+      job_uncharge(s, sl, /*evicted=*/true);
       // generation bump BEFORE tombstoning: a lock-free release racing
       // this eviction must fail its CAS, not resurrect the slot
       uint64_t gen = __atomic_load_n(&sl->refgen, __ATOMIC_RELAXED) >> 32;
@@ -723,11 +789,20 @@ int ss_attach(const char* name) {
   return attach_common(name, /*create=*/false, 0, 0, 0);
 }
 
-// Allocate an object buffer. Returns data-region-relative offset, or error.
-// The new object has refcount 1 (the creator) and is invisible to get()
-// until sealed. Allocation and eviction run BEFORE the index insert, so
-// the only index critical section is the (tiny) slot write.
-int64_t ss_create(int handle, const uint8_t* id, uint64_t size) {
+// Allocate an object buffer, attributed to `job_key` (0 = untracked).
+// Returns data-region-relative offset, or error. The new object has
+// refcount 1 (the creator) and is invisible to get() until sealed.
+// Allocation and eviction run BEFORE the index insert, so the only index
+// critical section is the (tiny) slot write.
+//
+// Quota path: the job's `used` counter is RESERVED with one atomic
+// fetch_add before any allocation happens — check-and-reserve is a
+// single RMW, never a read followed by a write across a lock release
+// (raylint's TOCTOU fixture encodes the forbidden shape). A job over
+// its quota first reclaims its OWN evictable objects; it never triggers
+// a global sweep, so no other tenant loses a byte to an offender.
+int64_t ss_create_job(int handle, const uint8_t* id, uint64_t size,
+                      uint64_t job_key) {
   Store* s = get_store(handle);
   if (!s) return SS_BAD_HANDLE;
   if (size == 0) size = kAlign;
@@ -739,28 +814,72 @@ int64_t ss_create(int handle, const uint8_t* id, uint64_t size) {
     Slot* dup = probe_lockfree(s, shard, id);
     if (dup && id_eq(dup, id)) return SS_EXISTS;
   }
+  int jrow = job_slot(s, job_key, /*create=*/true);
+  JobState* job = jrow >= 0 ? &h->jobs[jrow] : nullptr;
+  uint64_t want = align_up(size);
+  uint64_t reserved = 0;
+  if (job) {
+    // Reserve before allocating. Quota 0 = unlimited (pure accounting).
+    uint64_t prev = __atomic_fetch_add(&job->used, want, __ATOMIC_ACQ_REL);
+    reserved = want;
+    uint64_t quota = __atomic_load_n(&job->quota, __ATOMIC_ACQUIRE);
+    if (quota > 0 && prev + want > quota) {
+      // Over quota: reclaim this job's own evictable objects, then
+      // re-check. The sweep only touches slots tagged with this job.
+      uint64_t over = prev + want - quota;
+      for (uint32_t i = 0; i < h->num_shards; ++i)
+        evict_shard(s, (shard + i) % h->num_shards, over,
+                    static_cast<uint32_t>(jrow) + 1);
+      if (__atomic_load_n(&job->used, __ATOMIC_ACQUIRE) > quota) {
+        __atomic_fetch_sub(&job->used, want, __ATOMIC_ACQ_REL);
+        __atomic_fetch_add(&job->quota_rejects, 1, __ATOMIC_ACQ_REL);
+        return SS_QUOTA;
+      }
+    }
+  }
+  auto refund = [&]() {
+    if (job && reserved)
+      __atomic_fetch_sub(&job->used, reserved, __ATOMIC_ACQ_REL);
+  };
   uint64_t granted = 0;
   int64_t off = alloc_block(s, size, &granted, shard);
   // Evict until the allocation fits (not merely until `size` bytes were
   // reclaimed): freed blocks may not coalesce into a large-enough run.
   // Each sweep starts at the home shard and only locks the shards it
-  // actually has to touch.
+  // actually has to touch. A quota'd job reclaims its own objects first
+  // (tenant-priority), then falls back to the global LRU like any
+  // memory-pressured create.
   while (off == SS_NO_MEMORY) {
     uint64_t need = align_up(size);
     uint64_t freed = 0;
+    if (job) {
+      for (uint32_t i = 0; i < h->num_shards && freed < need; ++i)
+        freed += evict_shard(s, (shard + i) % h->num_shards, need - freed,
+                             static_cast<uint32_t>(jrow) + 1);
+    }
     for (uint32_t i = 0; i < h->num_shards && freed < need; ++i)
       freed += evict_shard(s, (shard + i) % h->num_shards, need - freed);
-    if (freed == 0) return SS_NO_MEMORY;
+    if (freed == 0) {
+      refund();
+      return SS_NO_MEMORY;
+    }
     off = alloc_block(s, size, &granted, shard);
+  }
+  if (job && granted > reserved) {
+    // whole-block grant: charge the real footprint, not the estimate
+    __atomic_fetch_add(&job->used, granted - reserved, __ATOMIC_ACQ_REL);
+    reserved = granted;
   }
   ShardGuard g(s, shard);
   Slot* insert = nullptr;
   if (find_slot(s, shard, id, &insert)) {
     region_free(s, static_cast<uint64_t>(off), granted);
+    refund();
     return SS_EXISTS;
   }
   if (!insert) {
     region_free(s, static_cast<uint64_t>(off), granted);
+    refund();
     return SS_TABLE_FULL;
   }
   id_store(insert, id);
@@ -768,11 +887,19 @@ int64_t ss_create(int handle, const uint8_t* id, uint64_t size) {
   insert->size = size;
   insert->alloc_size = granted;
   insert->lru_prev = insert->lru_next = 0;
+  insert->job = job ? static_cast<uint32_t>(jrow) + 1 : 0;
+  if (job)
+    __atomic_fetch_add(&job->num_objects, 1, __ATOMIC_ACQ_REL);
   uint64_t gen = __atomic_load_n(&insert->refgen, __ATOMIC_RELAXED) >> 32;
   __atomic_store_n(&insert->refgen, ((gen + 1) << 32) | 1, __ATOMIC_RELEASE);
   st_state(insert, CREATED);
   s->hdr->shards[shard].num_objects++;
   return off;
+}
+
+// v2-compatible create: untracked (no job attribution, no quota).
+int64_t ss_create(int handle, const uint8_t* id, uint64_t size) {
+  return ss_create_job(handle, id, size, 0);
 }
 
 // Seal a created object: becomes immutable and visible to get().
@@ -895,6 +1022,7 @@ int ss_delete(int handle, const uint8_t* id) {
   if (__atomic_load_n(&sl->state, __ATOMIC_RELAXED) == SEALED)
     lru_unlink(s, sh, sl);
   region_free(s, sl->offset, sl->alloc_size);
+  job_uncharge(s, sl, /*evicted=*/false);
   uint64_t gen = __atomic_load_n(&sl->refgen, __ATOMIC_RELAXED) >> 32;
   __atomic_store_n(&sl->refgen, (gen + 1) << 32, __ATOMIC_RELEASE);
   st_state(sl, TOMB);
@@ -1018,6 +1146,61 @@ int ss_shard_stats(int handle, uint32_t shard, uint64_t* out) {
     out[7] = h->regions[shard].lock_wait_ns;
   }
   return static_cast<int>(SS_OK);
+}
+
+// Set (or clear, quota=0) the byte quota for `job_key`, claiming an
+// accounting row if the job has none yet. Returns SS_OK, or
+// SS_TABLE_FULL when all kMaxJobs rows are taken.
+int ss_set_job_quota(int handle, uint64_t job_key, uint64_t quota) {
+  Store* s = get_store(handle);
+  if (!s) return static_cast<int>(SS_BAD_HANDLE);
+  int jrow = job_slot(s, job_key, /*create=*/true);
+  if (jrow < 0) return static_cast<int>(SS_TABLE_FULL);
+  __atomic_store_n(&s->hdr->jobs[jrow].quota, quota, __ATOMIC_RELEASE);
+  return static_cast<int>(SS_OK);
+}
+
+// Per-job accounting row: [quota, used, evicted_bytes, quota_rejects,
+// num_objects]. SS_NOT_FOUND when the job has no row (never stored and
+// never had a quota set).
+int ss_job_stats(int handle, uint64_t job_key, uint64_t* out) {
+  Store* s = get_store(handle);
+  if (!s) return static_cast<int>(SS_BAD_HANDLE);
+  int jrow = job_slot(s, job_key, /*create=*/false);
+  if (jrow < 0) return static_cast<int>(SS_NOT_FOUND);
+  JobState* j = &s->hdr->jobs[jrow];
+  out[0] = __atomic_load_n(&j->quota, __ATOMIC_ACQUIRE);
+  out[1] = __atomic_load_n(&j->used, __ATOMIC_ACQUIRE);
+  out[2] = __atomic_load_n(&j->evicted_bytes, __ATOMIC_ACQUIRE);
+  out[3] = __atomic_load_n(&j->quota_rejects, __ATOMIC_ACQUIRE);
+  out[4] = __atomic_load_n(&j->num_objects, __ATOMIC_ACQUIRE);
+  return static_cast<int>(SS_OK);
+}
+
+// List active job keys into `keys` (capacity `cap`); returns the count.
+int ss_job_list(int handle, uint64_t* keys, int cap) {
+  Store* s = get_store(handle);
+  if (!s) return static_cast<int>(SS_BAD_HANDLE);
+  int n = 0;
+  for (uint32_t i = 0; i < kMaxJobs && n < cap; ++i) {
+    uint64_t k = __atomic_load_n(&s->hdr->jobs[i].key, __ATOMIC_ACQUIRE);
+    if (k != 0) keys[n++] = k;
+  }
+  return n;
+}
+
+// Evict at least `nbytes` of ONE job's sealed unreferenced data (its
+// own objects only). Returns bytes evicted.
+uint64_t ss_evict_job(int handle, uint64_t nbytes, uint64_t job_key) {
+  Store* s = get_store(handle);
+  if (!s) return 0;
+  int jrow = job_slot(s, job_key, /*create=*/false);
+  if (jrow < 0) return 0;
+  uint64_t evicted = 0;
+  for (uint32_t i = 0; i < s->hdr->num_shards && evicted < nbytes; ++i)
+    evicted += evict_shard(s, i, nbytes - evicted,
+                           static_cast<uint32_t>(jrow) + 1);
+  return evicted;
 }
 
 // Parallel memcopy for large object payloads (reference: the plasma

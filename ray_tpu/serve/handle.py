@@ -19,11 +19,21 @@ import ray_tpu
 from ray_tpu.util import metrics as _metrics
 
 # module-level constructor (raylint: no metric objects on hot paths) —
-# counts requests shed because their deadline passed before dispatch
+# counts requests shed because their deadline passed before dispatch,
+# attributable per deployment and per submitting job (tenant)
 REQUEST_TIMEOUTS = _metrics.Counter(
     "serve_request_timeouts",
     "requests rejected because handle.options(timeout_s=...) expired "
-    "before dispatch")
+    "before dispatch",
+    tag_keys=("deployment", "job"))
+
+
+def _current_job_label() -> str:
+    """Short job label of the submitting process ({job=} metric rows)."""
+    from ray_tpu._private.object_ref import get_core_worker
+
+    cw = get_core_worker()
+    return cw.job_id.hex()[:8] if cw is not None else "none"
 
 
 class RequestTimeoutError(TimeoutError):
@@ -280,7 +290,8 @@ class DeploymentHandle:
         still queued client-side — a saturated deployment serves live
         requests instead of dead ones."""
         if deadline is not None and time.monotonic() > deadline:
-            REQUEST_TIMEOUTS.inc()
+            REQUEST_TIMEOUTS.inc(tags={"deployment": self._name,
+                                       "job": _current_job_label()})
             raise RequestTimeoutError(
                 f"request to {self._name!r} timed out after "
                 f"{self._timeout_s}s before dispatch")

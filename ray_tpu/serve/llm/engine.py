@@ -103,8 +103,10 @@ class Request:
     """
 
     def __init__(self, prompt: List[int], max_new_tokens: int,
-                 deadline: Optional[float], request_id: str):
+                 deadline: Optional[float], request_id: str,
+                 tenant: str = "none"):
         self.id = request_id
+        self.tenant = tenant  # submitting job's label ({job=} metrics)
         self.prompt = list(prompt)
         self.max_new_tokens = max_new_tokens
         self.deadline = deadline
@@ -260,6 +262,10 @@ class LLMEngine:
             "tokens_generated": 0, "prefill_steps": 0,
             "decode_steps": 0, "prefill_ms": 0.0, "decode_ms": 0.0,
         }
+        # per-tenant rows ({job=} labels in /metrics): shed decisions and
+        # throughput attributable to the submitting job — the serve
+        # plane's view of the multi-tenant quota plane
+        self.tenant_counters: Dict[str, Dict[str, float]] = {}
         _metrics.DEFAULT_REGISTRY.register_callback(
             "serve_llm", self._metrics_text)
 
@@ -302,9 +308,20 @@ class LLMEngine:
 
     # -- submission -------------------------------------------------------
 
+    def _tenant_row(self, tenant: str) -> Dict[str, float]:
+        """Per-tenant counter row; caller holds self._lock."""
+        row = self.tenant_counters.get(tenant)
+        if row is None:
+            row = self.tenant_counters[tenant] = {
+                "requests_submitted": 0, "requests_completed": 0,
+                "requests_timed_out": 0, "tokens_generated": 0,
+            }
+        return row
+
     def submit(self, prompt: List[int], max_new_tokens: int = 16, *,
                request_id: Optional[str] = None,
-               timeout_s: Optional[float] = None) -> Request:
+               timeout_s: Optional[float] = None,
+               tenant: Optional[str] = None) -> Request:
         if not prompt:
             raise RequestRejected("empty prompt")
         limit = max(self.config.prefill_buckets)
@@ -318,10 +335,17 @@ class LLMEngine:
                 f"prompt+max_new_tokens {total} exceeds max_seq_len "
                 f"{self.model_cfg.max_seq_len}")
         deadline = (time.monotonic() + timeout_s) if timeout_s else None
+        if tenant is None:
+            # default attribution: the submitting process's job
+            from ray_tpu._private.object_ref import get_core_worker
+            cw = get_core_worker()
+            tenant = cw.job_id.hex()[:8] if cw is not None else "none"
         req = Request(prompt, max_new_tokens, deadline,
-                      request_id or f"llm-{next(_req_counter)}")
+                      request_id or f"llm-{next(_req_counter)}",
+                      tenant=tenant)
         with self._lock:
             self.counters["requests_submitted"] += 1
+            self._tenant_row(tenant)["requests_submitted"] += 1
             self._waiting.append(req)
         self._work.set()
         return req
@@ -371,6 +395,7 @@ class LLMEngine:
             for req in self._waiting:
                 if req.deadline is not None and now > req.deadline:
                     self.counters["requests_timed_out"] += 1
+                    self._tenant_row(req.tenant)["requests_timed_out"] += 1
                     req._fail("deadline passed before admission")
                 else:
                     keep.append(req)
@@ -465,6 +490,9 @@ class LLMEngine:
             if seq in self._running:
                 self._running.remove(seq)
             self.counters["requests_completed"] += 1
+            row = self._tenant_row(seq.req.tenant)
+            row["requests_completed"] += 1
+            row["tokens_generated"] += len(seq.req.tokens)
         seq.req._finish(seq.req.finish_reason or "length")
 
     # -- pump thread ------------------------------------------------------
@@ -545,6 +573,8 @@ class LLMEngine:
                 kv_page_utilization=self.kv.utilization(),
                 kv_arena_id=self.kv.arena_id_hex,
                 model=self.model_name,
+                tenants={t: dict(row)
+                         for t, row in self.tenant_counters.items()},
             )
         return out
 
@@ -574,4 +604,11 @@ class LLMEngine:
             "# TYPE serve_llm_decode_ms_total counter",
             f"serve_llm_decode_ms_total {m['decode_ms']:.3f}",
         ]
+        # per-tenant rows: shed decisions + throughput per job label
+        for tenant, row in sorted(m.get("tenants", {}).items()):
+            for key in ("requests_submitted", "requests_completed",
+                        "requests_timed_out", "tokens_generated"):
+                lines.append(
+                    f'serve_llm_{key}_total{{job="{tenant}"}} '
+                    f"{int(row[key])}")
         return "\n".join(lines) + "\n"

@@ -71,6 +71,7 @@ def list_jobs() -> List[Dict[str, Any]]:
             "start_time": jb.get("start_time"),
             "end_time": jb.get("end_time"),
             "finished": jb.get("finished", False),
+            "quotas": jb.get("quotas"),
         }
         for jb in raw
     ]

@@ -31,7 +31,8 @@ import pytest  # noqa: E402
 # off) so the failure is attributed at teardown instead of perturbing
 # control flow mid-test; worker daemons self-install via RAY_TPU_LOCKDEP=1
 # in their inherited environment and raise in-daemon.
-_LOCKDEP_SUITES = ("test_chaos", "test_object_store", "test_rpc_batch")
+_LOCKDEP_SUITES = ("test_chaos", "test_object_store", "test_rpc_batch",
+                   "test_multitenant")
 
 
 @pytest.fixture(autouse=True)

@@ -1,0 +1,539 @@
+"""Multi-tenant isolation plane: weighted-fair dispatch, per-job store
+quotas, admission control, and job-identity plumbing.
+
+Covers the PR-11 tentpole invariants: grant shares track quota weights,
+the no_feasible/no_capacity autoscaler signal split, over-quota leases
+deferring (not failing), init(job_quotas=...) propagating GCS → pubsub →
+raylet → shared arena, two drivers' tasks carrying distinct job ids end
+to end, and the lockdep-gated two-job quota race at the byte-quota
+boundary (no torn counters, no cross-job eviction, referenced==0 at
+quiesce — same shape as the PR-3 object-store gate).
+"""
+
+import multiprocessing
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+from ray_tpu._private import scheduling as sched
+from ray_tpu._private.ids import ObjectID
+from ray_tpu._private.object_store import ObjectStore, QuotaExceededError
+from ray_tpu._private.scheduling import (
+    ClusterView,
+    FairDispatchQueue,
+    JobQuota,
+    SCHED_STATS,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_quota_registry():
+    saved = dict(sched.JOB_QUOTAS)
+    sched.JOB_QUOTAS.clear()
+    yield
+    sched.JOB_QUOTAS.clear()
+    sched.JOB_QUOTAS.update(saved)
+
+
+def _job(n: int) -> bytes:
+    return bytes([n]) + b"\0" * 15
+
+
+# -- weighted-fair dispatch queue -----------------------------------------
+
+
+def test_fair_queue_shares_track_weights():
+    """Backlogged jobs with weights 1/2/4 must receive grant shares
+    within 10% of the weight ratio (the bench_multitenant acceptance
+    bound, checked here at the queue level with zero noise)."""
+    weights = {_job(1): 1.0, _job(2): 2.0, _job(3): 4.0}
+    for job, w in weights.items():
+        sched.set_job_quota(job, JobQuota(weight=w))
+    q = FairDispatchQueue()
+    seq = 0
+    for job in weights:
+        for _ in range(5):
+            q.push(job, ("item", job, seq))
+            seq += 1
+    grants = {job: 0 for job in weights}
+    rounds = 700
+    for _ in range(rounds):
+        item = q.fair_scan()[0]
+        job = item[1]
+        q.charge(job, item)
+        q.remove(item)
+        grants[job] += 1
+        # keep every lane backlogged: shares are only defined while all
+        # jobs have queued work
+        q.push(job, ("item", job, seq))
+        seq += 1
+    total_w = sum(weights.values())
+    for job, w in weights.items():
+        expected = rounds * w / total_w
+        assert abs(grants[job] - expected) <= 0.10 * rounds, (
+            f"job {job[0]}: {grants[job]} grants, expected ~{expected}")
+
+
+def test_fair_queue_fifo_within_lane():
+    q = FairDispatchQueue()
+    job = _job(1)
+    items = [("i", n) for n in range(10)]
+    for it in items:
+        q.push(job, it)
+    assert q.fair_scan() == items
+    assert q.head(3) == items[:3]
+
+
+def test_fair_queue_identity_remove_and_contains():
+    q = FairDispatchQueue()
+    a, b = ["lease"], ["lease"]  # equal but distinct objects
+    q.push(_job(1), a)
+    q.push(_job(1), b)
+    assert a in q and b in q
+    assert q.remove(a) is True
+    assert a not in q and b in q
+    assert len(q) == 1
+
+
+def test_fair_queue_no_idle_credit_either_direction():
+    """After one job drains 20 items alone, a newly arriving equal-weight
+    job must NOT get a catch-up monopoly for the time before it existed,
+    and the incumbent must not burst either: from the shared frontier
+    the next grants alternate."""
+    q = FairDispatchQueue()
+    for n in range(20):
+        q.push(_job(1), ("a", n))
+    for _ in range(20):
+        item = q.fair_scan()[0]
+        q.charge(_job(1), item)
+        q.remove(item)
+    # job 2 arrives fresh against the (now idle) incumbent, then job 1
+    # re-enters: both lanes backlogged from a common frontier
+    for n in range(10):
+        q.push(_job(2), ("b", n))
+    for n in range(10):
+        q.push(_job(1), ("a2", n))
+    grants = {1: 0, 2: 0}
+    for _ in range(10):
+        item = q.fair_scan()[0]
+        job = _job(1) if item[0].startswith("a") else _job(2)
+        q.charge(job, item)
+        q.remove(item)
+        grants[job[0]] += 1
+    assert grants[1] == 5 and grants[2] == 5, grants
+
+
+def test_fair_scan_is_pure_and_charge_advances_clock():
+    """fair_scan() is simulation only — peeking must never advance a
+    job's clock; only charge() (an actual grant) does."""
+    sched.set_job_quota(_job(1), JobQuota(weight=1.0))
+    sched.set_job_quota(_job(2), JobQuota(weight=1.0))
+    q = FairDispatchQueue()
+    q.push(_job(1), "x1")
+    q.push(_job(2), "y1")
+    first = q.fair_scan()[0]
+    for _ in range(5):
+        assert q.fair_scan()[0] is first  # repeated peeks: same order
+    job = _job(1) if first == "x1" else _job(2)
+    q.charge(job, first)
+    q.remove(first)
+    q.push(job, "again")
+    assert q.fair_scan()[0] is not first  # the other lane's turn now
+
+
+def test_queue_depths_and_grant_metrics():
+    sched.set_job_quota(_job(7), JobQuota(weight=2.0))
+    q = FairDispatchQueue()
+    q.push(_job(7), "x")
+    q.push(_job(7), "y")
+    q.push(_job(9), "z")
+    depths = q.depths()
+    assert depths[sched.job_label(_job(7))] == 2
+    assert depths[sched.job_label(_job(9))] == 1
+    before = SCHED_STATS.job_granted.get(sched.job_label(_job(7)), 0)
+    q.charge(_job(7), "x")
+    assert SCHED_STATS.job_granted[sched.job_label(_job(7))] == before + 1
+    assert sched.job_label(_job(7)) in sched.metrics_text()
+
+
+# -- no_feasible vs no_capacity (autoscaler demand signal) ----------------
+
+
+def _view(total, available):
+    view = ClusterView()
+    view.update_node(b"n1", "addr:1", total, available)
+    return view
+
+
+def test_pick_node_counts_no_capacity_when_transiently_full():
+    """Demand fits the node's TOTAL but not its current availability:
+    that is lack of capacity (more of the same nodes, or wait), not
+    infeasibility."""
+    view = _view({"CPU": 2.0}, {"CPU": 0.0})
+    before_cap = SCHED_STATS.no_capacity
+    before_feas = SCHED_STATS.no_feasible
+    assert sched.pick_node(view, {"CPU": 1.0}) is None
+    assert SCHED_STATS.no_capacity == before_cap + 1
+    assert SCHED_STATS.no_feasible == before_feas
+
+
+def test_pick_node_counts_no_feasible_when_demand_never_fits():
+    """Demand no alive node's total can ever hold (and the empty
+    cluster) must count as no_feasible — the autoscaler needs BIGGER
+    nodes, not more of these."""
+    view = _view({"CPU": 2.0}, {"CPU": 2.0})
+    before_cap = SCHED_STATS.no_capacity
+    before_feas = SCHED_STATS.no_feasible
+    assert sched.pick_node(view, {"CPU": 8.0}) is None
+    assert SCHED_STATS.no_feasible == before_feas + 1
+    assert SCHED_STATS.no_capacity == before_cap
+    # empty cluster: nothing could ever fit
+    assert sched.pick_node(ClusterView(), {"CPU": 1.0}) is None
+    assert SCHED_STATS.no_feasible == before_feas + 2
+
+
+# -- raylet admission control (over-quota defers, never fails) ------------
+
+
+class _FakeRaylet:
+    """Just enough state for Raylet._job_usage/_over_quota."""
+
+    def __init__(self, leases):
+        self._leases = leases
+
+
+class _FakeLease:
+    def __init__(self, job, resources, acquired):
+        from types import SimpleNamespace
+
+        self.spec = SimpleNamespace(job_id=job)
+        self.resources = resources
+        self.acquired = acquired
+
+
+def test_over_quota_checks_cpu_and_memory_against_held():
+    from ray_tpu._private.raylet import Raylet
+
+    job = _job(3)
+    sched.set_job_quota(job, JobQuota(cpu=2.0, memory=1000.0))
+    fake = _FakeRaylet({
+        1: _FakeLease(job, {"CPU": 1.0}, acquired=True),
+        2: _FakeLease(job, {"CPU": 0.5}, acquired=False),  # not held
+    })
+    usage = Raylet._job_usage(fake)
+    assert usage[job]["CPU"] == 1.0
+    # 1.0 held + 1.0 demand == quota: admitted
+    assert not Raylet._over_quota(fake, job, {"CPU": 1.0}, usage)
+    # 1.0 held + 1.5 demand > quota: deferred
+    assert Raylet._over_quota(fake, job, {"CPU": 1.5}, usage)
+    # memory dimension enforced independently
+    assert Raylet._over_quota(fake, job, {"memory": 1001.0}, usage)
+    # an unlimited job never defers
+    free = _job(4)
+    assert not Raylet._over_quota(fake, free, {"CPU": 99.0}, usage)
+
+
+# -- chaos grammar: quota_flood (containment fault class) -----------------
+
+
+def test_quota_flood_parses_and_fires_against_registered_target():
+    from ray_tpu._private import fault_injection as _fi
+
+    plan = _fi.FaultPlan("at=0:quota_flood:0.4@worker")
+    tf = plan.timed[0]
+    assert (tf.fault, tf.arg, tf.role) == ("quota_flood", 0.4, "worker")
+    # default window when no arg given
+    assert _fi._parse_timed("1:quota_flood")[0].arg == 5.0
+    calls = {"n": 0}
+
+    def target():
+        calls["n"] += 1
+        if calls["n"] % 2 == 0:
+            raise QuotaExceededError("at quota")
+
+    _fi.install(plan)
+    try:
+        _fi.set_quota_flood_target(target)
+        _fi.set_role("worker")  # arms the @worker entry; fires at t+0
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and not any(
+                s[0] == "timed.quota_flood.done" for s in plan.schedule):
+            time.sleep(0.02)
+        done = [s for s in plan.schedule
+                if s[0] == "timed.quota_flood.done"]
+        assert done, "flood window never completed"
+        assert calls["n"] > 0
+        assert "rejects=" in done[0][2]
+        assert not plan.flooding()
+    finally:
+        _fi.set_quota_flood_target(None)
+        _fi.uninstall()
+        _fi.set_role("driver")
+
+
+def test_serve_timeout_metric_carries_deployment_and_job_labels():
+    from ray_tpu.serve.handle import REQUEST_TIMEOUTS
+
+    assert REQUEST_TIMEOUTS.tag_keys == ("deployment", "job")
+
+
+# -- two-job quota race at the byte-quota boundary (satellite 3) ----------
+# 4 threads + 2 processes split across two jobs hammer creates/frees,
+# job A pinned past its quota, while job B's parked objects stay
+# referenced. Runs under the lockdep gate (module is listed in
+# conftest._LOCKDEP_SUITES).
+
+_QUOTA = 4 * 1024 * 1024
+
+
+def _flood_job(store_name, job, seed, iters, obj_size, keep, q=None):
+    """Create/seal objects pinned by their creator reference, releasing
+    + deleting FIFO beyond `keep` live ones. With keep*obj_size above
+    the job's quota this drives SS_QUOTA rejects (nothing of the job's
+    is evictable); below it the job must never see a reject."""
+    from ray_tpu._private.object_store import (
+        ObjectStore as _OS,
+        ObjectStoreError,
+        QuotaExceededError as _QE,
+    )
+
+    store = _OS.attach(store_name)
+    store.set_current_job(job)
+    rejects = 0
+    pinned = []
+    try:
+        for i in range(iters):
+            oid = ObjectID(bytes([seed]) + i.to_bytes(4, "little")
+                           + b"\0" * 11)
+            try:
+                buf = store.create_buffer(oid, obj_size)
+                buf[:4] = b"ok!!"
+                del buf
+                store.seal(oid)
+                pinned.append(oid)
+            except _QE:
+                rejects += 1
+            except ObjectStoreError:
+                pass  # arena-level pressure: legal under the race
+            while len(pinned) > keep:
+                old = pinned.pop(0)
+                store.release(old)
+                store.delete(old)
+        # quiesce: this worker's objects all released and deleted
+        while pinned:
+            old = pinned.pop()
+            store.release(old)
+            store.delete(old)
+    finally:
+        store.close()
+    if q is not None:
+        q.put((seed, rejects))
+    return rejects
+
+
+def test_two_job_quota_race_no_torn_counters_no_cross_eviction():
+    import threading
+
+    name = f"/ray_tpu_test_mt_{os.getpid()}"
+    store = ObjectStore.create(name, capacity=32 * 1024 * 1024,
+                               table_size=4096, shards=8)
+    job_a, job_b = _job(21), _job(22)
+    big, small = 128 * 1024, 32 * 1024
+    try:
+        store.set_job_quota(job_a, _QUOTA, label="jobA")
+        store.set_job_quota(job_b, _QUOTA, label="jobB")
+
+        # job B parks referenced objects well under its quota — the race
+        # must never evict them or account them to job A
+        b_handle = ObjectStore.attach(name)
+        b_handle.set_current_job(job_b)
+        b_oids = []
+        for i in range(8):
+            oid = ObjectID(b"B" + i.to_bytes(4, "little") + b"\0" * 11)
+            buf = b_handle.create_buffer(oid, big)
+            buf[:4] = b"keep"
+            del buf
+            b_handle.seal(oid)  # creator reference kept: pinned
+            b_oids.append(oid)
+        b_used_before = store.job_stats(job_b)["used"]
+        assert b_used_before >= len(b_oids) * big
+
+        # job A's workers pin past A's quota (40*128K > 4M): guaranteed
+        # rejects. Job B's workers churn far below B's remaining quota:
+        # any B reject or eviction would mean A's overload leaked across.
+        ctx = multiprocessing.get_context("spawn")
+        q = ctx.Queue()
+        procs = [
+            ctx.Process(target=_flood_job,
+                        args=(name, job_a, 101, 150, big, 40, q)),
+            ctx.Process(target=_flood_job,
+                        args=(name, job_b, 102, 150, small, 2, q)),
+        ]
+        for p in procs:
+            p.start()
+        results = {}
+        lock = threading.Lock()
+
+        def run(seed, jb, n_keep, size):
+            r = _flood_job(name, jb, seed, 200, size, n_keep)
+            with lock:
+                results[seed] = r
+
+        threads = [
+            threading.Thread(target=run, args=args)
+            for args in ((1, job_a, 40, big), (2, job_a, 40, big),
+                         (3, job_b, 2, small), (4, job_b, 2, small))
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        for p in procs:
+            seed, r = q.get(timeout=120)
+            results[seed] = r
+        for p in procs:
+            p.join(timeout=30)
+        assert len(results) == 6
+
+        sa = store.job_stats(job_a)
+        sb = store.job_stats(job_b)
+        # the offender was capped: its quota held throughout the race
+        assert sa["used"] <= _QUOTA, sa
+        assert sa["quota_rejects"] >= 1, sa
+        assert results[1] + results[2] + results[101] >= 1
+        # containment: job B never felt job A's flood
+        assert sb["used"] <= _QUOTA, sb
+        assert sb["quota_rejects"] == 0, sb
+        assert sb["evicted_bytes"] == 0, sb
+        assert results[3] == results[4] == results[102] == 0
+        # B's parked objects survived, bytes intact
+        for oid in b_oids:
+            assert store.contains(oid)
+            view = b_handle.get_buffer(oid)
+            assert view is not None and bytes(view[:4]) == b"keep"
+            view = None
+        assert store.job_stats(job_b)["used"] >= b_used_before
+
+        # quiesce: drop the parked pins, then both jobs' counters must
+        # drain to exactly zero — a torn fetch_add/sub anywhere in the
+        # race leaves a residue here
+        for oid in b_oids:
+            b_handle.release(oid)
+            b_handle.delete(oid)
+        b_handle.close()
+        st = store.stats()
+        assert st["referenced"] == 0, st
+        store.evict(2 ** 62)
+        for jb in (job_a, job_b):
+            row = store.job_stats(jb)
+            assert row["used"] == 0, (jb, row)
+            assert row["num_objects"] == 0, (jb, row)
+        assert store.stats()["num_objects"] == 0
+    finally:
+        store.destroy()
+
+
+# -- end-to-end: quota propagation + distinct job ids on one cluster ------
+
+
+@pytest.fixture(scope="module")
+def mt_cluster():
+    import ray_tpu
+
+    quota = 2 * 1024 * 1024
+    ray_tpu.init(num_cpus=4, num_tpus=0,
+                 object_store_memory=64 * 1024 * 1024,
+                 job_quotas={"weight": 2.0, "object_store_bytes": quota})
+    yield ray_tpu, quota
+    ray_tpu.shutdown()
+
+
+def test_job_quota_registered_at_init_reaches_the_store(mt_cluster):
+    """init(job_quotas=...) → GCS register_job → jobs-channel pubsub →
+    raylet stamps the byte quota into the shared arena — after which
+    this driver's own creates hit QuotaExceededError at the boundary."""
+    ray_tpu, quota = mt_cluster
+    from ray_tpu._private.worker_api import _require_state
+
+    cw = _require_state().core_worker
+    store = cw.store
+    job = cw.job_id.binary()
+    # quota application is async (pubsub through the raylet): poll
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        st = store.job_stats(job)
+        if st is not None and st["quota"] == quota:
+            break
+        time.sleep(0.05)
+    st = store.job_stats(job)
+    assert st is not None and st["quota"] == quota, st
+
+    chunk = 256 * 1024
+    pinned = []
+    rejected = False
+    try:
+        for _ in range(quota // chunk + 8):
+            oid = ObjectID.from_random()
+            try:
+                buf = store.create_buffer(oid, chunk)
+                del buf
+                store.seal(oid)  # creator ref kept: nothing evictable
+                pinned.append(oid)
+            except QuotaExceededError:
+                rejected = True
+                break
+        assert rejected, "creates never hit the registered byte quota"
+        st = store.job_stats(job)
+        assert st["quota_rejects"] >= 1
+        assert st["used"] <= quota
+    finally:
+        for oid in pinned:
+            store.release(oid)
+            store.delete(oid)
+
+
+def test_two_drivers_tasks_carry_distinct_job_ids(mt_cluster):
+    """Two drivers against one cluster: each driver's tasks must run in
+    workers stamped with THAT driver's job id (the raylet pools workers
+    per job) — never a shared job-0 bucket."""
+    ray_tpu, _ = mt_cluster
+    from ray_tpu._private import worker_api
+    from ray_tpu.util import state as state_api
+
+    gcs_addr = worker_api._global_state.cluster.gcs_addr
+
+    @ray_tpu.remote
+    def whoami():
+        return ray_tpu.get_runtime_context().get_job_id()
+
+    my_job = ray_tpu.get_runtime_context().get_job_id()
+    assert my_job != "00" * 16  # the old JobID.from_int(0) default
+    assert ray_tpu.get(whoami.remote(), timeout=120) == my_job
+
+    script = textwrap.dedent(f"""
+        import ray_tpu
+        ray_tpu.init(address={gcs_addr!r})
+        @ray_tpu.remote
+        def whoami():
+            return ray_tpu.get_runtime_context().get_job_id()
+        me = ray_tpu.get_runtime_context().get_job_id()
+        worker = ray_tpu.get(whoami.remote(), timeout=120)
+        assert worker == me, (worker, me)
+        print("JOB=" + me)
+        ray_tpu.shutdown()
+    """)
+    out = subprocess.run(
+        [sys.executable, "-c", script], capture_output=True,
+        text=True, timeout=240, cwd="/root/repo",
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert out.returncode == 0, (out.stdout[-2000:], out.stderr[-2000:])
+    other_job = [ln for ln in out.stdout.splitlines()
+                 if ln.startswith("JOB=")][0].split("=", 1)[1]
+    assert other_job != my_job
+    # both jobs registered as distinct accounting buckets at the GCS
+    jobs = {j["job_id"] for j in state_api.list_jobs()}
+    assert my_job in jobs and other_job in jobs

@@ -414,6 +414,71 @@ class TestTimedSchedulePattern:
                    for f in findings), findings
 
 
+class TestQuotaReservePattern:
+    """The quota check-and-reserve idiom (`shm_store.cc ss_create_job`,
+    mirrored by the pure-Python quota paths): the quota read and the
+    `used` reservation must happen under ONE lock acquisition — a
+    single RMW in the native store. The good twin must stay silent;
+    checking under the lock and reserving after it is released is the
+    classic TOCTOU (two racing jobs both pass the check, both reserve,
+    and the tenant sails past its byte quota) and must flag.
+    """
+
+    def test_read_and_reserve_under_one_lock_clean(self):
+        findings = run("""
+            import threading
+
+            class JobQuota:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._used = 0
+                    self._quota = 1 << 23
+
+                def try_reserve(self, want):
+                    with self._lock:
+                        # check and reserve are one critical section
+                        if self._used + want > self._quota:
+                            return False
+                        self._used += want
+                    return True
+
+                def release(self, n):
+                    with self._lock:
+                        self._used -= n
+        """)
+        assert "lock-discipline" not in checks_of(findings), findings
+        assert "blocking-under-lock" not in checks_of(findings), findings
+
+    def test_check_then_reserve_across_release_flagged(self):
+        # the forbidden shape: the admission decision is made under the
+        # lock, but the reservation lands after it was released — a
+        # concurrent create can pass the same check in the window
+        findings = run("""
+            import threading
+
+            class JobQuota:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._used = 0
+                    self._quota = 1 << 23
+
+                def try_reserve(self, want):
+                    with self._lock:
+                        ok = self._used + want <= self._quota
+                    if ok:
+                        self._used += want   # TOCTOU: lock was released
+                    return ok
+
+                def release(self, n):
+                    with self._lock:
+                        self._used -= n
+        """)
+        assert any(f.check == "lock-discipline"
+                   and f.detail == "attr:_used"
+                   and f.scope == "JobQuota.try_reserve"
+                   for f in findings), findings
+
+
 # ---------------------------------------------------------------------------
 # checker 3: jit-purity
 # ---------------------------------------------------------------------------
