@@ -35,7 +35,7 @@ function esc(v) {
     '>':'&gt;','"':'&quot;',"'":'&#39;'}[c]));
 }
 async function refresh() {
-  const [nodes, actors, summary, jobs, res, events, steps] =
+  const [nodes, actors, summary, jobs, res, events, steps, reqs, tsdb] =
     await Promise.all([
     fetch('/api/nodes').then(r => r.json()),
     fetch('/api/actors').then(r => r.json()),
@@ -44,6 +44,8 @@ async function refresh() {
     fetch('/api/cluster_resources').then(r => r.json()),
     fetch('/api/events').then(r => r.json()),
     fetch('/api/steps').then(r => r.json()),
+    fetch('/api/requests').then(r => r.json()),
+    fetch('/api/timeseries').then(r => r.json()),
   ]);
   let html = '<h2>Cluster</h2><table><tr><th>total</th>' +
              '<th>available</th></tr>' +
@@ -103,6 +105,64 @@ async function refresh() {
     if (parts.length) html += `<p>time attribution: ${parts.join('  ')}</p>`;
   } else {
     html += '<p>no step records (train with the step profiler on)</p>';
+  }
+  html += '<h2>Serve requests</h2>';
+  if (reqs.records && reqs.records.length) {
+    const s = reqs.summary || {};
+    html += `<p>n=${esc(s.n||0)}  total p50/p99=` +
+            `${esc(s.total_ms_p50??'-')} / ${esc(s.total_ms_p99??'-')} ms` +
+            `  ttft p50=${esc(s.ttft_ms_p50??'-')} ms` +
+            `  tpot p50=${esc(s.tpot_ms_p50??'-')} ms</p>`;
+    html += '<table><tr><th>req</th><th>deploy</th><th>job</th>' +
+            '<th>total ms</th><th>queue</th><th>admit</th>' +
+            '<th>prefill</th><th>decode</th><th>ttft</th><th>tpot</th>' +
+            '<th>tok</th><th>outcome</th></tr>';
+    for (const r of (reqs.slowest || []).slice(0, 10)) {
+      const f = v => (v == null) ? '-' : Number(v).toFixed(2);
+      html += `<tr><td>${esc((r.req_id||'?').slice(0,8))}</td>` +
+              `<td>${esc(r.deployment||'')}</td><td>${esc(r.job||'')}</td>` +
+              `<td>${f(r.total_ms)}</td><td>${f(r.queue_ms)}</td>` +
+              `<td>${f(r.admission_ms)}</td><td>${f(r.prefill_ms)}</td>` +
+              `<td>${f(r.decode_ms)}</td><td>${f(r.ttft_ms)}</td>` +
+              `<td>${f(r.tpot_ms)}</td><td>${esc(r.tokens_out||0)}</td>` +
+              `<td>${esc(r.outcome||'ok')}</td></tr>`;
+    }
+    html += '</table>';
+  } else {
+    html += '<p>no request records (serve traffic with the request ' +
+            'recorder on)</p>';
+  }
+  html += '<h2>Time series</h2>';
+  function spark(points) {
+    // inline SVG polyline over the series' own min/max
+    if (!points || points.length < 2) return '(gathering)';
+    const vs = points.map(p => p[1]);
+    const lo = Math.min(...vs), hi = Math.max(...vs);
+    const w = 160, h = 24, span = (hi - lo) || 1;
+    const pts = points.map((p, i) =>
+      `${(i / (points.length - 1) * w).toFixed(1)},` +
+      `${(h - (p[1] - lo) / span * h).toFixed(1)}`).join(' ');
+    return `<svg width="${w}" height="${h}">` +
+           `<polyline points="${pts}" fill="none" stroke="#36c" ` +
+           `stroke-width="1.5"/></svg>`;
+  }
+  const sparkRows = (tsdb.series || [])
+    .filter(s => !s.name.endsWith('_bucket')).slice(0, 30);
+  if (sparkRows.length) {
+    html += '<table><tr><th>series</th><th>source</th>' +
+            '<th>latest</th><th>trend</th></tr>';
+    for (const s of sparkRows) {
+      const last = s.points.length ?
+        s.points[s.points.length - 1][1] : '-';
+      const lbl = Object.entries(s.labels || {})
+        .map(([k, v]) => `${k}=${v}`).join(',');
+      html += `<tr><td>${esc(s.name)}${lbl ? esc('{'+lbl+'}') : ''}</td>` +
+              `<td>${esc(s.source)}</td><td>${esc(last)}</td>` +
+              `<td>${spark(s.points)}</td></tr>`;
+    }
+    html += '</table>';
+  } else {
+    html += '<p>no series yet (sampler warming up)</p>';
   }
   html += '<h2>Recent events</h2><table><tr><th>time</th>' +
           '<th>severity</th><th>source</th><th>label</th>' +
@@ -273,6 +333,33 @@ class Dashboard:
             return {"deployments": out}
 
         app.router.add_get("/api/serve_llm", j(serve_llm_panel))
+
+        # metrics time-series plane: a Sampler owned by the dashboard
+        # snapshots the local registry + every reachable daemon's
+        # metrics_text on a cadence; /api/timeseries powers the
+        # sparkline panels
+        from ray_tpu.util import request_recorder
+        from ray_tpu.util import tsdb as tsdb_mod
+
+        sampler = tsdb_mod.Sampler().start()
+        app.router.add_get("/api/timeseries",
+                           j(lambda: sampler.db.snapshot()))
+
+        def requests_panel():
+            # request-path flight recorder: merged cross-process shards
+            # (when tracing is on), else this process's in-memory ring
+            records = request_recorder.collect()
+            if records:
+                records = request_recorder.merge_by_request(records)
+            else:
+                records = [r.as_dict()
+                           for r in request_recorder.ring().recent()]
+            records = records[-100:]
+            return {"records": records,
+                    "summary": request_recorder.summary(records),
+                    "slowest": request_recorder.slowest(records, 10)}
+
+        app.router.add_get("/api/requests", j(requests_panel))
 
         loop = asyncio.new_event_loop()
         asyncio.set_event_loop(loop)

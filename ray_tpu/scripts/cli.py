@@ -335,6 +335,163 @@ def cmd_profile(args):
               f"{max(attribution, key=attribution.get) if attribution else '?'}")
 
 
+def cmd_requests(args):
+    """Request-path flight recorder, offline: merged client+engine
+    records from the `requests-*.jsonl` shards the serving processes
+    wrote beside the tracing shards (falls back to this process's
+    in-memory ring). `--slow N` keeps the N worst by total latency."""
+    from ray_tpu.util import request_recorder
+
+    records = request_recorder.collect(args.trace_dir)
+    if records:
+        records = request_recorder.merge_by_request(records)
+    elif request_recorder.ring().recent():
+        records = [r.as_dict()
+                   for r in request_recorder.ring().recent()]
+    if getattr(args, "slow", 0):
+        records = request_recorder.slowest(records, args.slow)
+    if getattr(args, "json", False):
+        for rec in records:
+            print(json.dumps(rec))
+        return
+    print(request_recorder.format_table(records, last=args.last))
+
+
+def cmd_top(args):
+    """Live serving view: each tick polls the serve controller's
+    replicas, folds their counters into a `util.tsdb.TSDB` (alongside a
+    local+daemon metrics_text scrape), and renders req/s, TTFT/TPOT
+    p50/p99, KV occupancy, and per-job token shares from the stored
+    series — counter rates and quantiles come from the time-series
+    plane, not from one-shot gauges."""
+    ray_tpu = _connect(args)
+    from ray_tpu.util import tsdb as tsdb_mod
+
+    db = tsdb_mod.TSDB()
+
+    def poll() -> dict:
+        """One tick: controller poll -> exposition text -> db.ingest."""
+        view = {"deployments": []}
+        try:
+            ctrl = ray_tpu.get_actor("SERVE_CONTROLLER")
+            names = ray_tpu.get(ctrl.list_deployments.remote(),
+                                timeout=10)
+        except Exception:  # noqa: BLE001 — serve not running
+            return view
+        lines = []
+        for name in names:
+            try:
+                info = ray_tpu.get(ctrl.get_replicas.remote(name),
+                                   timeout=10)
+                rows = [ray_tpu.get(r.get_metrics.remote(), timeout=10)
+                        for r in info["replicas"]]
+            except Exception:  # noqa: BLE001 — replica churn mid-poll
+                continue
+            dep = {"deployment": name, "replicas": rows}
+            view["deployments"].append(dep)
+            done = sum(r.get("requests_completed", 0) for r in rows)
+            toks = sum(r.get("tokens_generated", 0) for r in rows)
+            live = sum(r.get("kv_pages_live", 0) for r in rows)
+            total = sum(r.get("kv_pages_total", 0) for r in rows)
+            lines.append(f'serve_top_requests_completed_total'
+                         f'{{deployment="{name}"}} {done}')
+            lines.append(f'serve_top_tokens_generated_total'
+                         f'{{deployment="{name}"}} {toks}')
+            lines.append(f'serve_top_kv_pages_live'
+                         f'{{deployment="{name}"}} {live}')
+            lines.append(f'serve_top_kv_pages_total'
+                         f'{{deployment="{name}"}} {total}')
+            jobs: dict = {}
+            for r in rows:
+                for job, row in (r.get("tenants") or {}).items():
+                    jobs[job] = jobs.get(job, 0) + row.get(
+                        "tokens_generated", 0)
+            for job, n in jobs.items():
+                lines.append(f'serve_top_tokens_generated_total'
+                             f'{{deployment="{name}",job="{job}"}} {n}')
+        if lines:
+            db.ingest("\n".join(lines) + "\n", source="serve")
+        tsdb_mod.scrape_once(db)
+        return view
+
+    def render(view: dict) -> str:
+        out = []
+        for dep in view["deployments"]:
+            name = dep["deployment"]
+            rows = dep["replicas"]
+            # counter rates from the series plane (deltas over the
+            # trailing window, robust to replica restarts)
+            req_s = db.rate("serve_top_requests_completed_total",
+                            {"deployment": name}, source="serve")
+            tok_s = db.rate("serve_top_tokens_generated_total",
+                            {"deployment": name}, source="serve")
+            live = db.latest("serve_top_kv_pages_live",
+                             {"deployment": name}, source="serve") or 0
+            total = db.latest("serve_top_kv_pages_total",
+                              {"deployment": name}, source="serve") or 0
+            out.append(f"deployment {name}: {len(rows)} replicas   "
+                       f"req/s={req_s if req_s is None else round(req_s, 2)}"
+                       f"   tok/s={tok_s if tok_s is None else round(tok_s, 1)}"
+                       f"   kv={int(live)}/{int(total)} pages"
+                       + (f" ({100 * live / total:.0f}%)"
+                          if total else ""))
+            # latency: per-replica request-recorder summaries (avg p50,
+            # worst p99 — quantiles don't merge exactly across rings)
+            sums = [r["request_summary"] for r in rows
+                    if r.get("request_summary")]
+            for key, label in (("ttft_ms", "ttft"), ("tpot_ms", "tpot"),
+                               ("total_ms", "total")):
+                p50s = [s[f"{key}_p50"] for s in sums
+                        if s.get(f"{key}_p50") is not None]
+                p99s = [s[f"{key}_p99"] for s in sums
+                        if s.get(f"{key}_p99") is not None]
+                if p50s:
+                    out.append(
+                        f"  {label:6} p50={sum(p50s) / len(p50s):8.2f} ms"
+                        f"   p99<={max(p99s):8.2f} ms")
+            queue = sum(r.get("queue_depth", 0) for r in rows)
+            running = sum(r.get("running", 0) for r in rows)
+            out.append(f"  queue={int(queue)}  running={int(running)}")
+            # per-job shares of generated tokens (multi-tenant view)
+            shares = {}
+            for key in db.series():
+                n, litems, src = key
+                ld = dict(litems)
+                if (n == "serve_top_tokens_generated_total"
+                        and src == "serve" and "job" in ld
+                        and ld.get("deployment") == name):
+                    r = db.rate(n, ld, source="serve")
+                    if r:
+                        shares[ld["job"]] = r
+            tot = sum(shares.values())
+            if tot > 0:
+                out.append("  job shares: " + "  ".join(
+                    f"{job}={100 * r / tot:.0f}%"
+                    for job, r in sorted(shares.items())))
+        if not view["deployments"]:
+            out.append("no serve deployments (serve.run something)")
+        out.append(f"[series={len(db.series())} "
+                   f"scrapes={db.scrapes}]")
+        return "\n".join(out)
+
+    import time as time_mod
+    try:
+        i = 0
+        while args.iterations is None or i < args.iterations:
+            view = poll()
+            if args.iterations is None \
+                    and not getattr(args, "no_clear", False):
+                print("\x1b[2J\x1b[H", end="")  # refresh in place
+            print(render(view))
+            i += 1
+            if args.iterations is None or i < args.iterations:
+                time_mod.sleep(args.interval)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        ray_tpu.shutdown()
+
+
 def cmd_client_server(args):
     import sys as _sys
 
@@ -512,6 +669,33 @@ def main(argv=None):
     p.add_argument("--json", action="store_true",
                    help="raw JSONL records instead of the table")
     p.set_defaults(fn=cmd_profile)
+
+    p = sub.add_parser(
+        "requests",
+        help="per-request serving telemetry: phase split + TTFT/TPOT")
+    p.add_argument("--trace-dir", default=None,
+                   help="request-record shard dir (default: "
+                        "RAY_TPU_TRACE_DIR)")
+    p.add_argument("--slow", type=int, default=0, metavar="N",
+                   help="only the N slowest requests by total latency")
+    p.add_argument("--last", type=int, default=20,
+                   help="rows to print (default 20)")
+    p.add_argument("--json", action="store_true",
+                   help="raw JSONL records instead of the table")
+    p.set_defaults(fn=cmd_requests)
+
+    p = sub.add_parser(
+        "top",
+        help="live serving table: req/s, TTFT/TPOT, KV occupancy, "
+             "per-job shares")
+    p.add_argument("--address")
+    p.add_argument("--interval", type=float, default=2.0,
+                   help="seconds between refreshes (default 2)")
+    p.add_argument("--iterations", type=int, default=None,
+                   help="stop after N refreshes (default: until ^C)")
+    p.add_argument("--no-clear", action="store_true",
+                   help="append output instead of redrawing the screen")
+    p.set_defaults(fn=cmd_top)
 
     p = sub.add_parser(
         "client-server",

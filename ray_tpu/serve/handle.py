@@ -17,6 +17,8 @@ from typing import Any, Dict, List, Optional
 
 import ray_tpu
 from ray_tpu.util import metrics as _metrics
+from ray_tpu.util import request_recorder as _rr
+from ray_tpu.util import tracing as _tracing
 
 # module-level constructor (raylint: no metric objects on hot paths) —
 # counts requests shed because their deadline passed before dispatch,
@@ -51,19 +53,50 @@ class DeploymentResponse:
     """
 
     def __init__(self, ref, router: Optional["Router"] = None,
-                 replica_idx: int = -1, resubmit=None):
+                 replica_idx: int = -1, resubmit=None,
+                 ctx: Optional[dict] = None,
+                 submit_ts: Optional[float] = None,
+                 queue_ms: float = 0.0):
         self._ref = ref
         self._router = router
         self._replica_idx = replica_idx
         self._done = False
         self._resubmit = resubmit
+        # request-recorder plane: the ctx minted at _submit + what the
+        # caller observed (the engine record carries the phase split)
+        self._ctx = ctx
+        self._submit_ts = submit_ts if submit_ts is not None \
+            else time.monotonic()
+        self._queue_ms = queue_ms
+        self._failed_over = False
+        self._recorded = False
 
     def _mark_done(self):
         if not self._done and self._router is not None:
             self._done = True
             self._router.done(self._replica_idx)
 
+    def _record(self, outcome: str):
+        if self._recorded or self._ctx is None:
+            return
+        self._recorded = True
+        total_ms = (time.monotonic() - self._submit_ts) * 1e3
+        _rr.record_client(
+            self._ctx, ts=time.time() - total_ms / 1e3,
+            total_ms=total_ms, queue_ms=self._queue_ms,
+            outcome=outcome)
+
     def result(self, timeout: Optional[float] = 60.0) -> Any:
+        try:
+            val = self._result_inner(timeout)
+        except BaseException as e:
+            self._record("timed_out" if isinstance(e, TimeoutError)
+                         else "failed")
+            raise
+        self._record("failed_over" if self._failed_over else "ok")
+        return val
+
+    def _result_inner(self, timeout: Optional[float]) -> Any:
         deadline = (time.monotonic() + timeout) if timeout is not None \
             else None
         try:
@@ -89,10 +122,12 @@ class DeploymentResponse:
             self._router = retry._router
             self._replica_idx = retry._replica_idx
             self._done = False
+            self._failed_over = True
             # This object took over the retry's in-flight accounting;
             # neuter the temporary so its __del__ can't double-decrement.
             retry._done = True
             retry._router = None
+            retry._recorded = True  # one client record per request
             return ray_tpu.get(self._ref, timeout=remaining)
         finally:
             self._mark_done()
@@ -114,18 +149,57 @@ class DeploymentResponseGenerator:
     riding the streaming-generator protocol)."""
 
     def __init__(self, gen, router: Optional["Router"] = None,
-                 replica_idx: int = -1, resubmit=None):
+                 replica_idx: int = -1, resubmit=None,
+                 ctx: Optional[dict] = None,
+                 submit_ts: Optional[float] = None,
+                 queue_ms: float = 0.0):
         self._gen = gen  # ObjectRefGenerator of chunk refs
         self._router = router
         self._replica_idx = replica_idx
         self._done = False
         self._resubmit = resubmit
         self._delivered = 0  # chunks already handed to the caller
+        # request-recorder plane: per-chunk stamps give the
+        # caller-observed TTFT and TPOT. TPOT averages the gaps between
+        # chunks the caller ACTUALLY waited on: after a failover the
+        # gap stamp resets (the next chunk's wait is recovery, not
+        # decode) and survivor-replayed chunks are counted in
+        # `replayed_tokens` but never timed.
+        self._ctx = ctx
+        self._submit_ts = submit_ts if submit_ts is not None \
+            else time.monotonic()
+        self._queue_ms = queue_ms
+        self._first_chunk_ts: Optional[float] = None
+        self._prev_chunk_ts: Optional[float] = None
+        self._tpot_sum = 0.0
+        self._tpot_n = 0
+        self._replayed = 0
+        self._failed_over = False
+        self._recorded = False
 
     def _mark_done(self):
         if not self._done and self._router is not None:
             self._done = True
             self._router.done(self._replica_idx)
+
+    def _record(self, outcome: str):
+        if self._recorded or self._ctx is None:
+            return
+        self._recorded = True
+        total_ms = (time.monotonic() - self._submit_ts) * 1e3
+        ttft = None
+        if self._first_chunk_ts is not None:
+            ttft = (self._first_chunk_ts - self._submit_ts) * 1e3
+        tpot = (self._tpot_sum / self._tpot_n * 1e3) \
+            if self._tpot_n else None
+        _rr.record_client(
+            self._ctx, ts=time.time() - total_ms / 1e3,
+            total_ms=total_ms, queue_ms=self._queue_ms,
+            ttft_ms=ttft, tpot_ms=tpot, tokens_out=self._delivered,
+            replayed_tokens=self._replayed, outcome=outcome,
+            # how many inter-chunk gaps the TPOT mean is over: lets
+            # tests pin that replay/recovery gaps were never timed
+            timed_gaps=self._tpot_n)
 
     def __iter__(self):
         return self
@@ -137,6 +211,8 @@ class DeploymentResponseGenerator:
                 val = ray_tpu.get(ref)
             except StopIteration:
                 self._mark_done()
+                self._record("failed_over" if self._failed_over
+                             else "ok")
                 raise
             except ray_tpu.ActorDiedError:
                 # replica died mid-stream: restart the stream on a
@@ -147,6 +223,7 @@ class DeploymentResponseGenerator:
                 # decode satisfies it). One retry, like the unary path.
                 if self._resubmit is None:
                     self._mark_done()
+                    self._record("failed")
                     raise
                 self._mark_done()
                 resubmit, self._resubmit = self._resubmit, None
@@ -158,14 +235,30 @@ class DeploymentResponseGenerator:
                 self._router = retry._router
                 self._replica_idx = retry._replica_idx
                 self._done = False
+                self._failed_over = True
                 retry._done = True  # accounting moved to this object
                 retry._router = None
+                retry._recorded = True  # one client record per request
+                # the survivor re-generates chunks the caller already
+                # has: count them as replayed, never time them, and
+                # reset the gap stamp so the next delivered chunk's
+                # recovery wait is excluded from TPOT too
+                self._replayed += self._delivered
+                self._prev_chunk_ts = None
                 for _ in range(self._delivered):  # replay dedup
                     ray_tpu.get(next(self._gen))
                 continue
             except Exception:
                 self._mark_done()
+                self._record("failed")
                 raise
+            now = time.monotonic()
+            if self._first_chunk_ts is None:
+                self._first_chunk_ts = now
+            elif self._prev_chunk_ts is not None:
+                self._tpot_sum += now - self._prev_chunk_ts
+                self._tpot_n += 1
+            self._prev_chunk_ts = now
             self._delivered += 1
             return val
 
@@ -174,6 +267,7 @@ class DeploymentResponseGenerator:
         yield."""
         self._gen.close()
         self._mark_done()
+        self._record("failed_over" if self._failed_over else "ok")
 
     def __del__(self):
         try:
@@ -296,26 +390,53 @@ class DeploymentHandle:
                 f"request to {self._name!r} timed out after "
                 f"{self._timeout_s}s before dispatch")
 
-    def _submit(self, args, kwargs, deadline: Optional[float] = None):
-        self._check_deadline(deadline)
-        idx, replica = self._router.choose()
+    def _submit(self, args, kwargs, deadline: Optional[float] = None,
+                ctx: Optional[dict] = None):
+        # mint the request's identity ONCE; a failover resubmit passes
+        # the same ctx back in so the survivor's work stitches into the
+        # same record/trace
+        t0 = time.monotonic()
+        if ctx is None:
+            ctx = _rr.new_context(self._name, _current_job_label())
+        idx = None
         try:
+            self._check_deadline(deadline)
+            idx, replica = self._router.choose()
             # choose() can block waiting for replicas — re-check before
             # committing the dispatch
             self._check_deadline(deadline)
         except RequestTimeoutError:
-            self._router.done(idx)
+            if idx is not None:
+                self._router.done(idx)
+            elapsed_ms = (time.monotonic() - t0) * 1e3
+            _rr.record_client(ctx, ts=time.time() - elapsed_ms / 1e3,
+                              total_ms=elapsed_ms, queue_ms=elapsed_ms,
+                              outcome="timed_out")
             raise
+        # client-side queue phase: deadline checks + router choose
+        queue_ms = (time.monotonic() - t0) * 1e3
+        attrs = {"req_id": ctx["req_id"],
+                 "flow_id": f"req:{ctx['req_id']}",
+                 "deployment": self._name, "replica": idx}
         if self._stream:
-            gen = replica.handle_request_streaming.options(
-                num_returns="streaming").remote(self._method, args, kwargs)
+            with _tracing.span(f"serve.{self._name}.stream",
+                               kind="producer", attrs=attrs):
+                gen = replica.handle_request_streaming.options(
+                    num_returns="streaming").remote(
+                        self._method, args, kwargs, ctx)
             return DeploymentResponseGenerator(
                 gen, self._router, idx,
-                resubmit=lambda: self._submit(args, kwargs, deadline))
-        ref = replica.handle_request.remote(self._method, args, kwargs)
+                resubmit=lambda: self._submit(args, kwargs, deadline,
+                                              ctx),
+                ctx=ctx, submit_ts=t0, queue_ms=queue_ms)
+        with _tracing.span(f"serve.{self._name}.request",
+                           kind="producer", attrs=attrs):
+            ref = replica.handle_request.remote(
+                self._method, args, kwargs, ctx)
         return DeploymentResponse(
             ref, self._router, idx,
-            resubmit=lambda: self._submit(args, kwargs, deadline))
+            resubmit=lambda: self._submit(args, kwargs, deadline, ctx),
+            ctx=ctx, submit_ts=t0, queue_ms=queue_ms)
 
     def _submit_asgi(self, scope: dict, body: bytes
                      ) -> "DeploymentResponseGenerator":

@@ -40,7 +40,9 @@ import numpy as np
 
 from ray_tpu.serve.llm.kv_cache import OutOfPagesError, PagedKVCache
 from ray_tpu.util import metrics as _metrics
+from ray_tpu.util import request_recorder as _rr
 from ray_tpu.util import step_profiler as _sp
+from ray_tpu.util import tracing as _tracing
 
 
 def _env_int(name: str, default: int) -> int:
@@ -117,6 +119,17 @@ class Request:
         self.finish_reason: Optional[str] = None
         self.submit_ts = time.monotonic()
         self.finish_ts: Optional[float] = None
+        # request-recorder plane: phase stamps (monotonic) + the
+        # propagated request context captured at submit() — the pump
+        # thread can't see the submitter's contextvars, so the ctx must
+        # ride the Request object
+        self.ctx: Optional[dict] = None
+        self.submit_wall = time.time()
+        self.first_consider_ts: Optional[float] = None
+        self.admit_ts: Optional[float] = None
+        self.prefill_ms = 0.0
+        self.first_token_ts: Optional[float] = None
+        self.last_token_ts: Optional[float] = None
 
     def __repr__(self):
         return f"Request({self.id})"
@@ -146,6 +159,12 @@ class Request:
     # -- engine side -----------------------------------------------------
 
     def _emit(self, token: int):
+        # per-token recorder cost: one monotonic read (TPOT = span
+        # between the first and last of these stamps)
+        now = time.monotonic()
+        if self.first_token_ts is None:
+            self.first_token_ts = now
+        self.last_token_ts = now
         self.tokens.append(token)
         self.out_q.put(("token", len(self.tokens) - 1, token))
 
@@ -343,6 +362,10 @@ class LLMEngine:
         req = Request(prompt, max_new_tokens, deadline,
                       request_id or f"llm-{next(_req_counter)}",
                       tenant=tenant)
+        # the replica's serving(ctx) region is live during submit (it
+        # happens inside handle_request_streaming's yield-from); the
+        # pump thread reads the ctx back off the request
+        req.ctx = _rr.current()
         with self._lock:
             self.counters["requests_submitted"] += 1
             self._tenant_row(tenant)["requests_submitted"] += 1
@@ -392,14 +415,18 @@ class LLMEngine:
         now = time.monotonic()
         with self._lock:
             keep = []
+            shed = []
             for req in self._waiting:
                 if req.deadline is not None and now > req.deadline:
                     self.counters["requests_timed_out"] += 1
                     self._tenant_row(req.tenant)["requests_timed_out"] += 1
-                    req._fail("deadline passed before admission")
+                    shed.append(req)
                 else:
                     keep.append(req)
             self._waiting = keep
+        for req in shed:
+            req._fail("deadline passed before admission")
+            self._emit_request_record(req, "timed_out")
 
     def _admit_one(self) -> Optional[Request]:
         """Pop the oldest waiting request whose worst-case page demand
@@ -410,12 +437,18 @@ class LLMEngine:
                     len(self._running) >= self.config.max_running:
                 return None
             req = self._waiting[0]
+            # queue phase ends at the FIRST admission consideration —
+            # time spent retrying page reservation after this point is
+            # admission wait, not queue wait
+            if req.first_consider_ts is None:
+                req.first_consider_ts = time.monotonic()
             need = self.kv.pages_for_tokens(
                 len(req.prompt) + req.max_new_tokens)
             try:
                 pages = self.kv.alloc(need, req)
             except OutOfPagesError:
                 return None
+            req.admit_ts = time.monotonic()
             self._waiting.pop(0)
         req._pages = pages
         return req
@@ -424,17 +457,26 @@ class LLMEngine:
         pages = req._pages
         s = len(req.prompt)
         bucket = min(b for b in self.config.prefill_buckets if b >= s)
-        toks = np.zeros((1, bucket), np.int32)
-        toks[0, :s] = req.prompt
-        next_logits, k, v = self._prefill_fns[bucket](
-            self.params, toks, np.asarray([s], np.int32))
-        self.kv.write_prefill(pages, np.asarray(k[0]),
-                              np.asarray(v[0]), s)
-        seq = _Sequence(req, pages, pos=s)
-        with self._lock:
-            self.counters["prefill_steps"] += 1
-        tok = int(np.argmax(np.asarray(next_logits[0])))
-        req._emit(tok)
+        attrs: Dict[str, Any] = {"bucket": bucket, "tokens_in": s}
+        if req.ctx:
+            attrs["req_id"] = req.ctx["req_id"]
+            attrs["flow_id"] = f"req:{req.ctx['req_id']}"
+        t0 = time.perf_counter()
+        with _tracing.span("llm.prefill", kind="consumer", attrs=attrs):
+            toks = np.zeros((1, bucket), np.int32)
+            toks[0, :s] = req.prompt
+            next_logits, k, v = self._prefill_fns[bucket](
+                self.params, toks, np.asarray([s], np.int32))
+            self.kv.write_prefill(pages, np.asarray(k[0]),
+                                  np.asarray(v[0]), s)
+            seq = _Sequence(req, pages, pos=s)
+            with self._lock:
+                self.counters["prefill_steps"] += 1
+            tok = int(np.argmax(np.asarray(next_logits[0])))
+            req._emit(tok)
+        # prefill phase ends at the first-token emit; the decode phase
+        # (first-token -> last-token) starts there, so the phases tile
+        req.prefill_ms = (time.perf_counter() - t0) * 1e3
         if self._seq_finished(seq, tok):
             self._finish(seq)
         else:
@@ -494,6 +536,39 @@ class LLMEngine:
             row["requests_completed"] += 1
             row["tokens_generated"] += len(seq.req.tokens)
         seq.req._finish(seq.req.finish_reason or "length")
+        self._emit_request_record(seq.req, "ok")
+
+    def _emit_request_record(self, req: Request, outcome: str):
+        """Fold one finished request into the flight recorder: engine
+        role, authoritative phase split. Monotonic stamp geometry —
+        submit → first_consider (queue) → admit (admission) →
+        first_token (prefill) → last_token (decode) → finish — tiles the
+        end-to-end time, so the bench can assert phase-sum ≈ total."""
+        if not _rr.enabled():
+            return
+        end = req.finish_ts or time.monotonic()
+        first_consider = req.first_consider_ts or end
+        admit = req.admit_ts or first_consider
+        n = len(req.tokens)
+        ttft_ms = decode_ms = None
+        tpot_ms = None
+        if req.first_token_ts is not None:
+            ttft_ms = (req.first_token_ts - req.submit_ts) * 1e3
+            decode_ms = (req.last_token_ts - req.first_token_ts) * 1e3
+            if n > 1 and decode_ms > 0:
+                tpot_ms = decode_ms / (n - 1)
+        _rr.record_engine(
+            req.ctx,
+            ts=req.submit_wall,
+            total_ms=(end - req.submit_ts) * 1e3,
+            queue_ms=(first_consider - req.submit_ts) * 1e3,
+            admission_ms=max(0.0, (admit - first_consider) * 1e3),
+            prefill_ms=req.prefill_ms,
+            decode_ms=decode_ms or 0.0,
+            ttft_ms=ttft_ms, tpot_ms=tpot_ms,
+            tokens_in=len(req.prompt), tokens_out=n,
+            outcome=outcome, job=req.tenant,
+            finish_reason=req.finish_reason or req.error or "")
 
     # -- pump thread ------------------------------------------------------
 
@@ -558,6 +633,7 @@ class LLMEngine:
             waiting, self._waiting = self._waiting, []
         for req in waiting:
             req._fail("engine shut down")
+            self._emit_request_record(req, "failed")
         _metrics.DEFAULT_REGISTRY.register_callback(
             "serve_llm", lambda: "")
         return self.kv.close()

@@ -10,6 +10,20 @@ from __future__ import annotations
 import threading
 from typing import Any, Dict, Optional
 
+from ray_tpu.util import request_recorder as _rr
+from ray_tpu.util import tracing as _tracing
+
+
+def _req_attrs(ctx: Optional[dict]) -> Dict[str, Any]:
+    """Span attrs carrying the request's flow id — to_chrome stitches
+    the handle's producer span to this replica's consumer span (and the
+    engine's prefill span) by the shared ``flow_id``."""
+    if not ctx:
+        return {}
+    return {"req_id": ctx["req_id"],
+            "flow_id": f"req:{ctx['req_id']}",
+            "deployment": ctx.get("deployment", "")}
+
 
 class Replica:
     def __init__(self, func_or_class: Any, init_args: tuple,
@@ -33,20 +47,25 @@ class Replica:
             self._asgi_app = resolve_app(marker, self._callable)
             self._run_lifespan_startup()
 
-    def handle_request(self, method: str, args: tuple, kwargs: dict) -> Any:
+    def handle_request(self, method: str, args: tuple, kwargs: dict,
+                       ctx: Optional[dict] = None) -> Any:
         with self._lock:
             self._ongoing += 1
             self._total += 1
         try:
-            if self._is_function:
-                return self._callable(*args, **kwargs)
-            return getattr(self._callable, method)(*args, **kwargs)
+            with _rr.serving(ctx), \
+                    _tracing.span("replica.handle_request",
+                                  kind="consumer", attrs=_req_attrs(ctx)):
+                if self._is_function:
+                    return self._callable(*args, **kwargs)
+                return getattr(self._callable, method)(*args, **kwargs)
         finally:
             with self._lock:
                 self._ongoing -= 1
 
     def handle_request_streaming(self, method: str, args: tuple,
-                                 kwargs: dict):
+                                 kwargs: dict,
+                                 ctx: Optional[dict] = None):
         """Generator variant: called with num_returns="streaming" so each
         yielded chunk ships to the caller as it is produced (reference:
         replica.py handle_request_streaming over the generator task
@@ -56,14 +75,21 @@ class Replica:
             self._ongoing += 1
             self._total += 1
         try:
-            if self._is_function:
-                result = self._callable(*args, **kwargs)
-            else:
-                result = getattr(self._callable, method)(*args, **kwargs)
-            if hasattr(result, "__next__"):
-                yield from result
-            else:
-                yield result
+            # serving(ctx) spans the WHOLE stream: user generators run
+            # lazily inside the yield-from, so engine submit() (which
+            # reads request_recorder.current()) happens in this region
+            with _rr.serving(ctx), \
+                    _tracing.span("replica.handle_request_streaming",
+                                  kind="consumer", attrs=_req_attrs(ctx)):
+                if self._is_function:
+                    result = self._callable(*args, **kwargs)
+                else:
+                    result = getattr(self._callable, method)(*args,
+                                                             **kwargs)
+                if hasattr(result, "__next__"):
+                    yield from result
+                else:
+                    yield result
         finally:
             with self._lock:
                 self._ongoing -= 1
@@ -266,6 +292,15 @@ class Replica:
                     out.update(extra)
             except Exception:  # noqa: BLE001 — a bad user callable must
                 pass           # not break liveness polling
+        # request-recorder summary (this replica's in-memory ring of
+        # engine records): TTFT/TPOT/attribution ride the same poll —
+        # `ray_tpu top` aggregates these across replicas
+        try:
+            rs = _rr.summary()
+            if rs.get("n"):
+                out["request_summary"] = rs
+        except Exception:  # noqa: BLE001
+            pass
         return out
 
     def reconfigure(self, user_config: Dict) -> None:

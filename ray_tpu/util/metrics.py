@@ -37,19 +37,34 @@ class _Registry:
             self._callbacks[name] = fn
 
     def prometheus_text(self) -> str:
+        # Assembly is all-or-nothing PER SOURCE: a metric or callback
+        # that raises mid-render contributes a `# scrape_error` comment
+        # instead of a torn chunk (e.g. histogram `_bucket` rows with no
+        # `_sum`/`_count`), so one bad source can neither take down the
+        # scrape nor corrupt the body for every other source.
         lines: List[str] = []
         with self._lock:
             metrics = list(self._metrics)
             callbacks = list(self._callbacks.items())
         for m in metrics:
+            try:
+                chunk = list(m.samples())
+            except Exception as e:  # noqa: BLE001
+                lines.append(
+                    f'# scrape_error source="{m.name}" '
+                    f'error="{type(e).__name__}"')
+                continue
             lines.append(f"# HELP {m.name} {m.description}")
             lines.append(f"# TYPE {m.name} {m.prom_type}")
-            lines.extend(m.samples())
+            lines.extend(chunk)
         for name, fn in callbacks:
             try:
                 chunk = fn()
-            except Exception:  # noqa: BLE001 — one bad source must not
-                continue       # take down the whole scrape
+            except Exception as e:  # noqa: BLE001
+                lines.append(
+                    f'# scrape_error source="{name}" '
+                    f'error="{type(e).__name__}"')
+                continue
             if chunk:
                 lines.append(chunk.rstrip("\n"))
         return "\n".join(lines) + "\n"

@@ -7,10 +7,12 @@ event on its owner's row, spanning SUBMITTED → FINISHED/FAILED.
 
 `unified_timeline` additionally merges the tracing plane's span shards
 (submit/execute spans, channel write→read hops with cross-process flow
-arrows) and the flight recorder's per-step records into ONE Chrome
-trace — the `ray_tpu timeline --unified` view: task rows from the GCS,
-span rows per process, a "train-step" row per training process, all on
-the same wall clock.
+arrows), the flight recorder's per-step records, and the request
+recorder's per-request records into ONE Chrome trace — the
+`ray_tpu timeline --unified` view: task rows from the GCS, span rows
+per process, a "train-step" row per training process, a
+"serve-request" row per serving process (handle→replica→engine arrows
+stitched by `flow_id="req:<id>"`), all on the same wall clock.
 """
 
 from __future__ import annotations
@@ -64,7 +66,7 @@ def unified_timeline(filename: Optional[str] = None,
     skips the task table (`include_tasks=False` or a connection error),
     an empty trace dir contributes nothing — whatever telemetry exists
     lands in the one file."""
-    from ray_tpu.util import step_profiler, tracing
+    from ray_tpu.util import request_recorder, step_profiler, tracing
 
     events: list = []
     if include_tasks:
@@ -76,6 +78,8 @@ def unified_timeline(filename: Optional[str] = None,
     events.extend(tracing.to_chrome(spans))
     steps = step_profiler.collect(trace_dir)
     events.extend(step_profiler.to_chrome(steps))
+    requests = request_recorder.collect(trace_dir)
+    events.extend(request_recorder.to_chrome(requests))
     events.sort(key=lambda e: e.get("ts", 0))
     if filename:
         with open(filename, "w") as f:

@@ -626,6 +626,25 @@ def test_chaos_llm_replica_kill_midstream():
             timeout=120)
         assert tokens == rerun  # the failed-over stream lost nothing
 
+        # flight-recorder regression (ISSUE 12): the failed-over stream
+        # produced exactly ONE client record (the resubmit's temporary
+        # response is neutered), the survivor-replayed chunks are
+        # counted but never timed, and TPOT is averaged over delivered-
+        # token gaps only — the recovery gap is excluded, so every
+        # timed gap carries the 50 ms per-chunk delay.
+        from ray_tpu.util import request_recorder as rr
+
+        fo = [r for r in rr.ring().recent()
+              if r.role == "client" and r.outcome == "failed_over"]
+        assert len(fo) == 1
+        crec = fo[0]
+        assert crec.tokens_out == n_tokens
+        assert crec.replayed_tokens >= 4  # >= chunks delivered pre-kill
+        # one untimed first chunk per stream half: pre-kill k chunks
+        # give k-1 gaps, post-failover (n-k) chunks give n-k-1 gaps
+        assert crec.attrs["timed_gaps"] == n_tokens - 2
+        assert crec.tpot_ms is not None and crec.tpot_ms >= 40.0
+
         # reconcile notices the death and reclaims the dead arena
         deadline = time.monotonic() + 60
         while time.monotonic() < deadline:

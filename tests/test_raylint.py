@@ -799,6 +799,74 @@ class TestMetricInHotLoop:
 
 
 # ---------------------------------------------------------------------------
+# span-leak: manually-opened spans must close on exception paths
+# ---------------------------------------------------------------------------
+
+class TestSpanLeak:
+    def test_happy_path_close_flagged(self):
+        findings = run("""
+            from ray_tpu.util import tracing
+
+            def handle(req):
+                s = tracing.start_span("serve.request")
+                do_work(req)
+                s.end()
+        """)
+        assert any(f.check == "span-leak" and f.detail == "span:s"
+                   and f.scope == "handle"
+                   and "happy path" in f.message
+                   for f in findings), findings
+
+    def test_manual_enter_never_closed_flagged(self):
+        findings = run("""
+            from ray_tpu.util.tracing import span
+
+            class Router:
+                def choose(self, req):
+                    s = span("router.choose").__enter__()
+                    return self.pick(req)
+        """)
+        assert any(f.check == "span-leak"
+                   and f.scope == "Router.choose"
+                   and "never closed" in f.message
+                   for f in findings), findings
+
+    def test_finally_close_ok(self):
+        findings = run("""
+            from ray_tpu.util import tracing
+
+            def handle(req):
+                s = tracing.start_span("serve.request")
+                try:
+                    do_work(req)
+                finally:
+                    s.end()
+        """)
+        assert "span-leak" not in checks_of(findings)
+
+    def test_with_span_ok(self):
+        findings = run("""
+            from ray_tpu.util import tracing
+
+            def handle(req):
+                with tracing.span("serve.request"):
+                    do_work(req)
+        """)
+        assert "span-leak" not in checks_of(findings)
+
+    def test_suppression_comment(self):
+        findings = run("""
+            from ray_tpu.util import tracing
+
+            def handle(req):
+                s = tracing.start_span("x")  # raylint: disable=span-leak
+                do_work(req)
+                s.end()
+        """)
+        assert "span-leak" not in checks_of(findings)
+
+
+# ---------------------------------------------------------------------------
 # jit-purity over the AOT-cache stagers (compiled_step / fold_steps)
 # ---------------------------------------------------------------------------
 

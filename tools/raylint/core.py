@@ -65,6 +65,15 @@ analysis gates"):
     ``register*``/``start*``/``main``), or a scrape-time text callback
     (``DEFAULT_REGISTRY.register_callback``) which constructs nothing.
 
+``span-leak``
+    Flags tracing spans opened manually — ``s = start_span(...)`` or
+    ``s = span(...).__enter__()`` — whose close (``s.__exit__`` /
+    ``s.end()`` / ``s.close()`` / ``s.finish()``) is not guaranteed on
+    exception paths: an exception between open and close leaks the span
+    (its end timestamp never lands, and a contextvar-parented span
+    poisons every span opened after it on that thread). Sanctioned
+    forms: ``with span(...)``, or closing in a ``finally:`` block.
+
 Suppression: append ``# raylint: disable=<check>`` (or ``disable=all``)
 to the flagged line, or put it on a comment line directly above.
 """
@@ -78,7 +87,8 @@ import re
 from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
 CHECKS = ("lock-discipline", "blocking-under-lock", "jit-purity",
-          "seeded-rng", "jit-cache-stability", "metric-in-hot-loop")
+          "seeded-rng", "jit-cache-stability", "metric-in-hot-loop",
+          "span-leak")
 
 _LOCKISH_NAME = re.compile(r"lock|mutex|cond", re.IGNORECASE)
 _LOCK_FACTORIES = {
@@ -1100,6 +1110,101 @@ def check_metric_in_hot_loop(ctx: ModuleContext) -> List[Finding]:
     return findings
 
 
+# span-closing method names (span-leak)
+_SPAN_CLOSERS = {"__exit__", "end", "close", "finish"}
+
+
+def _is_span_open_call(call: ast.Call) -> bool:
+    """True when `call` manually opens a tracing span: a
+    ``start_span(...)`` call (any holder), or a span contextmanager
+    entered by hand — ``span(...).__enter__()`` /
+    ``submit_span(...).__enter__()``."""
+    name = dotted(call.func)
+    if name and name.split(".")[-1] == "start_span":
+        return True
+    if isinstance(call.func, ast.Attribute) and \
+            call.func.attr == "__enter__" and \
+            isinstance(call.func.value, ast.Call):
+        inner = dotted(call.func.value.func)
+        return bool(inner) and inner.split(".")[-1] in (
+            "span", "submit_span", "execute_span")
+    return False
+
+
+def check_span_leak(ctx: ModuleContext) -> List[Finding]:
+    """Flag manually-opened spans not guaranteed to close on exception
+    paths. A span bound by ``s = start_span(...)`` (or
+    ``span(...).__enter__()``) must reach its ``__exit__``/``end``/
+    ``close``/``finish`` through a ``finally:`` block — straight-line
+    closes run only on the happy path, so any exception in between
+    leaks the span (no end timestamp; a contextvar-parented span also
+    mis-parents every later span on the thread). ``with span(...)`` is
+    the sanctioned form."""
+    findings: List[Finding] = []
+
+    def scan_function(func: ast.AST, scope: str) -> None:
+        opens: List[Tuple[str, int]] = []
+        closes: Dict[str, List[bool]] = {}
+
+        def visit(node: ast.AST, in_finally: bool) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                return  # nested scopes are scanned as their own funcs
+            if isinstance(node, ast.Try):
+                for n in node.body + node.orelse:
+                    visit(n, in_finally)
+                for h in node.handlers:
+                    for n in h.body:
+                        visit(n, in_finally)
+                for n in node.finalbody:
+                    visit(n, True)
+                return
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call) and \
+                    _is_span_open_call(node.value):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        opens.append((t.id, node.lineno))
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in _SPAN_CLOSERS and \
+                    isinstance(node.func.value, ast.Name):
+                closes.setdefault(node.func.value.id,
+                                  []).append(in_finally)
+            for child in ast.iter_child_nodes(node):
+                visit(child, in_finally)
+
+        for stmt in getattr(func, "body", []):
+            visit(stmt, False)
+        for var, lineno in opens:
+            close_sites = closes.get(var, [])
+            if any(close_sites):
+                continue
+            why = ("its close runs only on the happy path — an "
+                   "exception in between leaks the span"
+                   if close_sites else "it is never closed")
+            findings.append(Finding(
+                ctx.relpath, "span-leak", scope, f"span:{var}", lineno,
+                f"span `{var}` is opened manually and {why}; close it "
+                f"in a `finally:` block or use `with span(...)`"))
+
+    def walk_scopes(node: ast.AST, classname: Optional[str]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                walk_scopes(child, child.name)
+            elif isinstance(child, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                scope = (f"{classname}.{child.name}" if classname
+                         else child.name)
+                scan_function(child, scope)
+                walk_scopes(child, None)
+            else:
+                walk_scopes(child, classname)
+
+    walk_scopes(ctx.tree, None)
+    return findings
+
+
 # ---------------------------------------------------------------------------
 # driver
 # ---------------------------------------------------------------------------
@@ -1111,6 +1216,7 @@ _CHECKERS = {
     "seeded-rng": check_seeded_rng,
     "jit-cache-stability": check_jit_cache_stability,
     "metric-in-hot-loop": check_metric_in_hot_loop,
+    "span-leak": check_span_leak,
 }
 
 
