@@ -17,6 +17,7 @@ _lockdep.init_from_env()
 from ray_tpu._private.core_worker import (
     ActorDiedError,
     GetTimeoutError,
+    ObjectLostError,
     ObjectRefGenerator,
     OutOfMemoryError,
     RayTaskError,
@@ -57,6 +58,7 @@ __all__ = [
     "ActorHandle",
     "GetTimeoutError",
     "NodeAffinitySchedulingStrategy",
+    "ObjectLostError",
     "ObjectRef",
     "ObjectRefGenerator",
     "OutOfMemoryError",

@@ -80,7 +80,16 @@ class Config:
     actor_max_restarts_default: int = 0
     task_max_retries_default: int = 3
     # Lineage: max bytes of task specs retained by an owner for reconstruction.
+    # Also settable as RAY_TPU_LINEAGE_MAX_BYTES (alias).
     max_lineage_bytes: int = 1024**3
+    # Deepest chain of missing upstream inputs a single reconstruction
+    # will recursively re-submit before giving up with ObjectLostError
+    # (reference: lineage depth bound in task_manager resubmit).
+    lineage_max_depth: int = 100
+    # Per producing task: how many times its lost returns may be
+    # re-executed before the owner marks them unreconstructable
+    # (reference: max_retries semantics on object recovery).
+    max_object_reconstructions: int = 3
 
     # --- rpc ---
     rpc_connect_timeout_s: float = 10.0
@@ -111,11 +120,24 @@ class Config:
                 setattr(self, key, value)
         return self
 
+    # Alternate env spellings: RAY_TPU_<alias> -> field. The canonical
+    # RAY_TPU_<FIELD_NAME> form always works; aliases exist where the
+    # documented knob name differs from the field (wins over the
+    # canonical spelling when both are set).
+    _ENV_ALIASES = {
+        "LINEAGE_MAX_BYTES": "max_lineage_bytes",
+        "LINEAGE_MAX_DEPTH": "lineage_max_depth",
+    }
+
     @classmethod
     def from_env(cls) -> "Config":
         cfg = cls()
+        alias_for = {v: k for k, v in cls._ENV_ALIASES.items()}
         for f in fields(cls):
             env = os.environ.get(_ENV_PREFIX + f.name.upper())
+            alias = alias_for.get(f.name)
+            if alias is not None:
+                env = os.environ.get(_ENV_PREFIX + alias, env)
             if env is not None:
                 if f.type in ("int", int):
                     setattr(cfg, f.name, int(env))

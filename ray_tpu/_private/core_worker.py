@@ -98,6 +98,13 @@ class GetTimeoutError(Exception):
     pass
 
 
+class ObjectLostError(RayTaskError):
+    """Every copy of an object is gone and it cannot be reconstructed
+    (reference: ray.exceptions.ObjectLostError). The message names the
+    lost object and, when known, the lineage that died with it — a get()
+    on such an object fails NOW instead of blocking to its timeout."""
+
+
 class _MemoryStore:
     """In-process store for in-band results + object status (owner side)."""
 
@@ -330,7 +337,50 @@ class CoreWorker:
         self._key_states: Dict[tuple, _KeyState] = {}
         self._actor_clients: Dict[bytes, dict] = {}  # actor state cache
         self._actor_events: Dict[bytes, asyncio.Event] = {}
+        # --- ownership plane (reference: reference_count.h) ---
+        # _ref_lock is REENTRANT: ObjectRef.__del__ fires via the cycle
+        # collector during any allocation — including while this same
+        # thread already holds the lock — and deregister_ref must not
+        # deadlock against ourselves. Discipline: mutate and decide
+        # under the lock, act (RPC, enqueue) outside it.
+        self._ref_lock = threading.RLock()
         self._local_refs: Dict[bytes, int] = {}
+        # oid -> in-flight submitted tasks carrying the oid as an arg: a
+        # caller that drops its handle right after `.remote()` must not
+        # free an object the task still needs.
+        self._task_arg_refs: Dict[bytes, int] = {}
+        # Owner side: oid -> worker addresses that borrowed the ref
+        # (deserialized it inside a task they execute). The object stays
+        # alive until every borrower reports release (the reference's
+        # WaitForRefRemoved protocol, inverted to borrower-push).
+        self._borrowers: Dict[bytes, set] = {}
+        # Borrower side: oid -> owner addr for refs this process holds
+        # but does not own; the last local deref notifies the owner.
+        self._borrowed_refs: Dict[bytes, str] = {}
+        # Return-value handoffs: return oid -> [(nested oid, owner)]
+        # for ObjectRefs pickled inside a task's return. Each pair
+        # holds a _task_arg_refs count until the RETURN object itself
+        # is released — the serialized reply "contains" the ref, so it
+        # must keep the object alive even if this process never
+        # deserializes a handle.
+        self._contained_refs: Dict[bytes, List[tuple]] = {}
+        # Producing task id -> reconstruction attempts consumed
+        # (bounded by config.max_object_reconstructions).
+        self._reconstruction_attempts: Dict[bytes, int] = {}
+        # Oids whose lineage was evicted past max_lineage_bytes: a loss
+        # is then permanent and the ObjectLostError should say why.
+        self._lineage_evicted: set = set()
+        # Owned plasma objects freed on refcount zero; consulted so a
+        # late borrower status query errors instead of hanging.
+        self._freed_objects: set = set()
+        # recovery-plane counters (exported via the "ownership" metrics
+        # callback; loop-thread writes, so plain ints suffice)
+        self._stats_reconstructions = 0
+        self._stats_reconstruction_failures = 0
+        self._stats_reconstruction_depth_max = 0
+        self._stats_lineage_evictions = 0
+        self._stats_objects_freed = 0
+        self._stats_borrower_notifies = 0
         # Owner-side streaming-generator state, keyed by the producing
         # task id (reference: StreamingGeneratorState in task_manager.h).
         self._streams: Dict[bytes, dict] = {}
@@ -404,7 +454,50 @@ class CoreWorker:
         self._loop_thread.start()
         self._run_sync(self._start_async())
         set_core_worker(self)
+        try:
+            from ray_tpu.util.metrics import DEFAULT_REGISTRY
+            DEFAULT_REGISTRY.register_callback(
+                "ownership", self._ownership_metrics_text)
+        except Exception:  # noqa: BLE001 — observability only
+            pass
         return self
+
+    def _ownership_metrics_text(self) -> str:
+        """Ownership/recovery plane for /metrics (keyed callback — one
+        CoreWorker per process, re-registration replaces)."""
+        if self.memory_store is None:
+            return ""
+        with self._ref_lock:
+            owned = len(self._local_refs)
+            borrowed = len(self._borrowed_refs)
+            task_args = len(self._task_arg_refs)
+            borrower_edges = sum(
+                len(v) for v in self._borrowers.values())
+        rows = [
+            ("ray_tpu_owned_refs", "gauge", owned),
+            ("ray_tpu_borrowed_refs", "gauge", borrowed),
+            ("ray_tpu_task_arg_refs", "gauge", task_args),
+            ("ray_tpu_borrower_edges", "gauge", borrower_edges),
+            ("ray_tpu_lineage_bytes", "gauge", self._lineage_bytes),
+            ("ray_tpu_lineage_tasks", "gauge", len(self._lineage)),
+            ("ray_tpu_lineage_evictions_total", "counter",
+             self._stats_lineage_evictions),
+            ("ray_tpu_reconstructions_total", "counter",
+             self._stats_reconstructions),
+            ("ray_tpu_reconstruction_failures_total", "counter",
+             self._stats_reconstruction_failures),
+            ("ray_tpu_reconstruction_depth_max", "gauge",
+             self._stats_reconstruction_depth_max),
+            ("ray_tpu_objects_freed_total", "counter",
+             self._stats_objects_freed),
+            ("ray_tpu_borrower_notifies_total", "counter",
+             self._stats_borrower_notifies),
+        ]
+        out = []
+        for name, kind, value in rows:
+            out.append(f"# TYPE {name} {kind}")
+            out.append(f"{name} {value}")
+        return "\n".join(out) + "\n"
 
     async def _start_async(self):
         self.memory_store = _MemoryStore(self._loop)
@@ -414,6 +507,10 @@ class CoreWorker:
         self.gcs = ReconnectingClient(self._clients, self.gcs_addr)
         await self.gcs.call("subscribe",
                             {"channel": "actors", "addr": self._server.address})
+        # node-death notices drive owner-side location invalidation and
+        # lineage reconstruction (drivers AND workers own objects)
+        await self.gcs.call("subscribe",
+                            {"channel": "nodes", "addr": self._server.address})
         self._event_flush_task = asyncio.ensure_future(
             self._event_flush_loop())
 
@@ -481,43 +578,199 @@ class CoreWorker:
     # reference registry (local refcounts; reference: reference_count.h)
     # ------------------------------------------------------------------
 
+    def _ref_gone(self, oid: bytes) -> bool:
+        """Owner side, caller holds _ref_lock: nothing keeps oid alive —
+        no local handle, no in-flight task argument, no borrower."""
+        return (self._local_refs.get(oid, 0) <= 0
+                and self._task_arg_refs.get(oid, 0) <= 0
+                and not self._borrowers.get(oid))
+
     def register_ref(self, ref: ObjectRef):
-        self._local_refs[ref.binary()] = self._local_refs.get(ref.binary(), 0) + 1
+        oid = ref.binary()
+        borrow_from = None
+        with self._ref_lock:
+            self._local_refs[oid] = self._local_refs.get(oid, 0) + 1
+            if (ref.owner_addr not in ("", self.address)
+                    and oid not in self._borrowed_refs):
+                # first handle to a ref this process does not own:
+                # record the borrow and tell the owner, which keeps the
+                # object alive until we report release. (The notify is
+                # async; the submitted-task ref the owner holds until
+                # our task's terminal reply covers the in-flight gap.)
+                self._borrowed_refs[oid] = ref.owner_addr
+                borrow_from = ref.owner_addr
+        if borrow_from is not None and not self._shutdown:
+            try:
+                self._submit_enqueue("add_borrower", (oid, borrow_from))
+            except RuntimeError:
+                pass  # loop already closed at interpreter teardown
 
     def deregister_ref(self, ref: ObjectRef):
         oid = ref.binary()
-        n = self._local_refs.get(oid, 0) - 1
-        if n <= 0:
+        action = None  # decided under the lock, performed outside it
+        with self._ref_lock:
+            n = self._local_refs.get(oid, 0) - 1
+            if n > 0:
+                self._local_refs[oid] = n
+                return
             self._local_refs.pop(oid, None)
-            # last local ref gone: release the primary-copy pin and any
-            # lineage retained for this object (owner side). Posted
-            # unconditionally — the reply that records the pin may still
-            # be in flight on the loop thread, so gating on "is a pin
-            # recorded yet" here would race it (the reply side re-checks
-            # the refcount after recording to cover the other order).
-            if not self._shutdown:
-                try:
-                    # rides the submit buffer: a release between two
-                    # `.remote()` calls shares their loop wakeup instead
-                    # of paying its own
-                    self._submit_enqueue("release", oid)
-                except RuntimeError:
-                    pass  # loop already closed at interpreter teardown
-        else:
-            self._local_refs[oid] = n
+            owner = self._borrowed_refs.get(oid)
+            if owner is not None:
+                # borrower side: release the borrow only when no
+                # submitted task of OURS still carries the ref either
+                if self._task_arg_refs.get(oid, 0) <= 0:
+                    self._borrowed_refs.pop(oid, None)
+                    action = ("remove_borrower", (oid, owner))
+            elif self._ref_gone(oid):
+                # owner side: last holder gone. Posted unconditionally —
+                # the reply that records the pin may still be in flight
+                # on the loop thread, so gating on "is a pin recorded
+                # yet" here would race it (the reply side re-checks the
+                # refcount after recording to cover the other order).
+                action = ("release", oid)
+        if action is not None and not self._shutdown:
+            try:
+                # rides the submit buffer: a release between two
+                # `.remote()` calls shares their loop wakeup instead
+                # of paying its own
+                self._submit_enqueue(*action)
+            except RuntimeError:
+                pass  # loop already closed at interpreter teardown
+
+    def _retain_args(self, spec: task_mod.TaskSpec):
+        """Pin every by-reference argument for the submitted task's
+        lifetime (reference: the submitted-task reference count in
+        reference_count.h). Released at the terminal reply/error."""
+        deps = spec.plasma_deps()
+        if not deps:
+            return
+        with self._ref_lock:
+            for oid, _owner in deps:
+                self._task_arg_refs[oid] = \
+                    self._task_arg_refs.get(oid, 0) + 1
+
+    def _release_args(self, spec: task_mod.TaskSpec):
+        """Terminal reply/error (loop thread): drop the submitted-task
+        pins taken by _retain_args and free whatever hit zero."""
+        deps = spec.plasma_deps()
+        if not deps:
+            return
+        actions = []
+        with self._ref_lock:
+            for oid, _owner in deps:
+                n = self._task_arg_refs.get(oid, 0) - 1
+                if n > 0:
+                    self._task_arg_refs[oid] = n
+                    continue
+                self._task_arg_refs.pop(oid, None)
+                owner = self._borrowed_refs.get(oid)
+                if owner is not None:
+                    if self._local_refs.get(oid, 0) <= 0:
+                        self._borrowed_refs.pop(oid, None)
+                        actions.append(("remove_borrower", (oid, owner)))
+                elif self._ref_gone(oid):
+                    actions.append(("release", oid))
+        for kind, payload in actions:
+            if kind == "release":
+                self._on_ref_released(payload)
+            else:
+                asyncio.ensure_future(
+                    self._notify_borrow(payload[1], "remove_borrower",
+                                        payload[0]))
+
+    def _release_contained(self, ret_oid: bytes):
+        """The return object died: drop the holds its serialized reply
+        took on the ObjectRefs pickled inside it (mirrors _release_args
+        — same _task_arg_refs accounting, same release verdicts)."""
+        with self._ref_lock:
+            pairs = self._contained_refs.pop(ret_oid, None)
+        if not pairs:
+            return
+        actions = []
+        with self._ref_lock:
+            for oid, owner in pairs:
+                n = self._task_arg_refs.get(oid, 0) - 1
+                if n > 0:
+                    self._task_arg_refs[oid] = n
+                    continue
+                self._task_arg_refs.pop(oid, None)
+                b_owner = self._borrowed_refs.get(oid)
+                if b_owner is not None:
+                    if self._local_refs.get(oid, 0) <= 0:
+                        self._borrowed_refs.pop(oid, None)
+                        actions.append(("remove_borrower", oid, b_owner))
+                else:
+                    # we own the nested ref: the handoff registered OUR
+                    # address in our own borrower set (pinning it against
+                    # the executor's racing task-end remove_borrower) —
+                    # clear that self-borrow before the zero check
+                    s = self._borrowers.get(oid)
+                    if s is not None:
+                        s.discard(self.address)
+                        if not s:
+                            self._borrowers.pop(oid, None)
+                    if self._ref_gone(oid):
+                        actions.append(("release", oid, None))
+        for kind, oid, owner in actions:
+            if kind == "release":
+                self._on_ref_released(oid)
+            else:
+                asyncio.ensure_future(
+                    self._notify_borrow(owner, "remove_borrower", oid))
 
     def _on_ref_released(self, oid: bytes):
+        """Loop thread, owner side: refcount hit zero — free the object
+        everywhere (primary-copy unpin WITH store deletion, owner books,
+        lineage) instead of leaving it to eviction pressure."""
+        with self._ref_lock:
+            # re-check: a borrower or a fresh submission may have taken
+            # a reference while this release rode the submit buffer
+            if not self._ref_gone(oid):
+                return
+            self._lineage_evicted.discard(oid)
+        # a dying return drops the holds on refs its reply contained
+        self._release_contained(oid)
         addr = self._pinned_at.pop(oid, None)
         if addr is not None:
-            asyncio.ensure_future(self._unpin_at(oid, addr))
+            asyncio.ensure_future(self._unpin_at(oid, addr, free=True))
+            self._stats_objects_freed += 1
+        # a late borrower status query must error, not hang forever on
+        # books we just emptied (bounded: blown away wholesale rather
+        # than pay per-entry tracking)
+        if len(self._freed_objects) > 65536:
+            self._freed_objects.clear()
+        self._freed_objects.add(oid)
+        mem = self.memory_store
+        if mem is not None:
+            mem.values.pop(oid, None)
+            mem.errors.pop(oid, None)
+            mem.locations.pop(oid, None)
+            mem._events.pop(oid, None)
         task_id = self._lineage_oids.pop(oid, None)
         if task_id is not None and task_id in self._lineage:
             spec, size, oids = self._lineage[task_id]
             if not any(o in self._lineage_oids for o in oids):
                 self._lineage.pop(task_id, None)
                 self._lineage_bytes -= size
+                self._reconstruction_attempts.pop(task_id, None)
 
-    async def _unpin_at(self, oid: bytes, addr: str):
+    async def _notify_borrow(self, owner_addr: str, method: str,
+                             oid: bytes, addr: str | None = None):
+        """Borrower -> owner ref-count edge (add_borrower at first
+        handle, remove_borrower at last deref). `addr` overrides the
+        registered borrower — the return-value handoff registers the
+        CALLER, not the executing worker."""
+        self._stats_borrower_notifies += 1
+        try:
+            owner = await self._clients.get(owner_addr)
+            await owner.call(method, {
+                "object_id": oid, "addr": addr or self.address,
+            }, timeout=30.0)
+        except (ConnectionLost, RpcError, OSError,
+                asyncio.TimeoutError):
+            pass  # owner gone: its ref books died with it
+
+    async def _unpin_at(self, oid: bytes, addr: str, free: bool = False):
         # never let an unpin overtake its (async) pin — the raylet
         # would drop the unpin as unknown and the pin would then leak
         pending = self._pending_pins.get(oid)
@@ -525,7 +778,11 @@ class CoreWorker:
             await pending
         try:
             raylet = await self._clients.get(addr)
-            await raylet.notify("unpin_object", {"object_id": oid})
+            # free=True: the owner's distributed refcount hit zero — the
+            # raylet should delete the store copy outright (refcount
+            # permitting), not merely make it evictable
+            await raylet.notify("unpin_object",
+                                {"object_id": oid, "free": free})
         except (ConnectionLost, RpcError, OSError):
             pass  # raylet gone — nothing left to unpin
 
@@ -552,28 +809,79 @@ class CoreWorker:
         self._lineage_bytes += size
         while self._lineage_bytes > self.config.max_lineage_bytes \
                 and self._lineage:
-            _, (old_spec, old_size, old_oids) = \
+            evicted_tid, (old_spec, old_size, old_oids) = \
                 self._lineage.popitem(last=False)
             self._lineage_bytes -= old_size
+            self._stats_lineage_evictions += 1
+            self._reconstruction_attempts.pop(evicted_tid, None)
             for o in old_oids:
-                self._lineage_oids.pop(o, None)
+                if self._lineage_oids.pop(o, None) is not None:
+                    # loss of this object is now permanent — remember
+                    # why, so its ObjectLostError can say so
+                    with self._ref_lock:
+                        self._lineage_evicted.add(o)
 
-    async def _reconstruct(self, oid: bytes) -> bool:
+    def _fail_lost_object(self, oid: bytes, reason: str | None = None):
+        """Fail fast: every waiter on a lost, unreconstructable object
+        sees ObjectLostError NOW instead of blocking to its timeout."""
+        if reason is None:
+            if oid in self._lineage_evicted:
+                reason = ("its lineage was evicted past "
+                          "max_lineage_bytes, so the producing task "
+                          "cannot be re-executed")
+            else:
+                reason = ("it has no lineage to re-execute (ray.put "
+                          "data, actor-method returns and streaming "
+                          "items are not reconstructable)")
+        self._stats_reconstruction_failures += 1
+        self.memory_store.put_error(oid, serialization.dumps(
+            ObjectLostError(
+                f"object {oid.hex()[:12]} lost: all copies are gone "
+                f"and {reason}")))
+
+    async def _reconstruct(self, oid: bytes, depth: int = 0) -> bool:
         """Re-execute the task that created a lost object (reference:
-        TaskManager::ResubmitTask + ObjectRecoveryManager). Dedupes
-        concurrent recoveries of the same task; resolves when the
-        re-execution's reply lands (repopulating locations + pins)."""
+        TaskManager::ResubmitTask + ObjectRecoveryManager), recursively
+        recovering missing upstream inputs first. Dedupes concurrent
+        recoveries of the same task; resolves when the re-execution's
+        reply lands (repopulating locations + pins). Bounded two ways:
+        lineage_max_depth on the recursive chain and
+        max_object_reconstructions per producing task."""
         task_id = self._lineage_oids.get(oid)
         if task_id is None or task_id not in self._lineage:
+            self._fail_lost_object(oid)
+            return False
+        if depth > self.config.lineage_max_depth:
+            self._fail_lost_object(
+                oid,
+                f"its lineage chain is deeper than lineage_max_depth="
+                f"{self.config.lineage_max_depth}")
             return False
         fut = self._reconstructing.get(task_id)
         if fut is None:
             spec, _, oids = self._lineage[task_id]
+            attempts = self._reconstruction_attempts.get(task_id, 0)
+            if attempts >= self.config.max_object_reconstructions:
+                self._fail_lost_object(
+                    oid,
+                    f"task {spec.name or task_id.hex()[:12]} was "
+                    f"already re-executed {attempts}x "
+                    f"(max_object_reconstructions)")
+                return False
+            self._reconstruction_attempts[task_id] = attempts + 1
+            # hex()[:12] is only the sha1 prefix shared by every task a
+            # submitter mints — include the counter bytes or concurrent
+            # recoveries all log as "the same" task
             logger.warning(
-                "object %s lost — re-executing task %s (%s)",
-                oid.hex()[:12], task_id.hex()[:12], spec.name)
+                "object %s lost — re-executing task %s (%s), "
+                "attempt %d, depth %d",
+                oid.hex()[:26], task_id.hex()[:26], spec.name,
+                attempts + 1, depth)
             fut = self._loop.create_future()
             self._reconstructing[task_id] = fut
+            self._stats_reconstructions += 1
+            self._stats_reconstruction_depth_max = max(
+                self._stats_reconstruction_depth_max, depth + 1)
             mem = self.memory_store
             for roid in oids:
                 # clear each sibling's readiness properly: the event must
@@ -585,9 +893,41 @@ class CoreWorker:
                 pinned = self._pinned_at.pop(roid, None)
                 if pinned is not None:
                     asyncio.ensure_future(self._unpin_at(roid, pinned))
+            # Recover missing upstream inputs FIRST: the re-executed
+            # task would otherwise hang pulling a dependency whose only
+            # copy died on the same node.
+            for dep_oid, dep_owner in spec.plasma_deps():
+                if dep_owner not in ("", self.address):
+                    continue  # borrowed input: its own owner recovers it
+                if dep_oid in mem.values or dep_oid in mem.errors \
+                        or mem.locations.get(dep_oid):
+                    continue
+                if not await self._reconstruct(dep_oid, depth + 1):
+                    # upstream unreconstructable: this task's returns
+                    # are lost too — fail them with the lineage chain
+                    self._reconstructing.pop(task_id, None)
+                    if not fut.done():
+                        fut.set_result(False)
+                    self._stats_reconstruction_failures += 1
+                    frame = serialization.dumps(ObjectLostError(
+                        f"object {oid.hex()[:12]} lost: its producing "
+                        f"task {spec.name or task_id.hex()[:12]} "
+                        f"depends on upstream object "
+                        f"{dep_oid.hex()[:12]}, which is itself lost "
+                        f"and unreconstructable (lineage chain: "
+                        f"{spec.name or '?'} <- {dep_oid.hex()[:12]})"))
+                    for roid in oids:
+                        mem.put_error(roid, frame)
+                    return False
+            # the re-execution's terminal reply releases arg pins like
+            # any submission — take them afresh
+            self._retain_args(spec)
+            if spec.node_id is not None:
+                # a task pinned to the dead node must be free to move
+                spec.soft = True
             self._enqueue_task(spec)
         await fut
-        return True
+        return bool(fut.result())
 
     async def rpc_report_lost_location(self, req):
         """A raylet failed to fetch from a location we advertised: if the
@@ -619,10 +959,7 @@ class CoreWorker:
             else:
                 # unrecoverable: fail every waiter fast instead of
                 # letting status queries block to their timeouts
-                self.memory_store.put_error(oid, serialization.dumps(
-                    RayTaskError(
-                        f"object {oid.hex()[:12]} lost: all copies gone "
-                        "and no lineage to re-execute")))
+                self._fail_lost_object(oid)
         return {"ok": True}
 
     # ------------------------------------------------------------------
@@ -875,6 +1212,7 @@ class CoreWorker:
                 return None
             return max(0.0, deadline - self._loop.time())
 
+        pull_failures = 0
         while True:
             if oid in mem.errors:
                 return self._error_from_frame(mem.errors[oid])
@@ -886,7 +1224,26 @@ class CoreWorker:
                     return serialization.deserialize(buf)
             if oid in mem.locations:
                 # Object lives in remote plasma: ask local raylet to pull it.
-                await self._pull_via_raylet(ref)
+                try:
+                    await self._pull_via_raylet(ref)
+                except (ConnectionLost, RpcError, OSError):
+                    # The owner may have declared the object lost while
+                    # the pull was in flight (node death swept it):
+                    # prefer its verdict — an ObjectLostError naming the
+                    # lineage — over the transport error. Owned objects
+                    # surface it from mem.errors on the next pass;
+                    # borrowed refs drop the stale locations and
+                    # re-query the owner, bounded so a persistently
+                    # failing pull still raises.
+                    if owner_is_self:
+                        if oid not in mem.errors and oid not in mem.values:
+                            raise
+                    else:
+                        pull_failures += 1
+                        if pull_failures >= 3:
+                            raise
+                        for addr in list(mem.locations.get(oid, [])):
+                            mem.drop_location(oid, addr)
                 continue
             if owner_is_self:
                 try:
@@ -1064,17 +1421,18 @@ class CoreWorker:
 
     def _serialize_args(self, args, kwargs):
         """Returns (wire_args, wire_kwargs, nested_refs) — nested_refs is
-        True when any by-value payload pickled an ObjectRef buried inside a
-        container. Such specs must not join multi-task actor batches (see
-        `_actor_enqueue`) even though their top-level entries are all
-        by-value."""
-        nested = [False]  # local, not self.<attr>: submits are multi-thread
+        the (oid, owner_addr) list of every ObjectRef a by-value payload
+        pickled buried inside a container. Such specs must not join
+        multi-task actor batches (see `_actor_enqueue`) even though their
+        top-level entries are all by-value, and the owner pins the nested
+        refs for the task's lifetime exactly like top-level ref args."""
+        nested = []  # local, not self.<attr>: submits are multi-thread
         wire_args = []
         for a in args:
             wire_args.append(self._serialize_arg(a, nested))
         wire_kwargs = {k: self._serialize_arg(v, nested)
                        for k, v in (kwargs or {}).items()}
-        return wire_args, wire_kwargs, nested[0]
+        return wire_args, wire_kwargs, nested
 
     def _serialize_arg(self, value, nested=None):
         if isinstance(value, ObjectRef):
@@ -1085,9 +1443,10 @@ class CoreWorker:
             if oid in mem.values:
                 return ["v", mem.values[oid]]
             return ["r", oid, value.owner_addr or self.address]
-        payload, saw_ref = serialization.dumps_with_ref_flag(value)
-        if saw_ref and nested is not None:
-            nested[0] = True
+        payload, refs = serialization.dumps_with_ref_flag(value)
+        if refs and nested is not None:
+            nested.extend(
+                (r.binary(), r.owner_addr or self.address) for r in refs)
         return ["v", payload]
 
     @staticmethod
@@ -1208,6 +1567,9 @@ class CoreWorker:
             if parent is not None:
                 self._task_children.setdefault(parent, []).append(
                     spec.task_id)
+        # pin by-ref args for the task's lifetime BEFORE the enqueue —
+        # the caller may drop its handles the moment `.remote()` returns
+        self._retain_args(spec)
         if streaming:
             # plain dict insert; ordered before the task via the same
             # submit-buffer flush the enqueue rides on
@@ -1642,7 +2004,27 @@ class CoreWorker:
             spec.task_id, spec.name, spec.task_type,
             "FAILED" if reply.get("error") else "FINISHED")
         self._cancelled_tasks.pop(spec.task_id, None)  # terminal
+        self._release_args(spec)  # drop the submitted-task arg pins
         mem = self.memory_store
+        # Return values carrying ObjectRefs: the executor registered us
+        # as borrower of each before replying; hold them until the
+        # return object itself dies (the serialized reply contains the
+        # ref whether or not we ever deserialize a handle).
+        for ret_oid, pairs in reply.get("ref_handoffs", []):
+            with self._ref_lock:
+                for oid, owner in pairs:
+                    self._task_arg_refs[oid] = \
+                        self._task_arg_refs.get(oid, 0) + 1
+                    if owner != self.address \
+                            and oid not in self._borrowed_refs:
+                        self._borrowed_refs[oid] = owner
+                self._contained_refs.setdefault(ret_oid, []).extend(
+                    [tuple(p) for p in pairs])
+                gone = self._ref_gone(ret_oid)
+            if gone:
+                # the return's handle died before the reply landed —
+                # nothing will ever trigger the containment release
+                self._release_contained(ret_oid)
         plasma_oids: List[bytes] = []
         for entry in reply.get("returns", []):
             oid, kind, payload = entry
@@ -1654,25 +2036,27 @@ class CoreWorker:
                 mem.add_location(oid, payload)
                 plasma_oids.append(oid)
                 # the executor pinned the return at its raylet before
-                # replying — record the mapping (or release right away
-                # if the caller already dropped every ref)
-                if self._local_refs.get(oid, 0) > 0:
+                # replying — record the mapping only while someone still
+                # holds a reference (decide under the ref lock, act on
+                # the verdict outside it; a deref racing the record
+                # enqueues a release that re-checks and unpins)
+                with self._ref_lock:
+                    referenced = not self._ref_gone(oid)
+                if referenced:
                     self._pinned_at[oid] = payload
-                    if self._local_refs.get(oid, 0) <= 0:
-                        # the last ref died between the check and the
-                        # record — its release callback saw no pin, so
-                        # clean up here (idempotent with that callback)
-                        self._on_ref_released(oid)
                 else:
-                    asyncio.ensure_future(self._unpin_at(oid, payload))
+                    asyncio.ensure_future(
+                        self._unpin_at(oid, payload, free=True))
         if plasma_oids:
             self._retain_lineage(spec, plasma_oids)
             for oid in plasma_oids:
-                if self._local_refs.get(oid, 0) <= 0:
+                with self._ref_lock:
+                    gone = self._ref_gone(oid)
+                if gone:
                     self._on_ref_released(oid)  # ref died pre-reply
         fut = self._reconstructing.pop(spec.task_id, None)
         if fut is not None and not fut.done():
-            fut.set_result(True)
+            fut.set_result(not reply.get("error"))
         if spec.streaming:
             # the final reply closes the stream; pre-execution failures
             # arrive as an error entry instead of item reports
@@ -1687,6 +2071,7 @@ class CoreWorker:
         self._emit_task_event(spec.task_id, spec.name, spec.task_type,
                               "FAILED")
         self._cancelled_tasks.pop(spec.task_id, None)  # terminal
+        self._release_args(spec)
         fut = self._reconstructing.pop(spec.task_id, None)
         if fut is not None and not fut.done():
             fut.set_result(False)
@@ -1803,6 +2188,7 @@ class CoreWorker:
             concurrency_group=concurrency_group,
         )
         spec._nested_refs = nested_refs
+        self._retain_args(spec)
         if streaming:
             self._make_stream(spec.task_id)
             self._submit_enqueue("actor", spec)
@@ -1852,6 +2238,10 @@ class CoreWorker:
                 self._enqueue_task(spec)
             elif kind == "actor":
                 self._actor_enqueue(spec, batches)
+            elif kind in ("add_borrower", "remove_borrower"):
+                # spec is (oid, owner_addr) — borrower-side ref edge
+                asyncio.ensure_future(
+                    self._notify_borrow(spec[1], kind, spec[0]))
             else:  # "release": spec is the released object id
                 self._on_ref_released(spec)
         for entry in batches.values():
@@ -2138,6 +2528,15 @@ class CoreWorker:
     async def rpc_get_object_status(self, req):
         oid = req["object_id"]
         mem = self.memory_store
+        if oid in self._freed_objects and not mem.ready(oid):
+            # freed on refcount zero: a borrower whose add_borrower
+            # lost the race with the final deref must error out now —
+            # waiting would hang forever on books we emptied
+            return {"status": "err", "value": serialization.dumps(
+                ObjectLostError(
+                    f"object {oid.hex()[:12]} was freed by its owner "
+                    "(refcount reached zero before this borrow was "
+                    "registered)"))}
         if req.get("wait") and not mem.ready(oid):
             if self.store is not None and self.store.contains(ObjectID(oid)):
                 mem.add_location(oid, self.raylet_addr)
@@ -2165,7 +2564,70 @@ class CoreWorker:
             ev = self._actor_events.get(actor_id)
             if ev is not None:
                 ev.set()
+        elif msg["channel"] == "nodes":
+            data = msg["data"]
+            if data.get("event") == "removed":
+                await self._on_node_removed(data)
         return None
+
+    async def _on_node_removed(self, data: dict):
+        """GCS death notice: invalidate every advertised location on the
+        dead node and recover — or fail fast — owned objects whose last
+        copy died with it (reference: ObjectRecoveryManager's node-death
+        path). Runs on the io loop, so the location scan is atomic with
+        respect to reply processing."""
+        dead_addr = data.get("raylet_addr", "")
+        if not dead_addr:
+            return  # pre-recovery GCS build: notice carries no address
+        # dead peers leave the client pool so reconnect backoff cannot
+        # stall lease rerouting; mark_dead makes any later dial (a
+        # lease spilled back to the victim by a raylet that hasn't seen
+        # the death yet, an unpin, a status probe) fail fast instead of
+        # burning a full connect timeout against a black hole
+        self._clients.invalidate(dead_addr)
+        self._clients.mark_dead(dead_addr)
+        mem = self.memory_store
+        lost: List[bytes] = []
+        for oid in list(mem.locations.keys()):
+            locs = mem.locations.get(oid)
+            if not locs or dead_addr not in locs:
+                continue
+            mem.drop_location(oid, dead_addr)
+            if oid not in mem.locations and oid not in mem.values \
+                    and oid not in mem.errors:
+                lost.append(oid)
+        for oid, addr in list(self._pinned_at.items()):
+            if addr == dead_addr:
+                # the pin died with the raylet holding it
+                self._pinned_at.pop(oid, None)
+        for oid in lost:
+            if oid in self._lineage_oids:
+                asyncio.ensure_future(self._reconstruct(oid))
+            else:
+                self._fail_lost_object(oid)
+
+    async def rpc_add_borrower(self, req):
+        """A worker deserialized a ref we own: hold the object until it
+        reports release (reference: the borrower half of
+        WaitForRefRemoved, inverted to borrower-push)."""
+        oid = req["object_id"]
+        with self._ref_lock:
+            self._borrowers.setdefault(oid, set()).add(req["addr"])
+        return {"ok": True}
+
+    async def rpc_remove_borrower(self, req):
+        oid = req["object_id"]
+        release = False
+        with self._ref_lock:
+            s = self._borrowers.get(oid)
+            if s is not None:
+                s.discard(req["addr"])
+                if not s:
+                    self._borrowers.pop(oid, None)
+            release = self._ref_gone(oid)
+        if release:
+            self._on_ref_released(oid)
+        return {"ok": True}
 
     async def rpc_exit_worker(self, req):
         logger.info("exit requested: %s", req.get("reason"))
@@ -2908,16 +3370,59 @@ class CoreWorker:
                     f"expected {spec.num_returns}"
                 )
         returns = []
+        handoffs = []
         for i, value in enumerate(results):
             oid = ObjectID.for_task_return(TaskID(spec.task_id), i)
-            sv = serialization.serialize_value(value)
+            sv, nested = serialization.serialize_value_with_refs(value)
+            if nested:
+                handoffs.append([
+                    oid.binary(),
+                    self._handoff_nested_refs(nested, spec.owner_addr)])
             if sv.size <= self.config.max_direct_call_object_size or \
                     self.store is None:
                 returns.append([oid.binary(), "v", sv.to_bytes()])
             else:
                 self._plasma_put_pinned(oid, sv)
                 returns.append([oid.binary(), "plasma", self.raylet_addr])
-        return {"returns": returns}
+        out = {"returns": returns}
+        if handoffs:
+            out["ref_handoffs"] = handoffs
+        return out
+
+    def _handoff_nested_refs(self, refs: list, caller_addr: str) -> list:
+        """A return value carries ObjectRefs (executor thread): register
+        the CALLER as a borrower with each ref's owner BEFORE the reply
+        ships. Without this, the owner can free the object in the window
+        between this task's locals dying (our borrow releases) and the
+        caller deserializing its copy (its borrow registers) — the
+        handoff makes the transfer of the reference atomic with the
+        reply. Returns [(oid, owner_addr)] for the reply's
+        `ref_handoffs` entry; the caller holds each pair until the
+        return object itself is released."""
+        pairs = []
+        for r in refs:
+            oid = r.binary()
+            owner = r.owner_addr or self.address
+            pairs.append([oid, owner])
+            if owner == self.address:
+                # we own it — the caller's borrow is one set-add away,
+                # and our live handle (inside the return value) keeps
+                # the refcount nonzero until this line runs
+                with self._ref_lock:
+                    self._borrowers.setdefault(oid, set()).add(caller_addr)
+            else:
+                # registered synchronously so the reply cannot overtake
+                # it; covers owner == caller too (an object riding back
+                # to its owner — the entry pins it against a racing
+                # remove_borrower from our own task-end cleanup)
+                fut = asyncio.run_coroutine_threadsafe(
+                    self._notify_borrow(owner, "add_borrower", oid,
+                                        addr=caller_addr), self._loop)
+                try:
+                    fut.result(timeout=30.0)
+                except Exception:  # noqa: BLE001 — owner gone
+                    pass
+        return pairs
 
     def _package_error(self, spec: task_mod.TaskSpec, exc: Exception) -> dict:
         tb = traceback.format_exc()

@@ -70,6 +70,10 @@ repeatable (``at=…;at=…``) or ``|``-separated inside one value. Faults:
   - ``hb_brownout:<dur>`` — drop every GCS heartbeat for ``dur`` seconds
   - ``data_stall:<dur>``  — data-plane block reads stall for ``dur`` s
   - ``ckpt_fail[:<n>]``   — next n checkpoint persists raise ChaosError
+  - ``drop_objects[:<frac>]`` — force-delete a seeded random `frac`
+    (default 0.5) of this node's sealed shm objects WITHOUT killing the
+    process — object loss decoupled from node loss (exercises lineage
+    reconstruction while the raylet keeps serving)
 
 ``@role`` scopes the entry to processes of that role (``driver``,
 ``gcs``, ``raylet``, ``worker``, ``train`` — the last arms at train
@@ -122,7 +126,7 @@ _LOG_CAP = 8192
 _ARM_GRACE_S = 1.0
 
 _TIMED_FAULTS = ("kill", "crash_loop", "hb_brownout", "data_stall",
-                 "ckpt_fail", "quota_flood")
+                 "ckpt_fail", "quota_flood", "drop_objects")
 _ROLES = ("driver", "gcs", "raylet", "worker", "train")
 
 
@@ -187,6 +191,12 @@ def _parse_timed(value: str) -> List[TimedFault]:
             # window seconds; the flood hammers the registered target
             # (object-store puts) for the whole window
             arg = float(parts[2]) if len(parts) > 2 else 5.0
+        elif fault == "drop_objects":
+            # fraction of the node's sealed objects to force-delete
+            arg = float(parts[2]) if len(parts) > 2 else 0.5
+            if not 0.0 < arg <= 1.0:
+                raise ValueError(
+                    f"at: drop_objects fraction {arg} outside (0, 1]")
         else:  # crash_loop / hb_brownout / data_stall need an argument
             if len(parts) < 3:
                 raise ValueError(f"at: {fault} requires an argument")
@@ -506,6 +516,30 @@ class FaultPlan:
         self._record("timed.quota_flood.done",
                      f"puts={puts}:rejects={rejects}")
 
+    # -- object loss (lineage recovery plane) ----------------------------
+
+    def _drop_objects_run(self, frac: float) -> None:
+        """Force-delete a seeded random `frac` of this node's sealed shm
+        objects via the registered target (the raylet's store sweep —
+        see set_drop_objects_target). The process survives: the point is
+        object loss WITHOUT node loss, so lineage reconstruction gets
+        exercised while leases, pulls and heartbeats keep flowing. The
+        subset is drawn from the plan's own per-site stream, so the same
+        seed always drops the same objects."""
+        target = _DROP_TARGET
+        if target is None:
+            self._record("timed.drop_objects", "no-target")
+            return
+        try:
+            dropped = target(frac, self.rng_for("timed.drop_objects"))
+        except Exception:  # noqa: BLE001 — chaos must not kill the raylet
+            logger.exception("chaos: drop_objects sweep failed")
+            self._record("timed.drop_objects", "error")
+            return
+        self._record("timed.drop_objects", f"dropped={dropped}:frac={frac:g}")
+        logger.warning("chaos: drop_objects force-deleted %d sealed objects "
+                       "(frac=%g)", dropped, frac)
+
     # -- timed schedule (wall-clock offsets) -----------------------------
 
     def arm_timed(self, role: str) -> None:
@@ -588,6 +622,9 @@ class FaultPlan:
         if tf.fault == "quota_flood":
             threading.Thread(target=self._quota_flood_run,
                              daemon=True, name="chaos-quota-flood").start()
+        if tf.fault == "drop_objects":
+            threading.Thread(target=self._drop_objects_run, args=(tf.arg,),
+                             daemon=True, name="chaos-drop-objects").start()
         if tf.fault == "kill":
             self.export_artifact()  # atexit never runs past os._exit
             os._exit(1)
@@ -682,6 +719,21 @@ def set_quota_flood_target(fn) -> None:
     QuotaExceededError propagate — the flood loop counts rejections."""
     global _FLOOD_TARGET
     _FLOOD_TARGET = fn
+
+
+# drop_objects victimizer: `fn(frac, rng) -> int` force-deletes a
+# seeded random `frac` of the node's sealed objects and returns the
+# count; registered by the raylet once its store exists.
+_DROP_TARGET = None
+
+
+def set_drop_objects_target(fn) -> None:
+    """Register (or clear, with None) this process's drop_objects
+    target. The callable takes (fraction, random.Random) so the victim
+    subset is a pure function of the plan seed, and returns how many
+    objects it deleted."""
+    global _DROP_TARGET
+    _DROP_TARGET = fn
 
 
 def plan() -> Optional[FaultPlan]:

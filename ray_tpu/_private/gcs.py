@@ -585,7 +585,12 @@ class GcsServer:
             "GCS", "ERROR", "NODE_DEAD",
             f"node {node_id.hex()[:8]} dead: {reason}",
             node_id=node_id.hex(), reason=reason)
+        # raylet_addr rides the notice so owners can invalidate object
+        # locations (keyed by raylet address) without a get_nodes round
+        # trip per death
         await self.publish("nodes", {"event": "removed", "node_id": node_id,
+                                     "raylet_addr": node.get("raylet_addr",
+                                                             ""),
                                      "reason": reason})
         async with self._resources_pub_lock:
             await self.publish("resources", {"node_id": node_id,

@@ -309,6 +309,49 @@ class ObjectStore:
             return 0
         return self._lib.ss_evict(self._h, nbytes)
 
+    # -- ownership GC / recovery plane ------------------------------------
+
+    def set_primary(self, object_id: ObjectID, flag: bool = True) -> bool:
+        """Mark (or clear) the primary-copy location hint. The raylet
+        sets it when it pins an object as the authoritative copy for an
+        owner; replicas pulled from peers stay unmarked. Advisory: loss
+        sweeps and the drop_objects chaos fault use it to tell primary
+        data from caches. Returns False when the object is absent."""
+        if self._lib is None or self._h < 0:
+            return False
+        return self._lib.ss_set_primary(
+            self._h, object_id.binary(), 1 if flag else 0) == SS_OK
+
+    def is_primary(self, object_id: ObjectID) -> bool:
+        if self._lib is None or self._h < 0:
+            return False
+        return self._lib.ss_is_primary(self._h, object_id.binary()) == 1
+
+    def refcount(self, object_id: ObjectID) -> int:
+        """Client reference count of a stored object (creator + live
+        buffer views), or -1 when absent. The owner's free-on-zero path
+        checks this before force-delete: yanking a slot with mapped
+        views alive would corrupt zero-copy readers."""
+        if self._lib is None or self._h < 0:
+            return -1
+        rc = self._lib.ss_refcount(self._h, object_id.binary())
+        return -1 if rc < 0 else int(rc)
+
+    def list_sealed(self, max_objects: int = 65536) -> list:
+        """Sealed objects as (ObjectID, primary, referenced) rows — a
+        per-shard-consistent snapshot for chaos sweeps and loss
+        accounting."""
+        if self._lib is None or self._h < 0:
+            return []
+        ids = (ctypes.c_uint8 * (max_objects * 16))()
+        flags = (ctypes.c_uint8 * max_objects)()
+        n = self._lib.ss_list_sealed(self._h, ids, flags, max_objects)
+        out = []
+        for i in range(max(n, 0)):
+            oid = ObjectID(bytes(ids[i * 16:(i + 1) * 16]))
+            out.append((oid, bool(flags[i] & 1), bool(flags[i] & 2)))
+        return out
+
     # -- per-job accounting (multi-tenant quota plane) --------------------
 
     def set_job_quota(self, job_id_binary: bytes, quota_bytes: int,

@@ -194,12 +194,26 @@ class Raylet:
         # update (guards the heartbeat-reply prune against racing a
         # just-registered node's seed publish)
         self._view_push_ts: Dict[bytes, float] = {}
+        # Raylet addresses the GCS has declared dead (resources-channel
+        # dead publish). A pull must not spend a full connect timeout
+        # discovering what the control plane already knows — known-dead
+        # holders are reported to the owner immediately instead of
+        # dialed. The owner's GCS-backed aliveness check is the
+        # authority: a still_alive verdict un-poisons the entry.
+        self._dead_addrs: Dict[str, float] = {}
         self._actor_workers: Dict[bytes, bytes] = {}  # worker_id -> actor_id
         # Memory-monitor kill records: owners query these to turn a
         # generic "worker died" into an actionable OutOfMemoryError
         # (reference: worker_killing_policy.h surfaces the policy's
         # reasoning in the task error).
         self._exit_reasons_by_addr: Dict[str, str] = {}
+        # ownership-GC / recovery accounting
+        self._objects_freed = 0   # owner refcount-zero deletions
+        self._objects_dropped = 0  # chaos drop_objects force-deletes
+        # drop_objects@raylet chaos victimizer: force-delete a seeded
+        # subset of this node's sealed objects without killing the
+        # process (silent object loss, as distinct from node death)
+        _fi.set_drop_objects_target(self._chaos_drop_objects)
 
     # ------------------------------------------------------------------
 
@@ -227,6 +241,10 @@ class Raylet:
             f"raylet_workers {len(self._workers)}",
             f"raylet_pinned_objects {len(self._pinned)}",
             f"raylet_spilled_objects {len(self._spilled)}",
+            "# TYPE raylet_objects_freed_total counter",
+            f"raylet_objects_freed_total {self._objects_freed}",
+            "# TYPE raylet_objects_dropped_total counter",
+            f"raylet_objects_dropped_total {self._objects_dropped}",
             f"object_store_capacity_bytes {stats['capacity']}",
             f"object_store_allocated_bytes {stats['allocated']}",
             f"object_store_num_objects {stats['num_objects']}",
@@ -669,6 +687,14 @@ class Raylet:
             if d.get("node_id") == self.node_id.binary():
                 return None  # our own state is authoritative locally
             if d.get("dead"):
+                gone = self.view.nodes.get(d["node_id"])
+                if gone is not None \
+                        and gone.raylet_addr != self.server.address:
+                    if len(self._dead_addrs) >= 256:
+                        self._dead_addrs.pop(next(iter(self._dead_addrs)))
+                    self._dead_addrs[gone.raylet_addr] = time.monotonic()
+                    self.clients.invalidate(gone.raylet_addr)
+                    self.clients.mark_dead(gone.raylet_addr)
                 self.view.remove_node(d["node_id"])
                 self._view_push_ts.pop(d["node_id"], None)
             else:
@@ -1388,12 +1414,19 @@ class Raylet:
                 continue
             fetched = False
             for addr in locations:
-                try:
-                    fetched = await self._fetch_remote_chunked(
-                        object_id, addr)
-                except (ConnectionLost, RpcError, OSError,
-                        RuntimeError):
+                if addr in self._dead_addrs:
+                    # GCS already declared this holder dead: skip the
+                    # dial (a cold connect costs the full
+                    # rpc_connect_timeout_s) and go straight to the
+                    # lost-location report so the owner reconstructs
                     fetched = False
+                else:
+                    try:
+                        fetched = await self._fetch_remote_chunked(
+                            object_id, addr)
+                    except (ConnectionLost, RpcError, OSError,
+                            RuntimeError):
+                        fetched = False
                 if fetched:
                     await owner.notify("add_object_location", {
                         "object_id": object_id.binary(),
@@ -1410,6 +1443,7 @@ class Raylet:
                     # the GCS hasn't pruned yet (prune takes ~period ×
                     # threshold). Back off long enough that the attempt
                     # budget comfortably spans that window.
+                    self._dead_addrs.pop(addr, None)
                     await asyncio.sleep(1.0)
             if fetched:
                 return
@@ -1640,18 +1674,59 @@ class Raylet:
         # holding the buffer holds the store refcount; LRU only evicts
         # refcount-zero objects
         self._pinned[req["object_id"]] = buf
+        # primary-copy hint in the slot itself: loss sweeps and the
+        # drop_objects chaos fault can tell authoritative copies from
+        # pulled replicas without consulting this process's dicts
+        self.store.set_primary(oid, True)
         return {"ok": True}
 
     async def rpc_unpin_object(self, req):
         oid = req["object_id"]
-        self._pinned.pop(oid, None)
+        buf = self._pinned.pop(oid, None)
         rec = self._spilled.pop(oid, None)
         if rec is not None:
             try:
                 os.unlink(rec[0])
             except OSError:
                 pass
+        if req.get("free"):
+            # the owner's distributed refcount hit zero: delete the shm
+            # copy outright instead of waiting for eviction pressure.
+            # Drop OUR buffer reference first, then only force-delete a
+            # refcount-zero slot — yanking a slot while a reader still
+            # maps it would corrupt zero-copy views.
+            del buf
+            object_id = ObjectID(oid)
+            if self.store.refcount(object_id) == 0:
+                self.store.delete(object_id)
+                self._objects_freed += 1
         return {"ok": True}
+
+    def _chaos_drop_objects(self, frac: float, rng) -> int:
+        """Timed-fault target (fault_injection `drop_objects[:<frac>]`):
+        force-delete a seeded random subset of this node's sealed
+        objects, pins included, WITHOUT killing the process — models
+        silent object loss (arena corruption, operator fat-finger) as
+        distinct from whole-node death. Runs on the chaos timer thread;
+        dict ops are GIL-atomic and the store delete is shard-locked."""
+        rows = self.store.list_sealed()
+        if not rows:
+            return 0
+        k = max(1, int(len(rows) * frac))
+        chosen = rng.sample(rows, min(k, len(rows)))
+        dropped = 0
+        for oid, _primary, _referenced in chosen:
+            key = oid.binary()
+            # drop our pin's buffer reference first — the point is to
+            # lose primary copies, and a pinned slot is refcounted
+            self._pinned.pop(key, None)
+            if self.store.refcount(oid) > 0:
+                continue  # a live reader maps the slot: yanking it
+                # would corrupt a zero-copy view, not simulate loss
+            self.store.delete(oid)
+            dropped += 1
+        self._objects_dropped += dropped
+        return dropped
 
     async def rpc_spill_objects(self, req):
         """A local worker's plasma create failed: make room by spilling

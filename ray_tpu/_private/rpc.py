@@ -30,8 +30,9 @@ from __future__ import annotations
 import asyncio
 import itertools
 import logging
+import time
 import traceback
-from typing import Any, Awaitable, Callable, Dict, List, Optional
+from typing import Any, Awaitable, Callable, Dict, List, Optional, Tuple
 
 import msgpack
 
@@ -566,9 +567,22 @@ class ClientPool:
     """Lazily-created, cached RpcClients keyed by address (reference:
     per-service client pools in `src/ray/rpc/`)."""
 
+    # a failed connect poisons the address briefly: callers queued
+    # behind it — e.g. a raylet draining pulls whose advertised
+    # location just died — fail fast instead of each serializing a
+    # full connect timeout against the same dead peer
+    CONNECT_FAIL_TTL_S = 3.0
+    # a GCS death notice poisons for much longer: the control plane
+    # already decided the peer is gone, so even the FIRST dial (a full
+    # rpc_connect_timeout_s against a black hole) is wasted work. Kept
+    # finite so a pathological address reuse self-heals.
+    DEAD_ADDR_TTL_S = 60.0
+
     def __init__(self):
         self._clients: Dict[str, RpcClient] = {}
         self._locks: Dict[str, asyncio.Lock] = {}
+        # addr -> (poisoned_at, ttl)
+        self._connect_failed_at: Dict[str, Tuple[float, float]] = {}
 
     def get_cached(self, address: str) -> Optional[RpcClient]:
         """Synchronous lookup; None when no live connection exists yet."""
@@ -577,17 +591,46 @@ class ClientPool:
             return client
         return None
 
+    def mark_dead(self, address: str):
+        """Record an authoritative death notice (GCS node-removal):
+        dials within DEAD_ADDR_TTL_S fail fast with ConnectionLost
+        instead of timing out against a peer that no longer exists."""
+        self._connect_failed_at[address] = (
+            time.monotonic(), self.DEAD_ADDR_TTL_S)
+
+    def _check_poisoned(self, address: str):
+        entry = self._connect_failed_at.get(address)
+        if entry is None:
+            return
+        t, ttl = entry
+        age = time.monotonic() - t
+        if age < ttl:
+            raise ConnectionLost(
+                f"connect to {address} failed {age:.1f}s ago "
+                f"(fail-fast for {ttl:.0f}s)")
+        self._connect_failed_at.pop(address, None)
+
     async def get(self, address: str) -> RpcClient:
         client = self.get_cached(address)
         if client is not None:
             return client
+        self._check_poisoned(address)
         lock = self._locks.setdefault(address, asyncio.Lock())
         async with lock:
             client = self.get_cached(address)
             if client is not None:
                 return client
+            # re-check under the lock: the head of the queue may have
+            # just recorded the failure the rest were waiting on
+            self._check_poisoned(address)
             client = RpcClient(address)
-            await client.connect()
+            try:
+                await client.connect()
+            except (OSError, asyncio.TimeoutError):
+                self._connect_failed_at[address] = (
+                    time.monotonic(), self.CONNECT_FAIL_TTL_S)
+                raise
+            self._connect_failed_at.pop(address, None)
             self._clients[address] = client
             return client
 
@@ -602,5 +645,6 @@ class ClientPool:
         # connect locks too (the dict grows forever on a churning pool)
         clients, self._clients = list(self._clients.values()), {}
         self._locks.clear()
+        self._connect_failed_at.clear()
         for client in clients:
             await client.close()

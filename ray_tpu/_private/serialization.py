@@ -45,16 +45,20 @@ class _Pickler(cloudpickle.CloudPickler):
         super().__init__(
             file, protocol=5, buffer_callback=lambda b: buffers.append(b.raw())
         )
-        # Whether an ObjectRef was pickled anywhere inside the value.
-        # The submit path uses this to keep specs whose args *contain*
-        # refs (even nested in containers) out of multi-task actor
-        # batches — resolving such a ref may need an earlier in-batch
-        # task's withheld reply (deadlock).
+        # ObjectRefs pickled anywhere inside the value (nested in
+        # containers included). The submit path uses them two ways: a
+        # non-empty list keeps the spec out of multi-task actor batches
+        # (resolving such a ref may need an earlier in-batch task's
+        # withheld reply — deadlock), and the owner pins each one for
+        # the task's lifetime so dropping the caller's handle cannot
+        # free an object the task still needs.
+        self.object_refs: List[ObjectRef] = []
         self.saw_object_ref = False
 
     def reducer_override(self, obj):
         if type(obj) is ObjectRef:
             self.saw_object_ref = True
+            self.object_refs.append(obj)
         ser = _custom_serializers.get(type(obj))
         if ser is not None:
             serializer, deserializer = ser
@@ -121,10 +125,19 @@ def serialize_value(value: Any) -> SerializedValue:
     """Pickle `value` capturing out-of-band buffers, copying nothing
     large: the pickle stream stays a view of the pickler's buffer and
     the oob buffers stay views of the caller's arrays."""
+    return serialize_value_with_refs(value)[0]
+
+
+def serialize_value_with_refs(
+        value: Any) -> tuple[SerializedValue, List[ObjectRef]]:
+    """`serialize_value` plus every ObjectRef pickled anywhere inside
+    `value` — the executor's return path must know them to hand the
+    borrows off to the caller before its own handles die."""
     buffers: List[memoryview] = []
     f = io.BytesIO()
-    _Pickler(f, buffers).dump(value)
-    return SerializedValue(f.getbuffer(), buffers)
+    p = _Pickler(f, buffers)
+    p.dump(value)
+    return SerializedValue(f.getbuffer(), buffers), p.object_refs
 
 
 def serialize_into(dst: memoryview, value: Any) -> int:
@@ -140,14 +153,15 @@ def serialize_into(dst: memoryview, value: Any) -> int:
     return sv.write_into(dst)
 
 
-def dumps_with_ref_flag(value: Any) -> tuple[bytes, bool]:
-    """Like `dumps`, additionally reporting whether any ObjectRef was
-    pickled anywhere inside `value` (nested in containers included)."""
+def dumps_with_ref_flag(value: Any) -> tuple[bytes, list]:
+    """Like `dumps`, additionally returning every ObjectRef pickled
+    anywhere inside `value` (nested in containers included) — truthy
+    exactly when the old boolean flag was."""
     buffers: List[memoryview] = []
     f = io.BytesIO()
     p = _Pickler(f, buffers)
     p.dump(value)
-    return pack(f.getvalue(), buffers), p.saw_object_ref
+    return pack(f.getvalue(), buffers), p.object_refs
 
 
 def serialized_size(pickled: bytes, buffers: Sequence[memoryview]) -> int:

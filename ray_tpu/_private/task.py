@@ -79,8 +79,10 @@ class TaskSpec:
     concurrency_group: str = ""
     # Submitter-local only (never on the wire; must stay the LAST field
     # so `from_wire`'s positional splat fills exactly the wire fields):
-    # the nested ObjectRefs found while serializing args (truthy ⇒ the
-    # spec must not ride a multi-task batch — see CoreWorker._batchable).
+    # the nested ObjectRefs found while serializing args, as
+    # (object_id, owner_addr) pairs. Truthy ⇒ the spec must not ride a
+    # multi-task batch (see CoreWorker._batchable); the pairs join
+    # plasma_deps() so the owner pins them for the task's lifetime.
     _nested_refs: Any = False
 
     # Positional wire encoding: a flat msgpack array in field order.
@@ -118,7 +120,10 @@ class TaskSpec:
         return cls(*wire)
 
     def plasma_deps(self) -> List[tuple[bytes, str]]:
-        """(object_id, owner_addr) for every by-reference arg."""
+        """(object_id, owner_addr) for every by-reference arg — top-level
+        entries plus (submitter side only) refs nested inside by-value
+        containers. Wire-decoded specs carry no nested list, so executor/
+        raylet callers see just the top-level deps."""
         deps = []
         for entry in self.args:
             if entry[0] == "r":
@@ -126,6 +131,9 @@ class TaskSpec:
         for entry in self.kwargs.values():
             if entry[0] == "r":
                 deps.append((entry[1], entry[2]))
+        if isinstance(self._nested_refs, list):
+            deps.extend(
+                (oid, owner) for oid, owner in self._nested_refs)
         return deps
 
     def scheduling_key(self) -> tuple:

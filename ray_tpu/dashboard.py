@@ -361,6 +361,35 @@ class Dashboard:
 
         app.router.add_get("/api/requests", j(requests_panel))
 
+        def recovery_panel():
+            # ownership/recovery plane: this driver's ref-table and
+            # reconstruction counters (empty when not connected)
+            from ray_tpu._private.object_ref import get_core_worker
+
+            cw = get_core_worker()
+            if cw is None or cw.memory_store is None:
+                return {"connected": False}
+            with cw._ref_lock:
+                return {
+                    "connected": True,
+                    "owned_refs": len(cw._local_refs),
+                    "borrowed_refs": len(cw._borrowed_refs),
+                    "task_arg_refs": len(cw._task_arg_refs),
+                    "borrower_edges": sum(
+                        len(v) for v in cw._borrowers.values()),
+                    "lineage_bytes": cw._lineage_bytes,
+                    "lineage_tasks": len(cw._lineage),
+                    "lineage_evictions": cw._stats_lineage_evictions,
+                    "reconstructions": cw._stats_reconstructions,
+                    "reconstruction_failures":
+                        cw._stats_reconstruction_failures,
+                    "reconstruction_depth_max":
+                        cw._stats_reconstruction_depth_max,
+                    "objects_freed": cw._stats_objects_freed,
+                }
+
+        app.router.add_get("/api/recovery", j(recovery_panel))
+
         loop = asyncio.new_event_loop()
         asyncio.set_event_loop(loop)
         runner = web.AppRunner(app)

@@ -124,6 +124,23 @@ def load_shm_store() -> ctypes.CDLL:
         ctypes.POINTER(ctypes.c_uint64),  # 8-element row
     ]
     lib.ss_shard_stats.restype = ctypes.c_int
+    lib.ss_set_primary.argtypes = [
+        ctypes.c_int,
+        ctypes.c_char_p,
+        ctypes.c_int,  # flag (0 clears)
+    ]
+    lib.ss_set_primary.restype = ctypes.c_int
+    lib.ss_is_primary.argtypes = [ctypes.c_int, ctypes.c_char_p]
+    lib.ss_is_primary.restype = ctypes.c_int
+    lib.ss_refcount.argtypes = [ctypes.c_int, ctypes.c_char_p]
+    lib.ss_refcount.restype = ctypes.c_int64
+    lib.ss_list_sealed.argtypes = [
+        ctypes.c_int,
+        ctypes.POINTER(ctypes.c_uint8),  # ids_out (cap * 16 bytes)
+        ctypes.POINTER(ctypes.c_uint8),  # flags_out (cap bytes)
+        ctypes.c_int,
+    ]
+    lib.ss_list_sealed.restype = ctypes.c_int
     lib.ss_memcpy_mt.argtypes = [
         ctypes.c_void_p,
         ctypes.c_void_p,

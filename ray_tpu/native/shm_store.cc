@@ -55,6 +55,7 @@
 
 #include <cerrno>
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
 #include <ctime>
 #include <thread>
@@ -70,7 +71,10 @@
 namespace {
 
 constexpr uint64_t kMagic = 0x52415953544f5245ULL;  // "RAYSTORE"
-constexpr uint32_t kVersion = 3;  // v3: per-job accounting plane
+constexpr uint32_t kVersion = 4;  // v4: primary-copy hint in Slot::job
+// High bit of Slot::job marks the primary copy (ownership GC's
+// authoritative location); the low 31 bits remain the job row + 1.
+constexpr uint32_t kPrimaryBit = 0x80000000u;
 constexpr uint64_t kAlign = 64;
 constexpr uint32_t kIdSize = 16;
 constexpr uint32_t kMaxShards = 16;
@@ -107,7 +111,12 @@ struct Slot {
   // LRU doubly-linked list (per shard), values are slot_index + 1 (0 = nil).
   uint32_t lru_prev;
   uint32_t lru_next;
-  uint32_t job;         // creator job slot + 1 (0 = untagged); shard-locked
+  // lo 31 bits: creator job slot + 1 (0 = untagged); hi bit: primary-copy
+  // hint (v4) — set by the raylet that pinned this object as the
+  // authoritative copy, cleared on replicas pulled from other nodes.
+  // Shard-locked. The Slot is exactly one cache line with no spare
+  // field, and kMaxJobs=32 needs only 6 bits, so the flag rides here.
+  uint32_t job;
   // hi 32 bits: generation, bumped on every tombstone/reuse; lo 32:
   // refcount. One atomic word so the lock-free release can
   // decrement-iff-same-incarnation with a single CAS.
@@ -302,9 +311,11 @@ int job_slot(Store* s, uint64_t key, bool create) {
 // Charge an object's bytes off its creator job when it leaves the store
 // (delete / abort / eviction). Caller holds the object's shard mutex, so
 // sl->job is stable; the job counters themselves are atomic.
+inline uint32_t job_row_of(const Slot* sl) { return sl->job & ~kPrimaryBit; }
+
 inline void job_uncharge(Store* s, Slot* sl, bool evicted) {
-  if (sl->job == 0) return;
-  JobState* j = &s->hdr->jobs[sl->job - 1];
+  if (job_row_of(sl) == 0) { sl->job = 0; return; }
+  JobState* j = &s->hdr->jobs[job_row_of(sl) - 1];
   __atomic_fetch_sub(&j->used, sl->alloc_size, __ATOMIC_ACQ_REL);
   __atomic_fetch_sub(&j->num_objects, 1, __ATOMIC_ACQ_REL);
   if (evicted)
@@ -605,7 +616,7 @@ uint64_t evict_shard(Store* s, uint32_t shard, uint64_t need,
   while (cur && evicted < need) {
     Slot* sl = &s->slots[cur - 1];
     uint32_t next = sl->lru_prev;
-    if ((job_filter == 0 || sl->job == job_filter) &&
+    if ((job_filter == 0 || job_row_of(sl) == job_filter) &&
         __atomic_load_n(&sl->state, __ATOMIC_RELAXED) == SEALED &&
         (__atomic_load_n(&sl->refgen, __ATOMIC_ACQUIRE) & 0xffffffffULL) ==
             0) {
@@ -682,10 +693,17 @@ int attach_common(const char* name, bool create, uint64_t capacity,
     map_size = static_cast<uint64_t>(st.st_size);
   }
 
-  // MAP_POPULATE on creation pre-faults the whole arena in one kernel
-  // pass: every client write otherwise eats first-touch page faults on
-  // fresh allocations (measured ~25% of large-object put bandwidth).
-  const int mmap_flags = MAP_SHARED | (create ? MAP_POPULATE : 0);
+  // Pre-faulting the whole arena (MAP_POPULATE) trades creation latency
+  // for put bandwidth: every client write otherwise eats first-touch
+  // page faults on fresh allocations (measured ~25% of large-object put
+  // bandwidth). Opt-in via RAY_TPU_STORE_PREFAULT=1: on virtualized
+  // hosts with slow second-stage fault handling a multi-GB populate can
+  // take minutes — longer than the daemon-ready deadline — while lazy
+  // faulting amortizes invisibly across early puts.
+  const char* prefault = getenv("RAY_TPU_STORE_PREFAULT");
+  const bool want_populate =
+      create && prefault && prefault[0] == '1';
+  const int mmap_flags = MAP_SHARED | (want_populate ? MAP_POPULATE : 0);
   void* base = mmap(nullptr, map_size, PROT_READ | PROT_WRITE, mmap_flags,
                     fd, 0);
   close(fd);
@@ -1029,6 +1047,80 @@ int ss_delete(int handle, const uint8_t* id) {
   scrub_tombstones(s, shard, sl);
   sh->num_objects--;
   return SS_OK;
+}
+
+// --- ownership GC / recovery plane (v4) ---
+
+// Set (flag!=0) or clear the primary-copy hint. The hint is advisory
+// location metadata: the raylet marks objects it pinned on behalf of an
+// owner as the authoritative copy; replicas pulled from peers stay
+// unmarked, so loss sweeps and the drop_objects chaos fault can tell
+// "this node held the only copy" from "this node held a cache".
+int ss_set_primary(int handle, const uint8_t* id, int flag) {
+  Store* s = get_store(handle);
+  if (!s) return SS_BAD_HANDLE;
+  uint32_t shard = shard_of(s, id);
+  ShardGuard g(s, shard);
+  Slot* sl = find_slot(s, shard, id);
+  if (!sl) return SS_NOT_FOUND;
+  if (flag)
+    sl->job |= kPrimaryBit;
+  else
+    sl->job &= ~kPrimaryBit;
+  return SS_OK;
+}
+
+// 1 = primary-copy hint set, 0 = not set; SS_NOT_FOUND when absent.
+// Lock-free probe (advisory, like ss_contains).
+int ss_is_primary(int handle, const uint8_t* id) {
+  Store* s = get_store(handle);
+  if (!s) return SS_BAD_HANDLE;
+  Slot* sl = probe_lockfree(s, shard_of(s, id), id);
+  if (!sl) return SS_NOT_FOUND;
+  return (__atomic_load_n(&sl->job, __ATOMIC_RELAXED) & kPrimaryBit) ? 1 : 0;
+}
+
+// Current client reference count of the object (creator + getters with
+// live buffer views), or SS_NOT_FOUND. The owner's GC uses this before
+// a free-on-zero delete: force-deleting while a mapped view is live
+// would yank memory out from under a zero-copy reader.
+int64_t ss_refcount(int handle, const uint8_t* id) {
+  Store* s = get_store(handle);
+  if (!s) return SS_BAD_HANDLE;
+  Slot* sl = probe_lockfree(s, shard_of(s, id), id);
+  if (!sl) return SS_NOT_FOUND;
+  uint64_t rg = __atomic_load_n(&sl->refgen, __ATOMIC_ACQUIRE);
+  if (!id_eq(sl, id)) return SS_NOT_FOUND;
+  return static_cast<int64_t>(rg & 0xffffffffULL);
+}
+
+// Enumerate sealed objects: writes up to `cap` ids (kIdSize bytes each)
+// into `ids_out` and one flag byte per object into `flags_out`
+// (bit0 = primary-copy hint, bit1 = referenced). Returns the count.
+// Walks one shard lock at a time, so the listing is a consistent
+// per-shard snapshot (good enough for chaos sweeps and diagnostics).
+int ss_list_sealed(int handle, uint8_t* ids_out, uint8_t* flags_out,
+                   int cap) {
+  Store* s = get_store(handle);
+  if (!s) return static_cast<int>(SS_BAD_HANDLE);
+  Header* h = s->hdr;
+  int n = 0;
+  for (uint32_t i = 0; i < h->num_shards && n < cap; ++i) {
+    ShardGuard g(s, i);
+    Slot* base = shard_base(s, i);
+    for (uint32_t j = 0; j < h->shard_cap && n < cap; ++j) {
+      Slot* sl = &base[j];
+      if (__atomic_load_n(&sl->state, __ATOMIC_RELAXED) != SEALED) continue;
+      memcpy(ids_out + static_cast<uint64_t>(n) * kIdSize, sl->id, kIdSize);
+      uint8_t flags = 0;
+      if (sl->job & kPrimaryBit) flags |= 1;
+      if ((__atomic_load_n(&sl->refgen, __ATOMIC_RELAXED) & 0xffffffffULL) > 0)
+        flags |= 2;
+      flags_out[n] = flags;
+      ++n;
+    }
+  }
+  return n;
 }
 
 // Evict at least `nbytes` of LRU sealed unreferenced data. Returns evicted.

@@ -19,12 +19,16 @@ blocking-under-lock checker gates this property.
 
 from __future__ import annotations
 
+import logging
 import math
 import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
 import ray_tpu
+from ray_tpu.util import metrics as _metrics
+
+logger = logging.getLogger(__name__)
 from ray_tpu.serve.deployment import AutoscalingConfig, DeploymentConfig
 from ray_tpu.serve.replica import Replica
 
@@ -75,6 +79,9 @@ class ServeController:
         # pages don't leak until eviction pressure
         self._replica_metrics: Dict[int, Dict[str, Any]] = {}
         self._reclaimed_arenas: List[str] = []
+        self._arenas_reclaimed_total = 0
+        _metrics.DEFAULT_REGISTRY.register_callback(
+            "serve_controller", self._metrics_text)
 
     # -- API ---------------------------------------------------------------
 
@@ -279,14 +286,31 @@ class ServeController:
         try:
             from ray_tpu.serve.llm.kv_cache import reclaim_arena
             if reclaim_arena(arena):
+                logger.warning(
+                    "reclaimed KV arena %s from dead replica", arena)
                 with self._lock:
                     self._reclaimed_arenas.append(arena)
+                    self._arenas_reclaimed_total += 1
         except Exception:
             pass
 
     def get_reclaimed_arenas(self) -> List[str]:
         with self._lock:
             return list(self._reclaimed_arenas)
+
+    def _metrics_text(self) -> str:
+        with self._lock:
+            reclaimed = self._arenas_reclaimed_total
+            deployments = len(self._deployments)
+            draining = len(self._draining)
+        return "\n".join([
+            "# TYPE serve_llm_arenas_reclaimed_total counter",
+            f"serve_llm_arenas_reclaimed_total {reclaimed}",
+            "# TYPE serve_controller_deployments gauge",
+            f"serve_controller_deployments {deployments}",
+            "# TYPE serve_controller_draining_replicas gauge",
+            f"serve_controller_draining_replicas {draining}",
+        ]) + "\n"
 
     def _scale_to_target(self, name: str, st: _DeploymentState) -> None:
         """Converge replica count to st.target_replicas. State deltas are

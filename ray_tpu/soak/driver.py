@@ -52,6 +52,7 @@ _FAULT_TEMPLATES = {
     "kill@raylet": "{t}:kill",
     "hb_brownout@gcs": "{t}:hb_brownout:{brownout_s}",
     "crash_loop@raylet": "{t}:crash_loop:2",
+    "drop_objects@raylet": "{t}:drop_objects:{drop_frac}",
 }
 
 
@@ -69,6 +70,7 @@ class SoakConfig:
     fault_warmup_s: float = 6.0
     stall_s: float = 2.0
     brownout_s: float = 3.0
+    drop_frac: float = 0.5               # drop_objects sweep fraction
     # data plane (epoch = rows / num_workers / batch_size = 512 batches
     # at the defaults, so commits land mid-epoch and resume offsets are
     # exercised at non-zero values)
@@ -271,7 +273,8 @@ class SoakDriver:
             role = cls.split("@", 1)[1]
             t = round(lo + slot * (i + rng.uniform(0.1, 0.9)), 1)
             entry = template.format(t=t, stall_s=cfg.stall_s,
-                                    brownout_s=cfg.brownout_s)
+                                    brownout_s=cfg.brownout_s,
+                                    drop_frac=cfg.drop_frac)
             entries.append(f"{entry}@{role}")
         return f"seed={cfg.seed};at=" + "|".join(entries)
 

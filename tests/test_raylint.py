@@ -909,6 +909,73 @@ class TestJitPurityOverCompiledStep:
 
 
 # ---------------------------------------------------------------------------
+# ownership ref-table lock discipline
+# ---------------------------------------------------------------------------
+
+class TestRefTableLockDiscipline:
+    """Pins the ownership plane's ref-table contract (core_worker
+    `_ref_lock`): count mutation and the free decision must happen under
+    one lock hold. A check-then-delete that releases the lock between
+    the read and the write races a concurrent `register_ref` — the
+    classic lost-resurrection bug distributed ref counting must not
+    have."""
+
+    BAD = """
+        import threading
+
+        class RefTable:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._local_refs = {}
+
+            def register(self, oid):
+                with self._lock:
+                    self._local_refs[oid] = \\
+                        self._local_refs.get(oid, 0) + 1
+
+            def deregister(self, oid):
+                with self._lock:
+                    gone = self._local_refs.get(oid, 0) <= 1
+                if gone:
+                    # raced: a register between release and here is lost
+                    self._local_refs.pop(oid, None)
+    """
+
+    GOOD = """
+        import threading
+
+        class RefTable:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._local_refs = {}
+
+            def register(self, oid):
+                with self._lock:
+                    self._local_refs[oid] = \\
+                        self._local_refs.get(oid, 0) + 1
+
+            def deregister(self, oid):
+                with self._lock:
+                    n = self._local_refs.get(oid, 0) - 1
+                    if n <= 0:
+                        self._local_refs.pop(oid, None)
+                    else:
+                        self._local_refs[oid] = n
+    """
+
+    def test_check_then_delete_across_release_flagged(self):
+        findings = run(self.BAD)
+        assert any(f.check == "lock-discipline"
+                   and f.detail == "attr:_local_refs"
+                   and f.scope == "RefTable.deregister"
+                   for f in findings), findings
+
+    def test_mutation_under_one_hold_clean(self):
+        findings = run(self.GOOD)
+        assert "lock-discipline" not in checks_of(findings), findings
+
+
+# ---------------------------------------------------------------------------
 # suppression + baseline
 # ---------------------------------------------------------------------------
 
