@@ -1105,6 +1105,10 @@ class CoreWorker:
         raylet = await self._clients.get(raylet_addr)
         return await raylet.call("list_objects", {}, timeout=30.0)
 
+    async def _store_stats_on(self, raylet_addr: str):
+        raylet = await self._clients.get(raylet_addr)
+        return await raylet.call("get_store_stats", {}, timeout=30.0)
+
     async def _request_spill(self, size: int) -> int:
         try:
             raylet = await self._clients.get(self.raylet_addr)
@@ -2633,9 +2637,6 @@ class CoreWorker:
         logger.info("exit requested: %s", req.get("reason"))
         self._exec_queue.put(None)
         return None
-
-    async def rpc_ping(self, req):
-        return {"ok": True, "worker_id": self.worker_id.binary()}
 
     async def rpc_cancel_task(self, req):
         """Executor side of ray_tpu.cancel (reference: RemoteCancelTask,

@@ -315,7 +315,10 @@ class GcsServer:
             self._metrics_server.close()
         if self.persist_path:
             try:
-                self._write_snapshot()
+                # final snapshot can be tens of MB — write it off-loop
+                # so in-flight replies drain while it lands
+                await asyncio.get_running_loop().run_in_executor(
+                    None, self._write_snapshot)
             except Exception:  # noqa: BLE001
                 logger.exception("final snapshot failed")
         await self.clients.close_all()
@@ -386,7 +389,7 @@ class GcsServer:
                               req["available"],
                               labels=req.get("labels", {}))
         self._last_heartbeat[node_id] = time.monotonic()
-        export_events.report(
+        await export_events.report_async(
             "GCS", "INFO", "NODE_ADDED",
             f"node {node_id.hex()[:8]} joined",
             node_id=node_id.hex(), raylet_addr=req["raylet_addr"])
@@ -581,7 +584,7 @@ class GcsServer:
         node["alive"] = False
         self.view.remove_node(node_id)
         logger.warning("node %s dead: %s", node_id.hex()[:8], reason)
-        export_events.report(
+        await export_events.report_async(
             "GCS", "ERROR", "NODE_DEAD",
             f"node {node_id.hex()[:8]} dead: {reason}",
             node_id=node_id.hex(), reason=reason)
@@ -666,7 +669,7 @@ class GcsServer:
             if info["job_id"] == job_id and not info.get("detached") \
                     and info["state"] != DEAD:
                 await self._kill_actor(actor_id, "job finished")
-        export_events.report(
+        await export_events.report_async(
             "GCS", "INFO", "JOB_FINISHED",
             f"job {job_id.hex()[:8]} finished", job_id=job_id.hex())
         await self.publish("jobs", {"event": "finished", "job_id": job_id})
@@ -842,7 +845,9 @@ class GcsServer:
         cached = getattr(self, "_events_cache", None)
         if cached is not None and now - cached[0] < 2.0:
             return cached[1]
-        out = export_events.list_events()[-500:]
+        merged = await asyncio.get_running_loop().run_in_executor(
+            None, export_events.list_events)
+        out = merged[-500:]
         self._events_cache = (now, out)
         return out
 
@@ -869,7 +874,7 @@ class GcsServer:
             return
         restarts = info["max_restarts"]
         will_restart = restarts == -1 or info["num_restarts"] < restarts
-        export_events.report(
+        await export_events.report_async(
             "GCS", "WARNING",
             "ACTOR_RESTARTING" if will_restart else "ACTOR_DEAD",
             f"actor {actor_id.hex()[:8]} failed: {reason}",
@@ -1058,7 +1063,9 @@ async def main(host: str, port: int, metrics_port=None,
     import signal
 
     _fi.set_role("gcs")  # arm gcs-scoped timed faults (offsets from now)
-    server = GcsServer(host, port, persist_path=persist_path,
+    # snapshot load is one-time startup I/O before the server accepts
+    # its first connection — the loop has nothing else to run yet
+    server = GcsServer(host, port, persist_path=persist_path,  # raylint: disable=async-blocking
                        store_path=store_path)
     await server.start(metrics_port=metrics_port)
     print(f"GCS_READY {server.address}", flush=True)

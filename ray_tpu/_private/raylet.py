@@ -526,12 +526,17 @@ class Raylet:
     async def _memory_monitor_loop(self):
         period = self.config.memory_monitor_refresh_ms / 1000.0
         threshold = self.config.memory_usage_threshold
+        loop = asyncio.get_running_loop()
         while True:
             await asyncio.sleep(period)
-            used, total = self._host_memory_usage()
+            # /proc reads and the kill-selection walk both touch the
+            # filesystem — keep the lease/heartbeat loop responsive
+            used, total = await loop.run_in_executor(
+                None, self._host_memory_usage)
             if used / total <= threshold:
                 continue
-            if self._relieve_memory_pressure(used, total):
+            if await loop.run_in_executor(
+                    None, self._relieve_memory_pressure, used, total):
                 # give the reap loop + OS a cycle to reclaim the victim
                 # before re-evaluating, or one spike kills every worker
                 await asyncio.sleep(max(period, 0.5))
@@ -628,7 +633,7 @@ class Raylet:
     async def _on_worker_death(self, worker: WorkerHandle):
         from ray_tpu.util import events as export_events
 
-        export_events.report(
+        await export_events.report_async(
             "RAYLET", "WARNING", "WORKER_DIED",
             f"worker process {worker.pid} exited",
             worker_id=worker.worker_id.hex(), pid=worker.pid,
@@ -753,7 +758,8 @@ class Raylet:
         log_path = os.path.join(
             log_dir, f"worker-{len(self._workers)}-{os.urandom(3).hex()}.log"
         )
-        logfile = open(log_path, "ab")
+        logfile = await asyncio.get_running_loop().run_in_executor(
+            None, lambda: open(log_path, "ab"))
         proc = await asyncio.create_subprocess_exec(
             python_exe, "-m", "ray_tpu._private.worker_main",
             "--raylet-addr", self.server.address,

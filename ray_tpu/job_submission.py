@@ -124,6 +124,18 @@ class JobSubmissionClient:
                    submission_id: Optional[str] = None,
                    env_vars: Optional[Dict[str, str]] = None) -> str:
         job_id = submission_id or f"job-{uuid.uuid4().hex[:10]}"
+        if submission_id is not None:
+            # reference parity: an explicit submission_id that collides
+            # with a recorded job is a caller error, not a silent
+            # overwrite of the old job's record
+            from ray_tpu._private.worker_api import _require_state
+
+            cw = _require_state().core_worker
+            reply = cw._run_sync(cw.gcs.call("kv_exists", {
+                "ns": _KV_NS, "key": submission_id.encode()}))
+            if reply["exists"]:
+                raise ValueError(
+                    f"job {submission_id!r} was already submitted")
         supervisor_cls = ray_tpu.remote(_JobSupervisor)
         supervisor_cls.options(
             name=f"_job_supervisor_{job_id}",
@@ -162,6 +174,20 @@ class JobSubmissionClient:
                 return status
             time.sleep(0.5)
         raise TimeoutError(f"job {job_id} still {status} after {timeout}s")
+
+    def delete_job(self, job_id: str) -> bool:
+        """Drop a terminal job's KV record (reference SDK verb).
+        Refuses while the job may still be running — stop it first."""
+        from ray_tpu._private.worker_api import _require_state
+
+        status = self.get_job_status(job_id)
+        if status not in (SUCCEEDED, FAILED, STOPPED):
+            raise RuntimeError(
+                f"job {job_id!r} is {status}; stop it before deleting")
+        cw = _require_state().core_worker
+        reply = cw._run_sync(cw.gcs.call("kv_del", {
+            "ns": _KV_NS, "key": job_id.encode()}))
+        return bool(reply["deleted"])
 
     def list_jobs(self) -> List[Dict[str, Any]]:
         from ray_tpu._private.worker_api import _require_state

@@ -13,7 +13,10 @@ _build_lock = threading.Lock()
 
 
 def _build() -> None:
-    subprocess.run(
+    # one-time per process tree: runs only when the .so is missing or
+    # stale, serialized by _build_lock, and every daemon loads the
+    # library during startup — steady state never reaches this
+    subprocess.run(  # raylint: disable=async-blocking
         ["make", "-s", "-C", _DIR],
         check=True,
         capture_output=True,

@@ -210,6 +210,11 @@ def cmd_memory(args):
                   f"{o['where']:8} node {o['node_id'][:12]}")
         total = sum(o["size"] for o in objs)
         print(f"\n{len(objs)} primary copies, {total / 1e6:.1f} MB total")
+        for s in state_api.store_stats():
+            print(f"store {s['node_id'][:12]}: "
+                  f"{s.get('allocated', 0) / 1e6:.1f}"
+                  f"/{s.get('capacity', 0) / 1e6:.1f} MB shm allocated, "
+                  f"{s.get('num_objects', 0)} live objects")
         if len(objs) >= args.limit:
             print(f"WARNING: listing truncated at --limit {args.limit}; "
                   f"totals and top-N understate actual usage")

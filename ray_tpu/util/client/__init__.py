@@ -274,6 +274,14 @@ class ClientContext:
     def available_resources(self) -> Dict[str, float]:
         return self._call("available_resources", {})["resources"]
 
+    def ping(self) -> bool:
+        """Cheap liveness probe of the attached ClientServer — True
+        when the control connection still answers."""
+        try:
+            return bool(self._call("ping", {}, timeout=10.0)["ok"])
+        except Exception:  # noqa: BLE001 — dead link IS the answer
+            return False
+
     def disconnect(self):
         if self._closed:
             return

@@ -79,6 +79,20 @@ def report(source: str, severity: str, label: str, message: str,
     return ev
 
 
+async def report_async(source: str, severity: str, label: str,
+                       message: str, **fields: Any) -> dict:
+    """`report` for async daemons (GCS/raylet handlers): the JSONL
+    append — a lazy open() on the shard's first event plus the write —
+    runs in the default executor so an event at a lifecycle transition
+    never stalls the RPC event loop behind disk latency."""
+    import asyncio
+    import functools
+
+    return await asyncio.get_running_loop().run_in_executor(
+        None, functools.partial(report, source, severity, label,
+                                message, **fields))
+
+
 def list_events(source: Optional[str] = None,
                 severity: Optional[str] = None,
                 label: Optional[str] = None,

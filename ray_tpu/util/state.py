@@ -90,6 +90,25 @@ def list_nodes() -> List[Dict[str, Any]]:
     return ray_tpu.nodes()
 
 
+def store_stats() -> List[Dict[str, Any]]:
+    """Per-node shared-memory store counters (capacity, allocated,
+    object count, eviction/spill pressure) straight from each raylet
+    (reference: `ray memory --stats-only`'s plasma summary)."""
+    cw = _core_worker()
+    nodes = cw._run_sync(cw.gcs.call("get_nodes", {}))
+    out: List[Dict[str, Any]] = []
+    for node in nodes:
+        if not node["alive"]:
+            continue
+        try:
+            s = cw._run_sync(cw._store_stats_on(node["raylet_addr"]))
+        except Exception:  # noqa: BLE001 — node may be going away
+            continue
+        s["node_id"] = node["node_id"].hex()
+        out.append(s)
+    return out
+
+
 def list_objects(limit: int = 1000) -> List[Dict[str, Any]]:
     """Primary copies across the cluster: every raylet's pinned +
     spilled objects (reference: `ray list objects`, which reports

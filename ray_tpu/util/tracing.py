@@ -62,7 +62,10 @@ def _writer():
         with _lock:
             if _file is None:
                 os.makedirs(trace_dir(), exist_ok=True)
-                _file = open(
+                # opened once per process at the first span; per-span
+                # appends are line-buffered local writes (µs-scale), so
+                # span exits inside async executors stay loop-safe
+                _file = open(  # raylint: disable=async-blocking
                     os.path.join(trace_dir(), f"trace-{os.getpid()}.jsonl"),
                     "a", buffering=1)  # line-buffered: crash-safe
     return _file

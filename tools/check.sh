@@ -1,0 +1,47 @@
+#!/usr/bin/env bash
+# Pre-commit bar: the raylint repo gate + the static-analysis test
+# suite + the runtime-lockdep-gated suites. CI runs the same thing —
+# a commit that fails here fails tier-1.
+#
+#   tools/check.sh           # full bar (~2 min)
+#   tools/check.sh --fast    # raylint gate + lint marker only (~30 s)
+set -u -o pipefail
+
+cd "$(dirname "$0")/.."
+
+fast=0
+[ "${1:-}" = "--fast" ] && fast=1
+
+fail=0
+step() {
+    echo
+    echo "==> $1"
+    shift
+    "$@" || { echo "FAILED: $1"; fail=1; }
+}
+
+# 1. raylint repo gate: per-module + whole-program checkers +
+#    unused-suppression audit, against the committed (empty) baseline.
+#    Exit-nonzero on any new finding.
+step "raylint repo gate" python -m tools.raylint ray_tpu/ --root .
+
+# 2. static-analysis tests: checker fixtures (known-bad detected,
+#    known-good silent), call-graph units, CLI/baseline behavior, and
+#    the lint-marked repo-gate tests.
+step "raylint test suite" python -m pytest tests/test_raylint.py -q
+
+if [ "$fast" -eq 0 ]; then
+    # 3. runtime lockdep: the suites conftest gates under the
+    #    lock-order validator (record-only, asserted clean at teardown).
+    step "lockdep-gated suites" python -m pytest -q \
+        tests/test_chaos.py tests/test_object_store.py \
+        tests/test_rpc_batch.py tests/test_multitenant.py \
+        tests/test_ownership.py
+fi
+
+echo
+if [ "$fail" -ne 0 ]; then
+    echo "check.sh: FAILED"
+    exit 1
+fi
+echo "check.sh: all gates green"

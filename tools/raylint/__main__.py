@@ -1,24 +1,74 @@
 """CLI: ``python -m tools.raylint ray_tpu/``.
 
+Runs the per-module checkers (tools/raylint/core.py) plus the
+whole-program pass (tools/raylint/whole_program.py — async-blocking,
+rpc-surface, surface-drift over the repo-wide call graph) and, when the
+full check set is selected, the unused-suppression audit: a
+``# raylint: disable=`` comment whose check no longer fires anywhere on
+its line is itself a finding, so suppressions cannot rot.
+
 Exit codes: 0 — clean against the baseline; 1 — new findings; 2 — usage
 error. ``--write-baseline`` refreshes the frozen set (burn-down commits
-run it after fixing violations).
+run it after fixing violations). ``--json`` emits machine-readable
+findings for CI annotation.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 
 from tools.raylint import baseline as baseline_mod
-from tools.raylint.core import CHECKS, analyze_paths
+from tools.raylint.core import (CHECKS, Finding, analyze_paths,
+                                collect_suppressions)
+from tools.raylint.whole_program import WP_CHECKS, analyze_program_paths
+
+ALL_CHECKS = CHECKS + WP_CHECKS + ("unused-suppression",)
+
+
+def _finding_json(f: Finding) -> dict:
+    return {"path": f.path, "line": f.line, "check": f.check,
+            "scope": f.scope, "detail": f.detail, "message": f.message,
+            "key": f.key()}
+
+
+def run_checks(paths, root, checks, audit_suppressions=True):
+    """All findings for `paths`: per-module + whole-program checkers,
+    plus the unused-suppression audit when every check is enabled
+    (a partial --select would otherwise flag suppressions whose check
+    simply didn't run)."""
+    hits = set()
+    findings = []
+    module_checks = tuple(c for c in checks if c in CHECKS)
+    wp_checks = tuple(c for c in checks if c in WP_CHECKS)
+    if module_checks:
+        findings.extend(analyze_paths(paths, root=root,
+                                      checks=module_checks,
+                                      suppression_hits=hits))
+    if wp_checks:
+        findings.extend(analyze_program_paths(paths, root=root,
+                                              checks=wp_checks,
+                                              suppression_hits=hits))
+    if audit_suppressions and "unused-suppression" in checks and \
+            set(CHECKS + WP_CHECKS) <= set(checks):
+        for relpath, line, raw in collect_suppressions(paths, root=root):
+            if (relpath, line) not in hits:
+                findings.append(Finding(
+                    relpath, "unused-suppression", "<comment>",
+                    f"disable={raw}", line,
+                    f"suppression 'disable={raw}' matches no finding — "
+                    f"the violation is gone; delete the comment"))
+    findings.sort(key=lambda f: (f.path, f.line, f.check, f.detail))
+    return findings
 
 
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m tools.raylint",
-        description="concurrency + jit-boundary static analysis")
+        description="concurrency + jit-boundary + whole-program "
+                    "surface-consistency static analysis")
     parser.add_argument("paths", nargs="+", help="files or directories")
     parser.add_argument("--baseline", default=baseline_mod.DEFAULT_BASELINE,
                         help="baseline file (default: committed baseline)")
@@ -26,19 +76,22 @@ def main(argv=None) -> int:
                         help="report every finding, ignore the baseline")
     parser.add_argument("--write-baseline", action="store_true",
                         help="freeze the current findings as the baseline")
-    parser.add_argument("--select", default=",".join(CHECKS),
+    parser.add_argument("--select", default=",".join(ALL_CHECKS),
                         help="comma-separated checks to run "
-                             f"(default: all of {', '.join(CHECKS)})")
+                             f"(default: all of {', '.join(ALL_CHECKS)})")
     parser.add_argument("--root", default=os.getcwd(),
                         help="path findings are reported relative to")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="machine-readable output (findings, new, "
+                             "stale) for CI annotation")
     args = parser.parse_args(argv)
 
     checks = tuple(c.strip() for c in args.select.split(",") if c.strip())
-    unknown = [c for c in checks if c not in CHECKS]
+    unknown = [c for c in checks if c not in ALL_CHECKS]
     if unknown:
         parser.error(f"unknown checks: {', '.join(unknown)}")
 
-    findings = analyze_paths(args.paths, root=args.root, checks=checks)
+    findings = run_checks(args.paths, args.root, checks)
 
     if args.write_baseline:
         baseline_mod.save(findings, args.baseline)
@@ -46,13 +99,24 @@ def main(argv=None) -> int:
         return 0
 
     if args.no_baseline:
-        for f in findings:
-            print(f.render())
-        print(f"{len(findings)} finding(s)")
+        if args.as_json:
+            print(json.dumps({"findings": [_finding_json(f)
+                                           for f in findings],
+                              "new": [], "stale": []}, indent=2))
+        else:
+            for f in findings:
+                print(f.render())
+            print(f"{len(findings)} finding(s)")
         return 1 if findings else 0
 
     base = baseline_mod.load(args.baseline)
     new, stale = baseline_mod.compare(findings, base)
+    if args.as_json:
+        print(json.dumps({"findings": [_finding_json(f)
+                                       for f in findings],
+                          "new": [_finding_json(f) for f in new],
+                          "stale": sorted(stale)}, indent=2))
+        return 1 if new else 0
     for f in new:
         print(f.render())
     for key in stale:

@@ -1,0 +1,883 @@
+"""Whole-program symbol table + call graph for raylint v2.
+
+PR-2's checkers are per-module: each file is parsed, analyzed, and
+forgotten. The three v2 checkers (``async-blocking``, ``rpc-surface``,
+``surface-drift``) need facts that only exist across files — is this
+sync helper reachable from an ``async def`` three modules away? does any
+server register a handler for this string literal? does anything export
+the metric this dashboard query reads? — so this module splits the
+analysis RacerD-style into two phases:
+
+1. **Per-module fact extraction** (`extract_module_facts`): one AST walk
+   per file produces a plain-data `ModuleFacts` — functions with their
+   async coloring, outgoing call sites (dotted names, unresolved),
+   direct blocking operations, executor-hop shelter, RPC
+   registrations/call literals, metric exports/consumptions, class
+   shapes (bases, methods, ``self.attr = Ctor()`` types), import
+   aliases, and suppression comments. Facts are pickle-stable and
+   independent of every other file, which makes them **cacheable**: the
+   repo gate persists them keyed by ``(mtime_ns, size)`` so a warm run
+   re-parses only edited files (`FactsCache`).
+
+2. **Whole-program resolution** (`Program`): the facts of every module
+   are joined into a symbol table (``module.Class.method`` /
+   ``module.func`` keys), call sites are resolved through import
+   aliases, ``self.`` method dispatch (same-class, then cross-module
+   base chain), ``self._attr.m()`` instance-attribute types, and local
+   ``x = Ctor()`` bindings, and the checkers run over the resolved
+   graph.
+
+Resolution is deliberately *under*-approximate (an edge exists only
+when the target is provably a repo function): the checkers built on it
+flag what they can prove, and the baseline stays empty because every
+edge they report is real.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import pickle
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+# bump to invalidate cached facts when extraction logic changes
+FACTS_VERSION = 8
+
+_SUPPRESS_RE = re.compile(r"#\s*raylint:\s*disable=([\w,\-]+)")
+
+# ---------------------------------------------------------------------------
+# fact dataclasses (plain data — pickled by the facts cache)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class CallFact:
+    """One outgoing call site, unresolved: `callee` is the dotted name
+    as written ('self._coal.send', 'mod.f', 'f', 'Cls().m')."""
+    callee: str
+    line: int
+
+
+@dataclasses.dataclass
+class FuncFact:
+    name: str                 # module-local qual: 'Cls.m', 'f', 'f.<locals>.g'
+    line: int
+    is_async: bool
+    calls: List[CallFact] = dataclasses.field(default_factory=list)
+    # direct blocking operations: (reason, line)
+    blocking: List[Tuple[str, int]] = dataclasses.field(default_factory=list)
+    # local `x = Ctor(...)` bindings: var -> dotted ctor name as written
+    local_types: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class ClassFact:
+    name: str
+    line: int
+    bases: List[str] = dataclasses.field(default_factory=list)
+    methods: Dict[str, int] = dataclasses.field(default_factory=dict)
+    async_methods: Set[str] = dataclasses.field(default_factory=set)
+    # `self.attr = Ctor(...)` -> dotted ctor name as written
+    attr_types: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class RpcRegistration:
+    kind: str      # 'register' (literal) | 'register_all' (class sweep)
+    name: str      # method literal, or module-local class name
+    prefix: str    # register_all prefix ('' for literal registrations)
+    line: int
+    scope: str
+
+
+@dataclasses.dataclass
+class RpcCallSite:
+    method: str
+    verb: str      # 'call' | 'notify' | 'call_nowait'
+    line: int
+    scope: str
+
+
+@dataclasses.dataclass
+class MetricExport:
+    name: str
+    is_prefix: bool    # dynamic suffix ('rpc_' + formatted value)
+    kind: str          # 'ctor' | 'text'
+    line: int
+
+
+@dataclasses.dataclass
+class MetricUse:
+    name: str
+    is_prefix: bool    # prefix-filter consumption (DEFAULT_PREFIXES et al.)
+    line: int
+    scope: str
+
+
+@dataclasses.dataclass
+class ModuleFacts:
+    relpath: str
+    module: str                     # dotted ('ray_tpu._private.rpc')
+    aux: bool = False               # consumer-only file (bench.py)
+    imports: Dict[str, str] = dataclasses.field(default_factory=dict)
+    functions: Dict[str, FuncFact] = dataclasses.field(default_factory=dict)
+    classes: Dict[str, ClassFact] = dataclasses.field(default_factory=dict)
+    rpc_registrations: List[RpcRegistration] = \
+        dataclasses.field(default_factory=list)
+    rpc_calls: List[RpcCallSite] = dataclasses.field(default_factory=list)
+    metric_exports: List[MetricExport] = \
+        dataclasses.field(default_factory=list)
+    metric_uses: List[MetricUse] = dataclasses.field(default_factory=list)
+    # identifier-shaped string literals: [(value, line)] — dynamic
+    # dispatch evidence for the rpc-surface dead-handler check (a
+    # handler name mentioned anywhere outside its registration is
+    # plausibly dispatched through a variable, so not provably dead)
+    str_mentions: List[Tuple[str, int]] = \
+        dataclasses.field(default_factory=list)
+    # suppression comments: line -> set of check names (or {'all'})
+    suppressions: Dict[int, Set[str]] = dataclasses.field(default_factory=dict)
+
+    def suppressed(self, check: str, line: int) -> bool:
+        return self.suppression_line(check, line) is not None
+
+    def suppression_line(self, check: str, line: int) -> Optional[int]:
+        """Line of the `# raylint: disable=` comment covering (check,
+        line), or None. Matches the flagged line or the line above."""
+        for ln in (line, line - 1):
+            what = self.suppressions.get(ln)
+            if what and ("all" in what or check in what):
+                return ln
+        return None
+
+
+# ---------------------------------------------------------------------------
+# blocking-operation classification (async-blocking sinks)
+# ---------------------------------------------------------------------------
+
+_SUBPROCESS_BLOCKING = {"run", "call", "check_call", "check_output",
+                        "Popen", "getoutput", "getstatusoutput"}
+_SOCKET_MODULE_BLOCKING = {"create_connection", "getaddrinfo",
+                           "gethostbyname", "gethostbyaddr"}
+_SOCKET_METHODS = {"recv", "recvfrom", "accept", "sendall", "connect"}
+_FILE_METHODS = {"read_text", "write_text", "read_bytes", "write_bytes"}
+_QUEUEISH = re.compile(r"queue|(^|[._])q$", re.IGNORECASE)
+_LOCKISH = re.compile(r"lock|mutex|sem", re.IGNORECASE)
+
+# executor/thread hops that shelter their function arguments from the
+# event loop (the sanctioned way to run blocking work from async code)
+_HOP_CALLS = {"run_in_executor", "to_thread", "start_new_thread"}
+
+# asyncio combinators whose Call arguments are coroutines: an inner
+# `q.get()` inside `await wait_for(q.get(), t)` is an awaitable, not a
+# blocking queue read
+_CORO_WRAPPERS = {"wait_for", "shield", "gather", "wait", "ensure_future",
+                  "create_task", "as_completed",
+                  "run_coroutine_threadsafe"}
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """Dotted name of an expression; `Cls(...).m` renders as 'Cls().m'
+    so whole-program resolution can dispatch through the constructed
+    type."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = _dotted(node.value)
+        return f"{base}.{node.attr}" if base else None
+    if isinstance(node, ast.Call):
+        inner = _dotted(node.func)
+        return f"{inner}()" if inner else None
+    if isinstance(node, ast.Await):
+        return _dotted(node.value)
+    return None
+
+
+def classify_blocking(call: ast.Call) -> Optional[str]:
+    """Reason string when `call` is a blocking primitive that would
+    stall an event loop; None otherwise. Conservative: each pattern
+    here is a known-synchronous operation."""
+    name = _dotted(call.func) or ""
+    parts = name.split(".")
+    last = parts[-1] if parts else ""
+    first = parts[0] if parts else ""
+
+    if name.endswith("time.sleep") or name == "time.sleep":
+        return "time.sleep"
+    if first == "subprocess" and last in _SUBPROCESS_BLOCKING:
+        return name
+    if name in ("os.system", "os.waitpid", "os.popen"):
+        return name
+    if first == "socket" and last in _SOCKET_MODULE_BLOCKING:
+        return name
+    if first in ("ray_tpu", "ray") and len(parts) == 2 and \
+            last in ("get", "wait"):
+        return name
+    if name == "open":
+        return "open() [sync file I/O]"
+    if name in ("os.read", "os.write", "os.fsync"):
+        return name
+    if isinstance(call.func, ast.Attribute):
+        attr = call.func.attr
+        recv = _dotted(call.func.value) or ""
+        if attr in _FILE_METHODS:
+            return f".{attr}() [sync file I/O]"
+        if attr in _SOCKET_METHODS and "sock" in recv.lower():
+            return f".{attr}() [sync socket]"
+        if attr == "_run_sync":
+            return "._run_sync() [sync RPC bridge]"
+        if attr == "acquire" and _LOCKISH.search(recv.split(".")[-1]):
+            # `lock.acquire(blocking=False)` polls, never parks
+            for kw in call.keywords:
+                if kw.arg == "blocking" and \
+                        isinstance(kw.value, ast.Constant) and \
+                        kw.value.value is False:
+                    return None
+            return "Lock.acquire"
+        if attr == "join" and not call.args and not call.keywords:
+            return ".join()"
+        if attr == "result" and (call.args or call.keywords):
+            # a pending asyncio future's .result() raises immediately —
+            # only the concurrent.futures form takes a timeout and parks
+            return ".result(timeout) [concurrent future]"
+        if attr == "get" and _QUEUEISH.search(recv):
+            for kw in call.keywords:
+                if kw.arg == "block" and \
+                        isinstance(kw.value, ast.Constant) and \
+                        kw.value.value is False:
+                    return None
+            return ".get() [queue]"
+    return None
+
+
+def _is_hop_call(call: ast.Call) -> bool:
+    """Calls that move their callable argument OFF the event loop:
+    run_in_executor / to_thread / Thread(target=) / executor.submit."""
+    name = _dotted(call.func) or ""
+    last = name.split(".")[-1]
+    if last in _HOP_CALLS:
+        return True
+    if last == "Thread":
+        return True
+    if last == "submit" and re.search(r"executor|pool",
+                                      name.lower()):
+        return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# metric-name literal harvesting (surface-drift)
+# ---------------------------------------------------------------------------
+
+_METRIC_NAME_RE = re.compile(r"^[a-z][a-z0-9_]{2,}$")
+_IDENTIFIERISH_RE = re.compile(r"^[A-Za-z_][A-Za-z0-9_]{2,39}$")
+_ROW_HEAD_RE = re.compile(r"^([a-z][a-z0-9_]{2,})([{ ])")
+_METRIC_TYPES = {"Counter", "Gauge", "Histogram"}
+_PREFIXES_NAME_RE = re.compile(r"(?i)^_?(default_)?prefixes$")
+_TSDB_QUERY_METHODS = {"rate", "latest", "points"}
+
+
+def _exposition_lines(node: ast.AST) -> List[Tuple[str, bool]]:
+    """Logical lines of a string/f-string literal: [(text, ends_in_
+    dynamic)] where ends_in_dynamic marks a line whose tail is a
+    FormattedValue (``f"name {value}"``). Adjacent implicit-concat
+    literals arrive pre-merged by the parser, so a metrics_text body
+    spanning several source lines is one node here."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        chunks: List = [node.value]
+    elif isinstance(node, ast.JoinedStr):
+        chunks = [v.value if isinstance(v, ast.Constant)
+                  and isinstance(v.value, str) else None
+                  for v in node.values]
+    else:
+        return []
+    # each line is (head, has_dynamic): head stops at the line's first
+    # dynamic piece — constants after it are value/label tail, not name
+    lines: List[Tuple[str, bool]] = [("", False)]
+    for chunk in chunks:
+        if chunk is None:
+            head, _ = lines[-1]
+            lines[-1] = (head, True)
+            continue
+        parts = chunk.split("\n")
+        head, dyn = lines[-1]
+        if not dyn:
+            lines[-1] = (head + parts[0], dyn)
+        for part in parts[1:]:
+            lines.append((part, False))
+    return lines
+
+
+def _exposition_exports(node: ast.AST) -> List[Tuple[str, bool]]:
+    """Metric names exported by a string literal shaped like Prometheus
+    exposition rows. Returns [(name, is_prefix)].
+
+    - `'scheduler_queue_depth{job="x"} 3'` → exact
+    - `f'serve_top_kv_pages_live{{deployment="{n}"}} {v}'` → exact
+      (the AST constant chunk is 'serve_top_kv_pages_live{deployment="')
+    - `f"rpc_{name} {value}"` → prefix 'rpc_' (dynamic suffix)
+    - multi-row bodies (`"# TYPE x counter\\n" f"x {v}\\n"`) export
+      every row — each logical line is matched independently
+    """
+    out: List[Tuple[str, bool]] = []
+    for text, ends_dynamic in _exposition_lines(node):
+        if not text or text.startswith("#"):
+            continue  # comment/TYPE rows name the family elsewhere
+        m = _ROW_HEAD_RE.match(text)
+        if m:
+            name, sep = m.group(1), m.group(2)
+            rest = text[m.end():]
+            if sep == "{" and '="' in text:
+                out.append((name, False))
+            elif sep == " " and (_looks_numeric(rest.split()[0])
+                                 if rest.split()
+                                 else ends_dynamic):
+                out.append((name, False))
+        elif ends_dynamic and text.endswith("_") and \
+                _METRIC_NAME_RE.match(text):
+            # 'rpc_' + {formatted}: a family of names sharing the prefix
+            out.append((text, True))
+    return out
+
+
+def _looks_numeric(tok: str) -> bool:
+    if not tok:
+        return False
+    try:
+        float(tok)
+        return True
+    except ValueError:
+        return False
+
+
+# ---------------------------------------------------------------------------
+# per-module extraction
+# ---------------------------------------------------------------------------
+
+def module_name_for(relpath: str) -> str:
+    mod = relpath[:-3] if relpath.endswith(".py") else relpath
+    mod = mod.replace("/", ".").replace(os.sep, ".")
+    if mod.endswith(".__init__"):
+        mod = mod[: -len(".__init__")]
+    return mod
+
+
+class _FunctionExtractor:
+    """Walks one function body (nested defs excluded — they become their
+    own FuncFacts) collecting calls, blocking ops, and local types."""
+
+    def __init__(self, fact: FuncFact, module_facts: ModuleFacts,
+                 scope_class: Optional[str]):
+        self.fact = fact
+        self.mf = module_facts
+        self.scope_class = scope_class
+        self._awaited: Set[int] = set()
+
+    def walk_body(self, stmts: Iterable[ast.stmt]) -> None:
+        # prepass: awaited calls (and Call arguments of asyncio
+        # combinators) produce coroutines — never blocking sinks
+        for stmt in stmts:
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.Await) and \
+                        isinstance(node.value, ast.Call):
+                    self._awaited.add(id(node.value))
+                if isinstance(node, ast.Call):
+                    name = _dotted(node.func) or ""
+                    if name.split(".")[-1] in _CORO_WRAPPERS:
+                        for arg in node.args:
+                            if isinstance(arg, ast.Call):
+                                self._awaited.add(id(arg))
+        for stmt in stmts:
+            self._walk(stmt, sheltered=False)
+
+    def _walk(self, node: ast.AST, sheltered: bool) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return  # separate scope (handled by the module extractor)
+        if isinstance(node, ast.Assign):
+            value = node.value
+            if isinstance(value, ast.Call):
+                ctor = _dotted(value.func)
+                if ctor:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            self.fact.local_types[t.id] = ctor
+        if isinstance(node, ast.Call):
+            self._on_call(node, sheltered)
+            hop = _is_hop_call(node)
+            for child in ast.iter_child_nodes(node):
+                self._walk(child, sheltered or hop)
+            return
+        for child in ast.iter_child_nodes(node):
+            self._walk(child, sheltered)
+
+    def _on_call(self, call: ast.Call, sheltered: bool) -> None:
+        # RPC registration / call-site literals are harvested even in
+        # sheltered positions — shelter only affects the event-loop edge
+        self._harvest_rpc(call)
+        self._harvest_metric_use(call)
+        if sheltered:
+            return
+        if id(call) not in self._awaited:
+            reason = classify_blocking(call)
+            if reason is not None:
+                self.fact.blocking.append((reason, call.lineno))
+                return
+        callee = _dotted(call.func)
+        if callee:
+            self.fact.calls.append(CallFact(callee, call.lineno))
+
+    def _harvest_rpc(self, call: ast.Call) -> None:
+        if not isinstance(call.func, ast.Attribute):
+            return
+        attr = call.func.attr
+        if attr == "register" and call.args and \
+                isinstance(call.args[0], ast.Constant) and \
+                isinstance(call.args[0].value, str) and len(call.args) >= 2:
+            self.mf.rpc_registrations.append(RpcRegistration(
+                "register", call.args[0].value, "", call.lineno,
+                self.fact.name))
+        elif attr == "register_all" and call.args:
+            target = _dotted(call.args[0])
+            prefix = "rpc_"
+            for kw in call.keywords:
+                if kw.arg == "prefix" and \
+                        isinstance(kw.value, ast.Constant):
+                    prefix = kw.value.value
+            if target == "self" and self.scope_class:
+                target = self.scope_class
+            if target:
+                self.mf.rpc_registrations.append(RpcRegistration(
+                    "register_all", target, prefix, call.lineno,
+                    self.fact.name))
+        elif attr in ("call", "notify", "call_nowait",
+                      "_call", "_notify") and call.args and \
+                isinstance(call.args[0], ast.Constant) and \
+                isinstance(call.args[0].value, str):
+            # `_call`/`_notify` are the conventional thin wrappers
+            # around RpcClient (ray client's ClientContext._call) —
+            # their method literals are call sites too
+            self.mf.rpc_calls.append(RpcCallSite(
+                call.args[0].value, attr.lstrip("_"), call.lineno,
+                self.fact.name))
+
+    def _harvest_metric_use(self, call: ast.Call) -> None:
+        name = _dotted(call.func) or ""
+        last = name.split(".")[-1]
+        if last in _TSDB_QUERY_METHODS and "." in name and call.args and \
+                isinstance(call.args[0], ast.Constant) and \
+                isinstance(call.args[0].value, str) and \
+                _METRIC_NAME_RE.match(call.args[0].value):
+            self.mf.metric_uses.append(MetricUse(
+                call.args[0].value, False, call.lineno, self.fact.name))
+        elif last == "histogram_quantile" and len(call.args) >= 2 and \
+                isinstance(call.args[1], ast.Constant) and \
+                isinstance(call.args[1].value, str):
+            self.mf.metric_uses.append(MetricUse(
+                call.args[1].value + "_bucket", False, call.lineno,
+                self.fact.name))
+
+
+def extract_module_facts(source: str, relpath: str,
+                         aux: bool = False) -> ModuleFacts:
+    tree = ast.parse(source, filename=relpath)
+    mf = ModuleFacts(relpath=relpath, module=module_name_for(relpath),
+                     aux=aux)
+
+    for i, line in enumerate(source.splitlines(), start=1):
+        m = _SUPPRESS_RE.search(line)
+        if m:
+            mf.suppressions[i] = {w.strip() for w in m.group(1).split(",")}
+
+    _collect_imports(tree, mf)
+    _collect_scopes(tree, mf)
+    _collect_metric_surface(tree, mf)
+    return mf
+
+
+def _collect_imports(tree: ast.Module, mf: ModuleFacts) -> None:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                mf.imports[alias.asname or alias.name.split(".")[0]] = \
+                    alias.name if alias.asname else alias.name.split(".")[0]
+                if alias.asname:
+                    mf.imports[alias.asname] = alias.name
+        elif isinstance(node, ast.ImportFrom):
+            if node.level:  # relative import: resolve against this package
+                pkg_parts = mf.module.split(".")
+                base_parts = pkg_parts[: len(pkg_parts) - node.level]
+                base = ".".join(base_parts)
+                target = f"{base}.{node.module}" if node.module else base
+            else:
+                target = node.module or ""
+            for alias in node.names:
+                if alias.name == "*":
+                    continue
+                mf.imports[alias.asname or alias.name] = \
+                    f"{target}.{alias.name}" if target else alias.name
+
+
+def _scope_name(stack: List[str], name: str) -> str:
+    return ".<locals>.".join(stack + [name]) if stack else name
+
+
+def _collect_scopes(tree: ast.Module, mf: ModuleFacts) -> None:
+    def visit_func(fn: ast.AST, classname: Optional[str],
+                   stack: List[str]) -> None:
+        qual_base = f"{classname}.{fn.name}" if classname else fn.name
+        qual = _scope_name(stack, qual_base)
+        fact = FuncFact(name=qual, line=fn.lineno,
+                        is_async=isinstance(fn, ast.AsyncFunctionDef))
+        mf.functions[qual] = fact
+        ex = _FunctionExtractor(fact, mf, classname)
+        ex.fact = fact
+        ex.walk_body(fn.body)
+        # nested defs become their own facts under `qual.<locals>.`
+        for stmt in _shallow(fn):
+            visit_func(stmt, None, stack + [qual_base])
+
+    def _shallow(fn):
+        out = []
+        stack = list(fn.body)
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out.append(node)
+                continue  # don't descend into nested scopes
+            if isinstance(node, ast.Lambda):
+                continue
+            stack.extend(ast.iter_child_nodes(node))
+        return out
+
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            visit_func(node, None, [])
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            cf = ClassFact(name=node.name, line=node.lineno,
+                           bases=[b for b in (_dotted(base)
+                                              for base in node.bases) if b])
+            mf.classes[node.name] = cf
+            for item in node.body:
+                if isinstance(item, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    cf.methods[item.name] = item.lineno
+                    if isinstance(item, ast.AsyncFunctionDef):
+                        cf.async_methods.add(item.name)
+                    visit_func(item, node.name, [])
+                    for sub in ast.walk(item):
+                        if isinstance(sub, ast.Assign) and \
+                                isinstance(sub.value, ast.Call):
+                            ctor = _dotted(sub.value.func)
+                            if not ctor:
+                                continue
+                            for t in sub.targets:
+                                if isinstance(t, ast.Attribute) and \
+                                        isinstance(t.value, ast.Name) \
+                                        and t.value.id == "self":
+                                    cf.attr_types.setdefault(t.attr, ctor)
+
+
+def _collect_metric_surface(tree: ast.Module, mf: ModuleFacts) -> None:
+    # metric constructors: Counter("name", ...) / Gauge / Histogram —
+    # exporters wherever they are constructed
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            name = _dotted(node.func) or ""
+            last = name.split(".")[-1]
+            if last in _METRIC_TYPES and node.args and \
+                    isinstance(node.args[0], ast.Constant) and \
+                    isinstance(node.args[0].value, str) and \
+                    _METRIC_NAME_RE.match(node.args[0].value):
+                base = node.args[0].value
+                mf.metric_exports.append(MetricExport(
+                    base, False, "ctor", node.lineno))
+                if last == "Histogram":
+                    for suffix in ("_bucket", "_sum", "_count"):
+                        mf.metric_exports.append(MetricExport(
+                            base + suffix, False, "ctor", node.lineno))
+        # exposition-row literals (metrics_text builders, top's
+        # self-ingested rows): any string that parses as `name{...} v`
+        # or `name <value>` exports that name
+        for name, is_prefix in _exposition_exports(node):
+            mf.metric_exports.append(MetricExport(
+                name, is_prefix, "text", node.lineno))
+        if isinstance(node, ast.Constant) and \
+                isinstance(node.value, str) and \
+                _IDENTIFIERISH_RE.match(node.value):
+            mf.str_mentions.append((node.value, node.lineno))
+        # prefix-filter consumption: `prefixes = ("serve_", ...)` /
+        # DEFAULT_PREFIXES — each element must match some exporter
+        if isinstance(node, ast.Assign) and \
+                isinstance(node.value, (ast.Tuple, ast.List)):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and \
+                        _PREFIXES_NAME_RE.match(t.id):
+                    for el in node.value.elts:
+                        if isinstance(el, ast.Constant) and \
+                                isinstance(el.value, str) and \
+                                _METRIC_NAME_RE.match(el.value):
+                            mf.metric_uses.append(MetricUse(
+                                el.value, True, el.lineno, t.id))
+
+
+# ---------------------------------------------------------------------------
+# whole-program resolution
+# ---------------------------------------------------------------------------
+
+class Program:
+    """Joined view over every module's facts with name resolution."""
+
+    def __init__(self, modules: Sequence[ModuleFacts]):
+        self.modules: Dict[str, ModuleFacts] = {m.module: m
+                                                for m in modules}
+        self.by_relpath: Dict[str, ModuleFacts] = {m.relpath: m
+                                                   for m in modules}
+        # global symbol table: 'mod::qual' -> (ModuleFacts, FuncFact)
+        self.functions: Dict[str, Tuple[ModuleFacts, FuncFact]] = {}
+        for m in modules:
+            for qual, fact in m.functions.items():
+                self.functions[f"{m.module}::{qual}"] = (m, fact)
+
+    # -- symbol helpers ---------------------------------------------------
+
+    def func_key(self, mf: ModuleFacts, qual: str) -> str:
+        return f"{mf.module}::{qual}"
+
+    def _class_in(self, dotted_cls: str,
+                  home: ModuleFacts) -> Optional[Tuple[ModuleFacts,
+                                                       ClassFact]]:
+        """Resolve a dotted class name written inside `home` to its
+        defining module (same module, imported symbol, or imported
+        module attribute)."""
+        if dotted_cls in home.classes:
+            return home, home.classes[dotted_cls]
+        parts = dotted_cls.split(".")
+        target = home.imports.get(parts[0])
+        if target is None:
+            return None
+        full = ".".join([target] + parts[1:])
+        mod_name, _, cls_name = full.rpartition(".")
+        mod = self.modules.get(mod_name)
+        if mod and cls_name in mod.classes:
+            return mod, mod.classes[cls_name]
+        # `from pkg import mod` then `mod.Cls` → target may BE a module
+        mod = self.modules.get(full)
+        if mod is None and target in self.modules and len(parts) == 2:
+            mod = self.modules.get(target)
+            if mod and parts[1] in mod.classes:
+                return mod, mod.classes[parts[1]]
+        return None
+
+    def class_mro(self, mf: ModuleFacts, classname: str
+                  ) -> List[Tuple[ModuleFacts, ClassFact]]:
+        """The class + its resolvable base chain, nearest first
+        (cross-module bases followed through imports; cycles cut)."""
+        out: List[Tuple[ModuleFacts, ClassFact]] = []
+        seen: Set[Tuple[str, str]] = set()
+        frontier: List[Tuple[ModuleFacts, str]] = [(mf, classname)]
+        while frontier:
+            home, name = frontier.pop(0)
+            resolved = self._class_in(name, home)
+            if resolved is None:
+                continue
+            rmod, rcls = resolved
+            key = (rmod.module, rcls.name)
+            if key in seen:
+                continue
+            seen.add(key)
+            out.append((rmod, rcls))
+            for base in rcls.bases:
+                frontier.append((rmod, base))
+        return out
+
+    def find_method(self, mf: ModuleFacts, classname: str, meth: str
+                    ) -> Optional[str]:
+        """Key of `classname.meth` resolved through the base chain."""
+        for rmod, rcls in self.class_mro(mf, classname):
+            if meth in rcls.methods:
+                key = f"{rmod.module}::{rcls.name}.{meth}"
+                if key in self.functions:
+                    return key
+        return None
+
+    # -- call resolution --------------------------------------------------
+
+    def resolve_call(self, mf: ModuleFacts, caller: FuncFact,
+                     callee: str) -> Optional[str]:
+        """Resolve one call site's dotted name to a program function
+        key, or None when the target is not provably a repo function."""
+        caller_class = caller.name.split(".")[0] \
+            if "." in caller.name and "<locals>" not in caller.name \
+            else None
+        parts = callee.split(".")
+
+        # self.m() / self._attr.m()
+        if parts[0] == "self" and caller_class:
+            if len(parts) == 2:
+                return self.find_method(mf, caller_class, parts[1])
+            if len(parts) == 3:
+                cf = mf.classes.get(caller_class)
+                ctor = cf.attr_types.get(parts[1]) if cf else None
+                if ctor:
+                    ctor = ctor[:-2] if ctor.endswith("()") else ctor
+                    resolved = self._class_in(ctor, mf)
+                    if resolved:
+                        rmod, rcls = resolved
+                        return self.find_method(rmod, rcls.name, parts[2])
+            return None
+
+        # nested def called from its parent: parent.<locals>.name
+        if len(parts) == 1:
+            nested = f"{caller.name}.<locals>.{parts[0]}"
+            key = self.func_key(mf, nested)
+            if key in self.functions:
+                return key
+            if parts[0] in mf.functions:
+                return self.func_key(mf, parts[0])
+            target = mf.imports.get(parts[0])
+            if target:
+                mod_name, _, fn = target.rpartition(".")
+                mod = self.modules.get(mod_name)
+                if mod and fn in mod.functions:
+                    return f"{mod.module}::{fn}"
+                # imported class called = constructor
+                resolved = self._class_in(parts[0], mf)
+                if resolved:
+                    rmod, rcls = resolved
+                    return self.find_method(rmod, rcls.name, "__init__")
+            if parts[0] in mf.classes:
+                return self.find_method(mf, parts[0], "__init__")
+            return None
+
+        # Cls().m() — constructed-receiver dispatch
+        if parts[0].endswith("()"):
+            cls = parts[0][:-2]
+            resolved = self._class_in(cls, mf)
+            if resolved and len(parts) == 2:
+                rmod, rcls = resolved
+                return self.find_method(rmod, rcls.name, parts[1])
+            return None
+
+        # local `x = Ctor()` then `x.m()`
+        if parts[0] in caller.local_types and len(parts) == 2:
+            ctor = caller.local_types[parts[0]]
+            ctor = ctor[:-2] if ctor.endswith("()") else ctor
+            resolved = self._class_in(ctor, mf)
+            if resolved:
+                rmod, rcls = resolved
+                return self.find_method(rmod, rcls.name, parts[1])
+            # fall through: maybe a module alias shadowed by the binding
+
+        # Cls.m() (unbound) or mod.f() / pkg.mod.f()
+        if parts[0] in mf.classes and len(parts) == 2:
+            return self.find_method(mf, parts[0], parts[1])
+        target = mf.imports.get(parts[0])
+        if target is not None:
+            full = ".".join([target] + parts[1:])
+            mod_name, _, fn = full.rpartition(".")
+            mod = self.modules.get(mod_name)
+            if mod:
+                if fn in mod.functions:
+                    return f"{mod.module}::{fn}"
+                if fn in mod.classes:
+                    return self.find_method(mod, fn, "__init__")
+            # imported class: `rpc.RpcClient(...)` handled above via ();
+            # `alias.Cls.method` (3 parts)
+            if len(parts) == 3:
+                mod = self.modules.get(target)
+                if mod and parts[1] in mod.classes:
+                    return self.find_method(mod, parts[1], parts[2])
+        return None
+
+    def edges_of(self, key: str) -> List[Tuple[str, int, str]]:
+        """Resolved outgoing edges of one function:
+        [(target_key, line, callee_as_written)]."""
+        mf, fact = self.functions[key]
+        out = []
+        for call in fact.calls:
+            target = self.resolve_call(mf, fact, call.callee)
+            if target is not None and target != key:
+                out.append((target, call.line, call.callee))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# facts cache
+# ---------------------------------------------------------------------------
+
+class FactsCache:
+    """Pickle cache of per-file ModuleFacts keyed by (mtime_ns, size).
+    Keeps the repo gate warm-run cost at parse-only-what-changed;
+    disable with RAY_TPU_RAYLINT_CACHE=0."""
+
+    def __init__(self, path: Optional[str] = None):
+        self.path = path or os.path.join(os.path.dirname(__file__),
+                                         ".factscache.pkl")
+        self.enabled = os.environ.get("RAY_TPU_RAYLINT_CACHE", "1") != "0"
+        self._entries: Dict[str, Tuple[int, int, ModuleFacts]] = {}
+        self._dirty = False
+        if self.enabled:
+            try:
+                with open(self.path, "rb") as fh:
+                    version, entries = pickle.load(fh)
+                if version == FACTS_VERSION:
+                    self._entries = entries
+            except (OSError, pickle.PickleError, ValueError, EOFError):
+                pass
+
+    def get(self, abspath: str, relpath: str,
+            aux: bool = False) -> ModuleFacts:
+        st = os.stat(abspath)
+        key = (st.st_mtime_ns, st.st_size)
+        if self.enabled:
+            hit = self._entries.get(abspath)
+            if hit is not None and (hit[0], hit[1]) == key \
+                    and hit[2].aux == aux:
+                return hit[2]
+        with open(abspath, encoding="utf-8") as fh:
+            source = fh.read()
+        facts = extract_module_facts(source, relpath, aux=aux)
+        if self.enabled:
+            self._entries[abspath] = (key[0], key[1], facts)
+            self._dirty = True
+        return facts
+
+    def save(self) -> None:
+        if not (self.enabled and self._dirty):
+            return
+        tmp = self.path + ".tmp"
+        try:
+            with open(tmp, "wb") as fh:
+                pickle.dump((FACTS_VERSION, self._entries), fh,
+                            protocol=pickle.HIGHEST_PROTOCOL)
+            os.replace(tmp, self.path)
+        except OSError:
+            pass
+
+
+def build_program(paths: Sequence[str], root: str,
+                  aux_paths: Sequence[str] = (),
+                  cache: Optional[FactsCache] = None) -> Program:
+    """Extract (or load cached) facts for every file and join them.
+    `aux_paths` are consumer-only files (bench.py): their RPC call
+    sites and metric uses/exports count, but per-module checkers and
+    async-blocking sources skip them."""
+    cache = cache or FactsCache()
+    modules: List[ModuleFacts] = []
+    seen: Set[str] = set()
+    for path, aux in [(p, False) for p in paths] + \
+                     [(p, True) for p in aux_paths]:
+        abspath = os.path.abspath(path)
+        if abspath in seen:
+            continue
+        seen.add(abspath)
+        relpath = os.path.relpath(abspath, root).replace(os.sep, "/")
+        try:
+            modules.append(cache.get(abspath, relpath, aux=aux))
+        except SyntaxError:
+            continue  # reported by the per-module pass as parse-error
+    cache.save()
+    return Program(modules)

@@ -352,14 +352,20 @@ class ModuleContext:
     def suppressed(self, check: str, line: int) -> bool:
         """True when `# raylint: disable=<check>` is on the flagged line
         or the line directly above it."""
+        return self.suppression_line(check, line) is not None
+
+    def suppression_line(self, check: str, line: int) -> Optional[int]:
+        """Line number of the suppression comment covering (check,
+        line), or None — lets the CLI audit which suppressions still
+        earn their keep."""
         for ln in (line, line - 1):
             if 1 <= ln <= len(self.lines):
                 m = _SUPPRESS_RE.search(self.lines[ln - 1])
                 if m:
                     what = {w.strip() for w in m.group(1).split(",")}
                     if "all" in what or check in what:
-                        return True
-        return False
+                        return ln
+        return None
 
     def base_chain(self, classname: str) -> List[str]:
         """Same-module ancestor classes, nearest first (cycles cut)."""
@@ -1222,24 +1228,32 @@ _CHECKERS = {
 
 def analyze_source(source: str, relpath: str = "<string>",
                    path: Optional[str] = None,
-                   checks: Sequence[str] = CHECKS) -> List[Finding]:
+                   checks: Sequence[str] = CHECKS,
+                   suppression_hits: Optional[Set[Tuple[str, int]]] = None,
+                   ) -> List[Finding]:
     ctx = ModuleContext(path or relpath, relpath, source)
     findings: List[Finding] = []
     for check in checks:
         for f in _CHECKERS[check](ctx):
-            if not ctx.suppressed(f.check, f.line):
+            hit = ctx.suppression_line(f.check, f.line)
+            if hit is None:
                 findings.append(f)
+            elif suppression_hits is not None:
+                suppression_hits.add((relpath, hit))
     findings.sort(key=lambda f: (f.path, f.line, f.check, f.detail))
     return findings
 
 
 def analyze_file(path: str, root: str,
-                 checks: Sequence[str] = CHECKS) -> List[Finding]:
+                 checks: Sequence[str] = CHECKS,
+                 suppression_hits: Optional[Set[Tuple[str, int]]] = None,
+                 ) -> List[Finding]:
     relpath = os.path.relpath(path, root).replace(os.sep, "/")
     with open(path, encoding="utf-8") as fh:
         source = fh.read()
     try:
-        return analyze_source(source, relpath, path, checks)
+        return analyze_source(source, relpath, path, checks,
+                              suppression_hits=suppression_hits)
     except SyntaxError as e:
         return [Finding(relpath, "parse-error", "<module>", "syntax",
                         e.lineno or 0, f"syntax error: {e.msg}")]
@@ -1261,10 +1275,33 @@ def iter_python_files(paths: Sequence[str]) -> List[str]:
 
 
 def analyze_paths(paths: Sequence[str], root: Optional[str] = None,
-                  checks: Sequence[str] = CHECKS) -> List[Finding]:
+                  checks: Sequence[str] = CHECKS,
+                  suppression_hits: Optional[Set[Tuple[str, int]]] = None,
+                  ) -> List[Finding]:
     root = root or os.getcwd()
     findings: List[Finding] = []
     for path in iter_python_files(paths):
-        findings.extend(analyze_file(path, root, checks))
+        findings.extend(analyze_file(path, root, checks,
+                                     suppression_hits=suppression_hits))
     findings.sort(key=lambda f: (f.path, f.line, f.check, f.detail))
     return findings
+
+
+def collect_suppressions(paths: Sequence[str], root: Optional[str] = None
+                         ) -> List[Tuple[str, int, str]]:
+    """Every `# raylint: disable=` comment in `paths`:
+    [(relpath, line, raw check list)] — input to the unused-suppression
+    audit in the CLI."""
+    root = root or os.getcwd()
+    out: List[Tuple[str, int, str]] = []
+    for path in iter_python_files(paths):
+        relpath = os.path.relpath(path, root).replace(os.sep, "/")
+        try:
+            with open(path, encoding="utf-8") as fh:
+                for i, line in enumerate(fh, start=1):
+                    m = _SUPPRESS_RE.search(line)
+                    if m:
+                        out.append((relpath, i, m.group(1)))
+        except OSError:
+            continue
+    return out

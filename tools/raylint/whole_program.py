@@ -1,0 +1,325 @@
+"""Whole-program checkers built on the raylint call graph.
+
+Three interprocedural checkers over the `Program` view
+(tools/raylint/callgraph.py) — see README "Static analysis gates":
+
+``async-blocking``
+    Flags blocking operations reachable from any ``async def`` through
+    the transitive same-repo call chain with no intervening executor
+    hop. The event-loop-stall class: a ``time.sleep`` backoff three
+    sync helpers below an async RPC handler parks the entire loop, and
+    shows up only as tail latency under load. Direct blocking ops in an
+    async def are flagged at the op; a call from an async def into a
+    sync chain that (transitively) blocks is flagged at the async→sync
+    boundary call site, with the chain in the message. An async callee
+    that blocks is that callee's own finding — the boundary rule keeps
+    one finding per root cause instead of one per caller. Sanctioned
+    escapes: ``loop.run_in_executor``, ``asyncio.to_thread``,
+    ``Thread(target=)``, ``executor.submit`` — arguments of these calls
+    run off-loop and are exempt.
+
+``rpc-surface``
+    Compile-time-style checking for the string-keyed RPC plane. Every
+    ``server.register("name", fn)`` literal and ``register_all(self,
+    prefix="rpc_")`` class sweep (base chain included) defines the
+    handler surface; every ``client.call("name")`` /
+    ``notify`` / ``call_nowait`` literal consumes it. A call site whose
+    method no server registers is a latent ``RpcError("no handler for
+    method ...")``; a handler no call site ever names is dead surface.
+    Name-level matching (not per-server): the transport is shared, so a
+    name registered by any server satisfies any caller.
+
+``surface-drift``
+    The same literal-matching discipline for the observability plane.
+    Consumers — ``tsdb`` ``rate``/``latest``/``points`` query literals,
+    ``histogram_quantile`` families (→ ``_bucket``), and prefix-filter
+    tuples (``DEFAULT_PREFIXES``-shaped assignments, bench's attribution
+    prefixes) — must resolve against an exporter: a ``Counter`` /
+    ``Gauge`` / ``Histogram`` constructor literal (Histogram also
+    exports ``_bucket``/``_sum``/``_count``) or an exposition-text row
+    literal (``f"rpc_{n} {v}"``-style callbacks export the ``rpc_``
+    prefix). A renamed metric otherwise silently zeroes the dashboard
+    panel or bench REGRESSION gate that reads the old name.
+
+Consumer-only aux files (bench.py) contribute rpc-surface call sites
+and surface-drift uses, but are not async-blocking sources and their
+string literals do not satisfy ``ray_tpu/`` exporters.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from tools.raylint.callgraph import (FactsCache, ModuleFacts, Program,
+                                     build_program)
+from tools.raylint.core import Finding, iter_python_files
+
+WP_CHECKS = ("async-blocking", "rpc-surface", "surface-drift")
+
+
+# ---------------------------------------------------------------------------
+# async-blocking
+# ---------------------------------------------------------------------------
+
+def _sync_blocking_summaries(program: Program,
+                             suppression_hits: Optional[
+                                 Set[Tuple[str, int]]] = None,
+                             ) -> Dict[str, Tuple[str, List[str]]]:
+    """Fixpoint over *sync* functions: key -> (reason, chain) where
+    chain is the call path (function keys) from the function down to
+    the primitive blocking op. Async functions are boundaries, never
+    carriers — a sync fn calling an async fn gets a coroutine object
+    back, it does not block."""
+    summaries: Dict[str, Tuple[str, List[str]]] = {}
+    sync_keys = [k for k, (_m, fact) in program.functions.items()
+                 if not fact.is_async and not program.functions[k][0].aux]
+    for key in sync_keys:
+        mf, fact = program.functions[key]
+        # a suppression at the primitive op (with its justification —
+        # e.g. the one-time `make` in native.load_shm_store) sanctions
+        # every chain through it, not just the sync caller's own line
+        live = []
+        for reason, line in fact.blocking:
+            hit = mf.suppression_line("async-blocking", line)
+            if hit is None:
+                live.append((reason, line))
+            elif suppression_hits is not None:
+                # the comment sits on a real blocking op — it earns its
+                # keep by sanctioning the chains through it
+                suppression_hits.add((mf.relpath, hit))
+        if live:
+            reason, _line = live[0]
+            summaries[key] = (reason, [])
+    changed = True
+    while changed:
+        changed = False
+        for key in sync_keys:
+            if key in summaries:
+                continue
+            for target, _line, _callee in program.edges_of(key):
+                _tm, tfact = program.functions[target]
+                if tfact.is_async:
+                    continue
+                hit = summaries.get(target)
+                if hit is not None:
+                    reason, chain = hit
+                    summaries[key] = (reason, [target] + chain)
+                    changed = True
+                    break
+    return summaries
+
+
+def _pretty_key(key: str) -> str:
+    mod, _, qual = key.partition("::")
+    return f"{mod}.{qual}"
+
+
+def check_async_blocking(program: Program,
+                         suppression_hits: Optional[
+                             Set[Tuple[str, int]]] = None) -> List[Finding]:
+    findings: List[Finding] = []
+    summaries = _sync_blocking_summaries(program, suppression_hits)
+    for key, (mf, fact) in sorted(program.functions.items()):
+        if not fact.is_async or mf.aux:
+            continue
+        for reason, line in fact.blocking:
+            findings.append(Finding(
+                mf.relpath, "async-blocking", fact.name, reason, line,
+                f"blocking op ({reason}) on the event loop — hand it to "
+                f"run_in_executor/to_thread or use the async form"))
+        for target, line, callee in program.edges_of(key):
+            _tm, tfact = program.functions[target]
+            if tfact.is_async:
+                continue  # its own boundary — flagged there if dirty
+            hit = summaries.get(target)
+            if hit is None:
+                continue
+            reason, chain = hit
+            path = " -> ".join(_pretty_key(k) for k in [target] + chain)
+            findings.append(Finding(
+                mf.relpath, "async-blocking", fact.name,
+                f"{callee}->{reason}", line,
+                f"call into blocking sync chain [{path} -> {reason}] "
+                f"stalls the event loop — hop off-loop first "
+                f"(run_in_executor/to_thread)"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# rpc-surface
+# ---------------------------------------------------------------------------
+
+def _registered_handlers(program: Program
+                         ) -> Dict[str, List[Tuple[ModuleFacts, str, int]]]:
+    """method name -> [(module, scope-for-report, def line)] from both
+    literal register() calls and register_all() class sweeps."""
+    out: Dict[str, List[Tuple[ModuleFacts, str, int]]] = {}
+    for mf in program.modules.values():
+        for reg in mf.rpc_registrations:
+            if reg.kind == "register":
+                out.setdefault(reg.name, []).append(
+                    (mf, reg.scope, reg.line))
+                continue
+            # register_all(obj): sweep prefix-named methods of the
+            # class (and its resolvable base chain)
+            for rmod, rcls in program.class_mro(mf, reg.name):
+                for meth, line in rcls.methods.items():
+                    if meth.startswith(reg.prefix) and \
+                            len(meth) > len(reg.prefix):
+                        bare = meth[len(reg.prefix):]
+                        out.setdefault(bare, []).append(
+                            (rmod, f"{rcls.name}.{meth}", line))
+    return out
+
+
+def check_rpc_surface(program: Program) -> List[Finding]:
+    findings: List[Finding] = []
+    handlers = _registered_handlers(program)
+    called: Set[str] = set()
+    for mf in program.modules.values():
+        for site in mf.rpc_calls:
+            called.add(site.method)
+            if site.method not in handlers:
+                findings.append(Finding(
+                    mf.relpath, "rpc-surface", site.scope,
+                    f"call:{site.method}", site.line,
+                    f"{site.verb}({site.method!r}) has no registered "
+                    f"handler — a runtime RpcError('no handler for "
+                    f"method') waiting to fire"))
+    for name, sites in sorted(handlers.items()):
+        if name in called:
+            continue
+        # dynamic-dispatch fallback: the name as a string literal
+        # anywhere outside its own registration lines means some
+        # variable-method path plausibly reaches it — not provably dead
+        reg_lines = {(mf.relpath, line) for mf, _scope, line in sites}
+        if any((m.relpath, line) not in reg_lines
+               for m in program.modules.values()
+               for value, line in m.str_mentions if value == name):
+            continue
+        for mf, scope, line in sites:
+            if mf.aux:
+                continue  # bench-local surface is bench's business
+            findings.append(Finding(
+                mf.relpath, "rpc-surface", scope,
+                f"handler:{name}", line,
+                f"handler {name!r} is registered but no call site "
+                f"names it — dead RPC surface (delete it or add the "
+                f"missing caller)"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# surface-drift
+# ---------------------------------------------------------------------------
+
+def check_surface_drift(program: Program) -> List[Finding]:
+    exact: Set[str] = set()
+    prefixes: Set[str] = set()
+    for mf in program.modules.values():
+        if mf.aux:
+            continue  # bench rows don't satisfy ray_tpu queries
+        for exp in mf.metric_exports:
+            (prefixes if exp.is_prefix else exact).add(exp.name)
+
+    def resolves(use) -> bool:
+        if use.is_prefix:
+            # prefix-filter element: live if ANY exporter falls under it
+            return any(n.startswith(use.name) for n in exact) or \
+                any(p.startswith(use.name) or use.name.startswith(p)
+                    for p in prefixes)
+        if use.name in exact:
+            return True
+        return any(use.name.startswith(p) for p in prefixes)
+
+    findings: List[Finding] = []
+    for mf in program.modules.values():
+        for use in mf.metric_uses:
+            if resolves(use):
+                continue
+            kind = "prefix" if use.is_prefix else "metric"
+            findings.append(Finding(
+                mf.relpath, "surface-drift", use.scope,
+                f"{kind}:{use.name}", use.line,
+                f"{kind} {use.name!r} matches no registered or "
+                f"callback-exported metric in ray_tpu/ — this query "
+                f"silently reads zero"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+_WP_CHECKERS = {
+    "async-blocking": check_async_blocking,
+    "rpc-surface": check_rpc_surface,
+    "surface-drift": check_surface_drift,
+}
+
+
+def find_aux_files(paths: Sequence[str], root: str) -> List[str]:
+    """Consumer-only siblings of the analyzed tree: a ``bench.py``
+    next to the repo root joins the program so its RPC call literals
+    and metric value-keys are checked against the ray_tpu surface."""
+    out: List[str] = []
+    candidate = os.path.join(root, "bench.py")
+    if os.path.isfile(candidate):
+        analyzed = {os.path.abspath(p) for p in iter_python_files(paths)}
+        if os.path.abspath(candidate) not in analyzed:
+            out.append(candidate)
+    return out
+
+
+def analyze_program_paths(
+        paths: Sequence[str], root: Optional[str] = None,
+        checks: Sequence[str] = WP_CHECKS,
+        aux_paths: Optional[Sequence[str]] = None,
+        cache: Optional[FactsCache] = None,
+        suppression_hits: Optional[Set[Tuple[str, int]]] = None,
+) -> List[Finding]:
+    """Run the whole-program checkers over `paths` (+ auto-discovered
+    aux consumers). Suppressions are honored per finding line; matched
+    suppression-comment lines are recorded into `suppression_hits`
+    (for the unused-suppression audit)."""
+    root = root or os.getcwd()
+    files = iter_python_files(paths)
+    if aux_paths is None:
+        aux_paths = find_aux_files(paths, root)
+    program = build_program(files, root, aux_paths=aux_paths, cache=cache)
+    return analyze_program(program, checks, suppression_hits)
+
+
+def analyze_program(program: Program,
+                    checks: Sequence[str] = WP_CHECKS,
+                    suppression_hits: Optional[Set[Tuple[str, int]]] = None,
+                    ) -> List[Finding]:
+    findings: List[Finding] = []
+    for check in checks:
+        if check == "async-blocking":
+            raw = check_async_blocking(program, suppression_hits)
+        else:
+            raw = _WP_CHECKERS[check](program)
+        for f in raw:
+            mf = program.by_relpath.get(f.path)
+            hit = mf.suppression_line(f.check, f.line) if mf else None
+            if hit is not None:
+                if suppression_hits is not None:
+                    suppression_hits.add((f.path, hit))
+                continue
+            findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.check, f.detail))
+    return findings
+
+
+def analyze_program_sources(sources: Dict[str, str],
+                            checks: Sequence[str] = WP_CHECKS,
+                            aux: Sequence[str] = ()) -> List[Finding]:
+    """Test helper: build a Program from in-memory {relpath: source}
+    and run the whole-program checkers (paths in `aux` are
+    consumer-only)."""
+    from tools.raylint.callgraph import extract_module_facts
+    modules = [extract_module_facts(src, rel, aux=rel in set(aux))
+               for rel, src in sources.items()]
+    return analyze_program(Program(modules), checks)
