@@ -1354,12 +1354,180 @@ def bench_serve_llm():
         "phase_ms": phase_ms,
         "phase_sum_over_e2e_p50": round(p50_ratio, 4),
     }
+    # -- shared-prefix + speculative A/B (ISSUE 18) ----------------------
+    ab = _serve_llm_shared_prefix_ab(scale)
+    detail["shared_prefix_ab"] = ab["detail"]
+
     return {
         "serve_llm": detail,
-        # value-keyed: the >15% REGRESSION gate watches both rates
+        # value-keyed: the >15% REGRESSION gate watches all four rates
         "serve_llm_requests_per_s": n_done / elapsed,
         "serve_llm_tokens_per_s_per_chip":
             m["tokens_generated"] / elapsed / chips,
+        "serve_llm_shared_prefix_tokens_per_s":
+            ab["cache_tokens_per_s"],
+        "serve_llm_shared_prefix_spec_tokens_per_s":
+            ab["spec_tokens_per_s"],
+    }
+
+
+def _serve_llm_shared_prefix_ab(scale: dict) -> dict:
+    """Shared-prefix workload A/B (ISSUE 18): every request carries the
+    same long prompt prefix with a private suffix — the RAG /
+    system-prompt shape the COW prefix cache exists for. Three arms run
+    the IDENTICAL workload in one process:
+
+        base        prefix_cache=0, spec_k=0  (the PR-7 engine)
+        cache       prefix_cache=1, spec_k=0  (COW prefix reuse)
+        cache+spec  prefix_cache=1, spec_k=K  (reuse + speculation)
+
+    Greedy determinism makes the three token streams comparable: the
+    arms must EMIT identical tokens (asserted), so tokens/s is an
+    apples-to-apples rate. The spec arm self-drafts (draft == target
+    weights) — accept length is always K, the upper bound of the
+    speculative win; a production draft supplies its own accept rate.
+    Zero retraces and zero leaked pages are hard gates in every arm.
+    Shape knobs via RAY_TPU_SCALE_SIZES: llm_prefix=96,llm_suffix=16,
+    llm_ab_requests=48,llm_ab_clients=4,llm_spec_k=4."""
+    import numpy as np
+
+    from ray_tpu import parallel
+    from ray_tpu.serve.llm import EngineConfig, LLMEngine
+    from ray_tpu.util import request_recorder as rr
+
+    prefix_len = scale.get("llm_prefix", 96)
+    suffix_len = scale.get("llm_suffix", 16)
+    n_requests = scale.get("llm_ab_requests", 48)
+    n_clients = scale.get("llm_ab_clients", 4)
+    spec_k = scale.get("llm_spec_k", 4)
+    max_new = 8
+
+    rng = np.random.RandomState(7)
+    prefix = [int(t) for t in rng.randint(3, 500, size=prefix_len)]
+    prompts = [prefix + [int(t) for t in rng.randint(3, 500,
+                                                     size=suffix_len)]
+               for _ in range(min(n_requests, 16))]
+
+    # the chunk window matches the suffix: a prefix-cache hit prefills
+    # ONLY the private suffix, in one suffix-sized chunk (without it
+    # the suffix pads to the widest prefill bucket and the win drowns)
+    arms = {
+        "base": dict(prefix_cache=0, spec_k=0),
+        "cache": dict(prefix_cache=1, spec_k=0,
+                      prefill_chunk=suffix_len),
+        "cache_spec": dict(prefix_cache=1, spec_k=spec_k,
+                           prefill_chunk=suffix_len),
+    }
+    out_detail: dict = {
+        "prefix_tokens": prefix_len, "suffix_tokens": suffix_len,
+        "requests_per_arm": n_requests, "clients": n_clients,
+        "spec_k": spec_k, "max_new_tokens": max_new,
+    }
+    emitted: dict = {}
+    rates: dict = {}
+    rec_was_enabled = rr.enabled()
+    rr.set_enabled(True)
+    for arm, knobs in arms.items():
+        eng = LLMEngine(
+            model="llama",
+            engine_config=EngineConfig(
+                batch_buckets=(1, 2, 4),
+                prefill_buckets=(16, 32, 64, 128), **knobs),
+            seed=0)
+        eng.warmup()
+        eng.start()
+        stats_before = parallel.cache_stats()
+        rr.clear()
+        results: dict = {}
+        res_lock = threading.Lock()
+        issued = iter(range(n_requests))
+
+        def client():
+            while True:
+                i = next(issued, None)  # GIL-atomic claim
+                if i is None:
+                    break
+                req = eng.submit(prompts[i % len(prompts)], max_new)
+                toks = req.result(timeout=300)
+                with res_lock:
+                    results[i % len(prompts)] = toks
+        threads = [threading.Thread(target=client, daemon=True)
+                   for _ in range(n_clients)]
+        start = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        elapsed = time.perf_counter() - start
+        eng.quiesce(timeout=60)
+        m = eng.metrics()
+        retraces = parallel.cache_stats()["retraces"] - \
+            stats_before["retraces"]
+        leaked = eng.shutdown()
+        if retraces:
+            raise RuntimeError(
+                f"{arm}: {retraces} retraces in steady state")
+        if leaked:
+            raise RuntimeError(f"{arm}: {leaked} KV pages leaked")
+        emitted[arm] = results
+        rates[arm] = m["tokens_generated"] / elapsed
+
+        recs = [r for r in rr.ring().recent()
+                if r.role == "engine" and r.outcome == "ok"]
+        ttfts = sorted(r.ttft_ms for r in recs
+                       if r.ttft_ms is not None)
+        tpots = sorted(r.tpot_ms for r in recs
+                       if r.tpot_ms is not None)
+
+        def _q(vals, q):
+            return round(vals[int(q * (len(vals) - 1))], 3) \
+                if vals else None
+        arm_detail = {
+            "tokens_per_s": round(rates[arm], 2),
+            "elapsed_s": round(elapsed, 2),
+            "ttft_ms_p50": _q(ttfts, 0.50),
+            "ttft_ms_p99": _q(ttfts, 0.99),
+            "tpot_ms_p50": _q(tpots, 0.50),
+            "tpot_ms_p99": _q(tpots, 0.99),
+        }
+        if knobs.get("prefix_cache"):
+            hit = m["prefix_cache_hit_tokens"]
+            miss = m["prefix_cache_miss_tokens"]
+            arm_detail["prefix_cache_hit_rate"] = round(
+                hit / (hit + miss), 4) if hit + miss else 0.0
+            arm_detail["prefix_cache_hit_tokens"] = int(hit)
+        if knobs.get("spec_k"):
+            arm_detail["spec_mean_accept"] = round(
+                m["spec_accepted"] / m["spec_rounds"], 3) \
+                if m["spec_rounds"] else None
+            arm_detail["spec_proposed"] = int(m["spec_proposed"])
+            arm_detail["spec_accepted"] = int(m["spec_accepted"])
+        out_detail[arm] = arm_detail
+    rr.set_enabled(rec_was_enabled)
+
+    # greedy determinism: all three arms emit the SAME streams
+    for arm in ("cache", "cache_spec"):
+        if emitted[arm] != emitted["base"]:
+            raise RuntimeError(
+                f"{arm} arm diverged from plain greedy output")
+
+    ncpu = os.cpu_count() or 1
+    best = max(rates["cache"], rates["cache_spec"])
+    out_detail["speedup_cache"] = round(rates["cache"] / rates["base"], 3)
+    out_detail["speedup_cache_spec"] = round(
+        rates["cache_spec"] / rates["base"], 3)
+    out_detail["two_x_target_met"] = best >= 2.0 * rates["base"]
+    if not out_detail["two_x_target_met"] and ncpu <= 2:
+        # the 2x acceptance target assumes real accelerator decode
+        # (prefill FLOPs dominate); on the 1-core CPU box dispatch
+        # overhead dominates and caps the cache win — noted, not fatal
+        out_detail["note"] = (
+            f"{ncpu}-core CPU box: dispatch-bound, 2x target waived "
+            "(see README 1-core caveat)")
+    return {
+        "detail": out_detail,
+        "cache_tokens_per_s": rates["cache"],
+        "spec_tokens_per_s": rates["cache_spec"],
     }
 
 

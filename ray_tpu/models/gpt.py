@@ -316,6 +316,55 @@ def decode_step(variables, cfg: GPTConfig, tokens, positions,
     return logits, jnp.stack(new_ks, axis=1), jnp.stack(new_vs, axis=1)
 
 
+def chunk_step(variables, cfg: GPTConfig, tokens, start,
+               k_pages, v_pages, page_table):
+    """Forward C tokens per sequence against a paged cache (chunked
+    prefill / speculative verify). Shapes as in `llama.chunk_step`."""
+    from ray_tpu.models.llama import (  # avoids import cycle
+        chunk_valid_mask, paged_attend_chunk)
+
+    p = unboxed_params(variables)
+    dtype = cfg.dtype
+    hd = cfg.d_model // cfg.n_head
+    b, c = tokens.shape
+    block = k_pages.shape[2]
+    t_max = page_table.shape[1] * block
+    wte = p["wte"].astype(dtype)
+    positions = jnp.minimum(start[:, None] + jnp.arange(c)[None, :],
+                            cfg.max_seq_len - 1)
+    x = wte[tokens] + p["wpe"].astype(dtype)[positions]
+    scale = hd ** -0.5
+    valid = chunk_valid_mask(start, positions, c, t_max)
+    new_ks, new_vs = [], []
+    for i in range(cfg.n_layer):
+        lp = p[f"h{i}"]
+        h = _ln(x, lp["ln_1"]["scale"], lp["ln_1"]["bias"], dtype)
+        qkv = h @ lp["attn_qkv"]["kernel"].astype(dtype) + \
+            lp["attn_qkv"]["bias"].astype(dtype)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(b, c, cfg.n_head, hd)
+        k = k.reshape(b, c, cfg.n_head, hd)
+        v = v.reshape(b, c, cfg.n_head, hd)
+        att = paged_attend_chunk(q, k, v, k_pages[:, i], v_pages[:, i],
+                                 page_table, valid, scale)
+        att = att.reshape(b, c, cfg.d_model) @ \
+            lp["attn_out"]["kernel"].astype(dtype) + \
+            lp["attn_out"]["bias"].astype(dtype)
+        x = x + att
+        h = _ln(x, lp["ln_2"]["scale"], lp["ln_2"]["bias"], dtype)
+        h = h @ lp["mlp_up"]["kernel"].astype(dtype) + \
+            lp["mlp_up"]["bias"].astype(dtype)
+        h = nn.gelu(h)
+        h = h @ lp["mlp_down"]["kernel"].astype(dtype) + \
+            lp["mlp_down"]["bias"].astype(dtype)
+        x = x + h
+        new_ks.append(k)
+        new_vs.append(v)
+    x = _ln(x, p["ln_f"]["scale"], p["ln_f"]["bias"], dtype)
+    logits = jnp.einsum("bcd,vd->bcv", x, wte)
+    return logits, jnp.stack(new_ks, axis=2), jnp.stack(new_vs, axis=2)
+
+
 def count_params(params) -> int:
     return sum(int(np.prod(p.shape))
                for p in jax.tree_util.tree_leaves(params))

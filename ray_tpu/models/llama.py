@@ -292,6 +292,123 @@ def prefill_step(variables, cfg: LlamaConfig, tokens, true_len):
     return next_logits, k, v
 
 
+def _rope_chunk(x, cos_p, sin_p):
+    """apply_rope for a window of positions per sequence; x:
+    [B, C, H, D], cos_p/sin_p: [B, C, D/2] rows gathered at each
+    sequence's window positions."""
+    x32 = x.astype(jnp.float32)
+    x1, x2 = jnp.split(x32, 2, axis=-1)
+    c = cos_p[:, :, None, :]
+    s = sin_p[:, :, None, :]
+    return jnp.concatenate(
+        [x1 * c - x2 * s, x2 * c + x1 * s], axis=-1).astype(x.dtype)
+
+
+def paged_attend_chunk(q, k_new, v_new, k_pages_l, v_pages_l, page_table,
+                       valid, scale):
+    """A window of C tokens attending over paged KV history + the
+    window itself (causally).
+
+    q: [B, C, H, D]; k_new/v_new: [B, C, KVH, D] (this window,
+    post-RoPE); k_pages_l/v_pages_l: [P, block, KVH, D]; page_table:
+    [B, n_pages]; valid: [B, C, T+C] key mask per query position
+    (cached positions < that query's global position, plus the causal
+    triangle inside the window). Same math as `paged_attend` — C=1
+    reduces to it exactly, which is what makes chunked prefill and
+    speculative verify logit-identical to the one-shot paths.
+    """
+    b, c, h, d = q.shape
+    kvh = k_new.shape[2]
+    kc = k_pages_l[page_table].reshape(b, -1, kvh, d).astype(q.dtype)
+    vc = v_pages_l[page_table].reshape(b, -1, kvh, d).astype(q.dtype)
+    k_all = jnp.concatenate([kc, k_new], axis=1)  # [B, T+C, KVH, D]
+    v_all = jnp.concatenate([vc, v_new], axis=1)
+    if kvh != h:  # GQA: repeat KV query-side (expand_kv_heads)
+        k_all = jnp.repeat(k_all, h // kvh, axis=2)
+        v_all = jnp.repeat(v_all, h // kvh, axis=2)
+    logits = jnp.einsum("bchd,bkhd->bhck", q, k_all) * scale
+    logits = jnp.where(valid[:, None, :, :], logits, NEG_INF)
+    row_max = jnp.max(logits, axis=-1, keepdims=True)
+    p = jnp.exp(logits - row_max)
+    row_sum = jnp.sum(p, axis=-1, keepdims=True)
+    out = jnp.einsum("bhck,bkhd->bchd", p / jnp.maximum(row_sum, 1e-20),
+                     v_all)
+    return out
+
+
+def chunk_valid_mask(start, positions, c: int, t_max: int):
+    """[B, C, T+C] key mask for `paged_attend_chunk`: query j (global
+    position start+j) sees cached keys < start plus window keys <= j.
+    Padding rows (start+j >= true length) still compute but their
+    output is discarded by the caller — causality keeps them out of
+    every real position's receptive field."""
+    key_idx = jnp.arange(t_max)
+    cache_valid = key_idx[None, None, :] < start[:, None, None]
+    b = start.shape[0]
+    causal = jnp.tril(jnp.ones((c, c), dtype=bool))[None]
+    return jnp.concatenate(
+        [jnp.broadcast_to(cache_valid, (b, c, t_max)),
+         jnp.broadcast_to(causal, (b, c, c))], axis=-1)
+
+
+def chunk_step(variables, cfg: LlamaConfig, tokens, start,
+               k_pages, v_pages, page_table):
+    """Forward C tokens per sequence against a paged cache holding each
+    sequence's first `start` positions. One kernel serves two callers:
+    chunked prefill (the prompt arrives in fixed-size windows
+    interleaved with decode steps) and speculative verify (the window
+    is [last_committed, draft_1..draft_K] and the caller reads a logit
+    row per position).
+
+    tokens: [B, C]; start: [B] tokens already cached per sequence;
+    k_pages/v_pages: [P, L, block, KVH, D]; page_table: [B, n_pages].
+    Returns (logits [B, C, V], new_k [B, C, L, KVH, D], new_v
+    [B, C, L, KVH, D]); the caller writes rows [0, true_len-start) into
+    each sequence's pages and ignores the padding tail.
+    """
+    p = unboxed_params(variables)
+    dtype = cfg.dtype
+    hd = cfg.head_dim
+    b, c = tokens.shape
+    block = k_pages.shape[2]
+    t_max = page_table.shape[1] * block
+    wte = p["wte"].astype(dtype)
+    x = wte[tokens]  # [B, C, D]
+    # clamp pad positions into the rope table (their output is garbage
+    # by contract; the clamp only keeps the gather in-bounds)
+    positions = jnp.minimum(start[:, None] + jnp.arange(c)[None, :],
+                            cfg.max_seq_len - 1)
+    cos_t, sin_t = rope_tables(cfg.max_seq_len, hd, cfg.rope_theta)
+    cos_p, sin_p = cos_t[positions], sin_t[positions]  # [B, C, D/2]
+    scale = hd ** -0.5
+    valid = chunk_valid_mask(start, positions, c, t_max)
+    new_ks, new_vs = [], []
+    for i in range(cfg.n_layer):
+        lp = p[f"layer{i}"]
+        h = _rms(x, lp["attn_norm"]["scale"], cfg.norm_eps, dtype)
+        fused = h @ lp["attn_qkv"]["kernel"].astype(dtype)
+        q, k, v = jnp.split(
+            fused, [cfg.n_head * hd, (cfg.n_head + cfg.n_kv_head) * hd],
+            axis=-1)
+        q = _rope_chunk(q.reshape(b, c, cfg.n_head, hd), cos_p, sin_p)
+        k = _rope_chunk(k.reshape(b, c, cfg.n_kv_head, hd), cos_p, sin_p)
+        v = v.reshape(b, c, cfg.n_kv_head, hd)
+        att = paged_attend_chunk(q, k, v, k_pages[:, i], v_pages[:, i],
+                                 page_table, valid, scale)
+        x = x + att.reshape(b, c, cfg.d_model) @ \
+            lp["attn_out"]["kernel"].astype(dtype)
+        h = _rms(x, lp["mlp_norm"]["scale"], cfg.norm_eps, dtype)
+        gu = h @ lp["mlp_gate_up"]["kernel"].astype(dtype)
+        gate, up = jnp.split(gu, 2, axis=-1)
+        x = x + (nn.silu(gate) * up) @ \
+            lp["mlp_down"]["kernel"].astype(dtype)
+        new_ks.append(k)
+        new_vs.append(v)
+    x = _rms(x, p["final_norm"]["scale"], cfg.norm_eps, dtype)
+    logits = jnp.einsum("bcd,vd->bcv", x, wte)
+    return logits, jnp.stack(new_ks, axis=2), jnp.stack(new_vs, axis=2)
+
+
 def decode_step(variables, cfg: LlamaConfig, tokens, positions,
                 k_pages, v_pages, page_table):
     """One decode iteration for a batch of sequences on a paged cache.

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import logging
 import math
+import os
 import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
@@ -78,6 +79,12 @@ class ServeController:
         # force-deleted from the node's shm store so the dead process's
         # pages don't leak until eviction pressure
         self._replica_metrics: Dict[int, Dict[str, Any]] = {}
+        # spawn timestamps (actor identity -> monotonic): a replica that
+        # has never answered a poll gets a startup grace window
+        # (RAY_TPU_SERVE_STARTUP_GRACE_S) before an unresponsive poll
+        # counts as death — long warmups (serve.llm AOT compiles) must
+        # not be reaped mid-__init__
+        self._replica_spawned: Dict[int, float] = {}
         self._reclaimed_arenas: List[str] = []
         self._arenas_reclaimed_total = 0
         _metrics.DEFAULT_REGISTRY.register_callback(
@@ -225,8 +232,25 @@ class ServeController:
                 continue
             try:
                 # liveness + load polls on the snapshot, outside the lock
-                alive, dead, total_load, polled = \
+                alive, dead, slow, total_load, polled = \
                     self._poll_replicas(replicas)
+                # unresponsive-but-present replicas: a replica that has
+                # answered a poll before and now times out is hung —
+                # treat as dead. One that has NEVER answered is likely
+                # still constructing (serve.llm warmup compiles every
+                # decode/prefill/verify shape before start); give it a
+                # startup grace window before concluding it's wedged.
+                now = time.monotonic()
+                grace = float(os.environ.get(
+                    "RAY_TPU_SERVE_STARTUP_GRACE_S", "60"))
+                with self._lock:
+                    for r in slow:
+                        # unknown spawn time -> 0.0: an untracked slow
+                        # replica is killable, never immortal
+                        born = self._replica_spawned.get(id(r), 0.0)
+                        if id(r) in self._replica_metrics or \
+                                now - born > grace:
+                            dead.append(r)
                 for r in dead:
                     self._kill(r)
                     self._reclaim_dead_replica(r)
@@ -234,6 +258,7 @@ class ServeController:
                     self._replica_metrics.update(polled)
                     for r in dead:
                         self._replica_metrics.pop(id(r), None)
+                        self._replica_spawned.pop(id(r), None)
                     if self._deployments.get(name) is not st:
                         continue  # deleted/replaced while polling
                     dead_ids = {id(r) for r in dead}
@@ -246,18 +271,23 @@ class ServeController:
 
     @staticmethod
     def _poll_replicas(replicas: List[Any]
-                       ) -> Tuple[List[Any], List[Any], float,
+                       ) -> Tuple[List[Any], List[Any], List[Any], float,
                                   Dict[int, Dict[str, Any]]]:
         """One concurrent get_metrics round over a snapshot: liveness +
-        load in one RPC. Returns (alive, dead, total_load, metrics by
-        replica identity); total_load folds deployment-reported queue
+        load in one RPC. Returns (alive, dead, slow, total_load, metrics
+        by replica identity); total_load folds deployment-reported queue
         depth (serve.llm engine backlog) into the ongoing count so
-        autoscaling sees queued work, not just dispatched work. Dead
-        (or unresponsive) replicas are killed by the caller so they
-        can't leak. Never called with a lock held."""
+        autoscaling sees queued work, not just dispatched work. `dead`
+        holds replicas whose actor is GONE (kill + reclaim immediately);
+        `slow` holds replicas that exist but didn't answer in time — the
+        caller decides whether that's a hung replica (kill) or one still
+        warming up (a serve.llm replica compiling its decode/verify fns
+        can't answer until __init__ returns). Never called with a lock
+        held."""
         refs = [(r, r.get_metrics.remote()) for r in replicas]
         alive: List[Any] = []
         dead: List[Any] = []
+        slow: List[Any] = []
         total_load = 0.0
         polled: Dict[int, Dict[str, Any]] = {}
         for r, ref in refs:
@@ -267,9 +297,11 @@ class ServeController:
                 total_load += m["ongoing"] + \
                     float(m.get("queue_depth", 0))
                 polled[id(r)] = m
-            except Exception:
+            except ray_tpu.ActorDiedError:
                 dead.append(r)
-        return alive, dead, total_load, polled
+            except Exception:
+                slow.append(r)
+        return alive, dead, slow, total_load, polled
 
     def _reclaim_dead_replica(self, replica: Any) -> None:
         """Release node-side resources a dead replica can no longer
@@ -346,6 +378,9 @@ class ServeController:
             orphans: List[Any] = []
             with self._lock:
                 st.scaling = False
+                now = time.monotonic()
+                for r in started:
+                    self._replica_spawned[id(r)] = now
                 if self._deployments.get(name) is st:
                     if started:
                         st.replicas.extend(started)

@@ -10,6 +10,7 @@ from ray_tpu.serve.llm.kv_cache import (
     KVCacheError,
     OutOfPagesError,
     PagedKVCache,
+    PrefixCache,
     reclaim_arena,
 )
 from ray_tpu.serve.llm.engine import (
@@ -27,6 +28,7 @@ __all__ = [
     "LLMEngine",
     "OutOfPagesError",
     "PagedKVCache",
+    "PrefixCache",
     "Request",
     "RequestRejected",
     "build_app",

@@ -32,21 +32,30 @@ class LLMDeployment:
     def __init__(self, model: str = "llama",
                  model_config: Optional[Dict[str, Any]] = None,
                  engine_config: Optional[Dict[str, Any]] = None,
+                 draft_config: Optional[Dict[str, Any]] = None,
                  seed: int = 0):
         from ray_tpu.serve.llm.engine import EngineConfig, LLMEngine
 
         model_cfg = None
+        draft_cfg = None
+        if model == "llama":
+            from ray_tpu.models.llama import LlamaConfig as _Cfg
+        else:
+            from ray_tpu.models.gpt import GPTConfig as _Cfg
         if model_config:
-            if model == "llama":
-                from ray_tpu.models.llama import LlamaConfig as _Cfg
-            else:
-                from ray_tpu.models.gpt import GPTConfig as _Cfg
             model_cfg = _Cfg(**model_config)
+        if draft_config:
+            # a small same-family draft for speculative decoding (its
+            # weights init replica-side from `seed`, like the target's,
+            # so every replica drafts identically — the replay
+            # determinism contract extends to speculation); without
+            # this, spec_k > 0 self-drafts with the target weights
+            draft_cfg = _Cfg(**draft_config)
         store = self._node_store()
         self.engine = LLMEngine(
             model=model, model_cfg=model_cfg,
             engine_config=EngineConfig(**(engine_config or {})),
-            store=store, seed=seed)
+            store=store, seed=seed, draft_cfg=draft_cfg)
         self.engine.warmup()
         self.engine.start()
 
@@ -93,13 +102,26 @@ class LLMDeployment:
 
     def get_autoscaling_metrics(self) -> Dict[str, Any]:
         m = self.engine.metrics()
-        return {
+        out = {
             "queue_depth": float(m["queue_depth"]),
             "llm_running": float(m["running"]),
             "kv_pages_live": float(m["kv_pages_live"]),
+            "kv_pages_cached": float(m.get("kv_pages_cached", 0)),
             "kv_pages_total": float(m["kv_pages_total"]),
             "kv_arena_id": m["kv_arena_id"],
         }
+        # perf-plane rollups for the dashboard /api/serve_llm panel:
+        # prefix-cache hit rate and mean speculative accept length
+        hit = m.get("prefix_cache_hit_tokens")
+        if hit is not None:
+            total = hit + m.get("prefix_cache_miss_tokens", 0)
+            out["prefix_cache_hit_rate"] = hit / total if total else 0.0
+            out["prefix_cache_entries"] = float(
+                m.get("prefix_cache_entries", 0))
+        if m.get("spec_k"):
+            out["spec_k"] = float(m["spec_k"])
+            out["spec_mean_accept"] = float(m.get("spec_mean_accept", 0.0))
+        return out
 
     def engine_metrics(self) -> Dict[str, Any]:
         return self.engine.metrics()

@@ -38,7 +38,8 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from ray_tpu.serve.llm.kv_cache import OutOfPagesError, PagedKVCache
+from ray_tpu.serve.llm.kv_cache import (OutOfPagesError, PagedKVCache,
+                                        PrefixCache)
 from ray_tpu.util import metrics as _metrics
 from ray_tpu.util import request_recorder as _rr
 from ray_tpu.util import step_profiler as _sp
@@ -70,6 +71,14 @@ class EngineConfig:
     max_running: int = 0           # RAY_TPU_LLM_MAX_RUNNING
     max_prefills_per_step: int = 1
     eos_token: Optional[int] = None
+    # copy-on-write shared-prefix page reuse (RAY_TPU_LLM_PREFIX_CACHE,
+    # default on; -1 = unset)
+    prefix_cache: int = -1
+    # chunked prefill window (RAY_TPU_LLM_PREFILL_CHUNK, 0 = off: long
+    # prompts then stay capped at the largest prefill bucket)
+    prefill_chunk: int = -1
+    # speculative decoding draft length K (RAY_TPU_LLM_SPEC_K, 0 = off)
+    spec_k: int = -1
 
     def resolved(self, max_seq_len: int) -> "EngineConfig":
         block = self.block_size or _env_int("RAY_TPU_LLM_BLOCK_SIZE", 16)
@@ -84,10 +93,21 @@ class EngineConfig:
         max_running = min(max_running, max(batch))
         pages_per_seq = -(-max_seq_len // block)
         num_pages = self.num_pages or max_running * pages_per_seq
+        prefix = self.prefix_cache
+        if prefix < 0:
+            prefix = _env_int("RAY_TPU_LLM_PREFIX_CACHE", 1)
+        chunk = self.prefill_chunk
+        if chunk < 0:
+            chunk = _env_int("RAY_TPU_LLM_PREFILL_CHUNK", 0)
+        chunk = min(chunk, max_seq_len)
+        spec = self.spec_k
+        if spec < 0:
+            spec = _env_int("RAY_TPU_LLM_SPEC_K", 0)
         return dataclasses.replace(
             self, block_size=block, num_pages=num_pages,
             batch_buckets=batch, prefill_buckets=prefill,
-            max_running=max_running)
+            max_running=max_running, prefix_cache=int(bool(prefix)),
+            prefill_chunk=max(0, chunk), spec_k=max(0, spec))
 
 
 class RequestRejected(RuntimeError):
@@ -182,14 +202,30 @@ class Request:
 
 
 class _Sequence:
-    """A running request's decode state."""
+    """A running request's decode state.
 
-    __slots__ = ("req", "pages", "pos")
+    `pos` is the number of tokens in the TARGET KV cache (= prompt +
+    generated - 1 in steady state: the newest token rides as the next
+    dispatch's input). `prefilled`/`cached` track the chunked-prefill
+    frontier (prefilled starts at the prefix-cache hit length);
+    `d_pages`/`d_prefilled`/`d_pos` are the draft model's mirror state
+    when speculative decoding is on — `d_pos` is the draft cache
+    frontier, which can lag `pos` by at most one token after a
+    fully-accepted round (the catch-up loop closes the gap)."""
 
-    def __init__(self, req: Request, pages: List[int], pos: int):
+    __slots__ = ("req", "pages", "pos", "prefilled", "cached",
+                 "d_pages", "d_prefilled", "d_pos")
+
+    def __init__(self, req: Request, pages: List[int], pos: int,
+                 cached: int = 0, d_pages: Optional[List[int]] = None):
         self.req = req
         self.pages = pages
         self.pos = pos  # tokens already written to the KV cache
+        self.prefilled = pos or cached
+        self.cached = cached
+        self.d_pages = d_pages
+        self.d_prefilled = 0
+        self.d_pos = 0
 
     @property
     def last_token(self) -> int:
@@ -214,7 +250,8 @@ class LLMEngine:
 
     def __init__(self, model: str = "llama", model_cfg=None, params=None,
                  engine_config: Optional[EngineConfig] = None,
-                 store=None, seed: int = 0):
+                 store=None, seed: int = 0,
+                 draft_cfg=None, draft_params=None):
         import jax
         import jax.numpy as jnp
         from ray_tpu.parallel import compiled_step
@@ -254,6 +291,7 @@ class LLMEngine:
             n_kv_head, head_dim,
             dtype=jnp.dtype(self.model_cfg.dtype),
             store=store)
+        self.prefix = PrefixCache(self.kv) if cfg.prefix_cache else None
 
         # one compiled_step wrapper per bucket: each sees exactly one
         # abstract signature, so on_retrace="error" turns any shape
@@ -266,8 +304,73 @@ class LLMEngine:
             b: compiled_step(self._make_decode_fn(b),
                              on_retrace="error")
             for b in cfg.batch_buckets}
+        # one chunk executable (B=1, C=_chunk_size) covers both chunked
+        # prefill windows and prefix-cache-hit suffixes: every window
+        # pads to the same width, so a chunk is a bucket by construction
+        self._chunk_size = cfg.prefill_chunk or max(cfg.prefill_buckets)
+        self._chunk_fn = compiled_step(
+            self._make_chunk_fn(self._chunk_size, "chunk"),
+            on_retrace="error")
+
+        # speculative decoding: the draft model defaults to the target
+        # itself (self-draft — the 1-core build box's determinism rig);
+        # a real deployment passes a small draft_cfg + draft_params of
+        # the SAME family (vocab/max_seq_len must match the target)
+        self.draft_cfg = None
+        self.draft_params = None
+        self.kv_d: Optional[PagedKVCache] = None
+        if cfg.spec_k > 0:
+            self.draft_cfg = draft_cfg or self.model_cfg
+            if draft_params is not None:
+                self.draft_params = draft_params
+            elif draft_cfg is None:
+                self.draft_params = self.params  # self-draft
+            else:
+                net = (mod.Llama if model == "llama" else mod.GPT)(
+                    self.draft_cfg)
+                self.draft_params = net.init(
+                    jax.random.PRNGKey(seed + 1),
+                    jnp.ones((1, min(cfg.prefill_buckets)), jnp.int32))
+            if getattr(self.draft_cfg, "n_kv_head", None) is not None:
+                d_kvh = self.draft_cfg.n_kv_head
+            else:
+                d_kvh = self.draft_cfg.n_head
+            d_hd = self.draft_cfg.d_model // self.draft_cfg.n_head
+            # the draft frontier can run up to K tokens past the target
+            # (a fully-accepted round), so its per-seq reservation is
+            # K tokens wider; the draft arena is never on the object
+            # plane — it is reconstructible state, not survivor truth
+            self.max_pages_per_seq_d = -(-(self.model_cfg.max_seq_len
+                                           + cfg.spec_k)
+                                         // cfg.block_size)
+            self.kv_d = PagedKVCache(
+                cfg.max_running * self.max_pages_per_seq_d,
+                self.draft_cfg.n_layer, cfg.block_size, d_kvh, d_hd,
+                dtype=jnp.dtype(self.draft_cfg.dtype))
+            # verify: one multi-token target forward per batch bucket,
+            # window C = K+1 ([last_committed, draft_1..draft_K]) — the
+            # accept length varies per round but the window never does,
+            # so accept-length variation can't retrace by construction
+            self._verify_fns = {
+                b: compiled_step(
+                    self._make_verify_fn(b, cfg.spec_k + 1),
+                    on_retrace="error")
+                for b in cfg.batch_buckets}
+            self._d_decode_fns = {
+                b: compiled_step(self._make_decode_fn(b, draft=True),
+                                 on_retrace="error")
+                for b in cfg.batch_buckets}
+            self._d_prefill_fns = {
+                s: compiled_step(self._make_prefill_fn(s, draft=True),
+                                 on_retrace="error")
+                for s in cfg.prefill_buckets}
+            self._d_chunk_fn = compiled_step(
+                self._make_chunk_fn(self._chunk_size, "draft_chunk",
+                                    draft=True),
+                on_retrace="error")
 
         self._waiting: List[Request] = []
+        self._prefilling: List[_Sequence] = []
         self._running: List[_Sequence] = []
         self._lock = threading.Lock()       # guards queues + counters
         self._step_lock = threading.Lock()  # serializes step()
@@ -280,7 +383,14 @@ class LLMEngine:
             "requests_failed": 0, "requests_timed_out": 0,
             "tokens_generated": 0, "prefill_steps": 0,
             "decode_steps": 0, "prefill_ms": 0.0, "decode_ms": 0.0,
+            "chunk_steps": 0, "spec_rounds": 0,
+            "spec_proposed": 0, "spec_accepted": 0,
         }
+        # per-bucket compiled_step dispatch counts: (kind, bucket) ->
+        # calls. Every entry maps 1:1 onto one AOT executable, so the
+        # rows in /metrics show exactly which compiled programs serve
+        # the steady state (and the bench can assert none was missing)
+        self.bucket_calls: Dict[Tuple[str, int], int] = {}
         # per-tenant rows ({job=} labels in /metrics): shed decisions and
         # throughput attributable to the submitting job — the serve
         # plane's view of the multi-tenant quota plane
@@ -290,25 +400,55 @@ class LLMEngine:
 
     # -- compiled kernels -------------------------------------------------
 
-    def _make_prefill_fn(self, bucket: int):
-        mod, cfg = self._mod, self.model_cfg
+    def _make_prefill_fn(self, bucket: int, draft: bool = False):
+        mod = self._mod
+        cfg = self.draft_cfg if draft else self.model_cfg
 
         def fn(variables, tokens, true_len):
             return mod.prefill_step(variables, cfg, tokens, true_len)
 
-        fn.__name__ = f"llm_prefill_s{bucket}"
+        fn.__name__ = f"llm_{'draft_' if draft else ''}prefill_s{bucket}"
         return fn
 
-    def _make_decode_fn(self, batch: int):
-        mod, cfg = self._mod, self.model_cfg
+    def _make_decode_fn(self, batch: int, draft: bool = False):
+        mod = self._mod
+        cfg = self.draft_cfg if draft else self.model_cfg
 
         def fn(variables, tokens, positions, k_pages, v_pages,
                page_table):
             return mod.decode_step(variables, cfg, tokens, positions,
                                    k_pages, v_pages, page_table)
 
-        fn.__name__ = f"llm_decode_b{batch}"
+        fn.__name__ = f"llm_{'draft_' if draft else ''}decode_b{batch}"
         return fn
+
+    def _make_chunk_fn(self, width: int, tag: str, draft: bool = False):
+        mod = self._mod
+        cfg = self.draft_cfg if draft else self.model_cfg
+
+        def fn(variables, tokens, start, k_pages, v_pages, page_table):
+            return mod.chunk_step(variables, cfg, tokens, start,
+                                  k_pages, v_pages, page_table)
+
+        fn.__name__ = f"llm_{tag}_c{width}"
+        return fn
+
+    def _make_verify_fn(self, batch: int, width: int):
+        mod, cfg = self._mod, self.model_cfg
+
+        def fn(variables, tokens, start, k_pages, v_pages, page_table):
+            return mod.chunk_step(variables, cfg, tokens, start,
+                                  k_pages, v_pages, page_table)
+
+        fn.__name__ = f"llm_verify_b{batch}_c{width}"
+        return fn
+
+    def _note_call(self, kind: str, bucket: int):
+        """Per-(kind, bucket) dispatch counter — one row per compiled
+        executable actually exercised."""
+        with self._lock:
+            key = (kind, bucket)
+            self.bucket_calls[key] = self.bucket_calls.get(key, 0) + 1
 
     def warmup(self):
         """Compile every bucket up front so steady state is all cache
@@ -324,6 +464,32 @@ class LLMEngine:
                np.zeros(b, np.int32), np.zeros(b, np.int32),
                self.kv.k_pages, self.kv.v_pages,
                np.zeros((b, self.max_pages_per_seq), np.int32))
+        self._chunk_fn(
+            self.params, np.zeros((1, self._chunk_size), np.int32),
+            np.zeros((1,), np.int32), self.kv.k_pages, self.kv.v_pages,
+            np.zeros((1, self.max_pages_per_seq), np.int32))
+        if self.kv_d is None:
+            return
+        K = self.config.spec_k
+        for s, fn in self._d_prefill_fns.items():
+            fn(self.draft_params, np.zeros((1, s), np.int32),
+               np.ones((1,), np.int32))
+        for b, fn in self._d_decode_fns.items():
+            fn(self.draft_params,
+               np.zeros(b, np.int32), np.zeros(b, np.int32),
+               self.kv_d.k_pages, self.kv_d.v_pages,
+               np.zeros((b, self.max_pages_per_seq_d), np.int32))
+        for b, fn in self._verify_fns.items():
+            fn(self.params, np.zeros((b, K + 1), np.int32),
+               np.zeros((b,), np.int32), self.kv.k_pages,
+               self.kv.v_pages,
+               np.zeros((b, self.max_pages_per_seq), np.int32))
+        self._d_chunk_fn(
+            self.draft_params,
+            np.zeros((1, self._chunk_size), np.int32),
+            np.zeros((1,), np.int32), self.kv_d.k_pages,
+            self.kv_d.v_pages,
+            np.zeros((1, self.max_pages_per_seq_d), np.int32))
 
     # -- submission -------------------------------------------------------
 
@@ -343,11 +509,14 @@ class LLMEngine:
                tenant: Optional[str] = None) -> Request:
         if not prompt:
             raise RequestRejected("empty prompt")
-        limit = max(self.config.prefill_buckets)
-        if len(prompt) > limit:
-            raise RequestRejected(
-                f"prompt of {len(prompt)} tokens exceeds the largest "
-                f"prefill bucket ({limit})")
+        if not self.config.prefill_chunk:
+            # chunked prefill off: a prompt must fit one prefill bucket
+            # (with chunking on, any prompt up to max_seq_len windows in)
+            limit = max(self.config.prefill_buckets)
+            if len(prompt) > limit:
+                raise RequestRejected(
+                    f"prompt of {len(prompt)} tokens exceeds the "
+                    f"largest prefill bucket ({limit})")
         total = len(prompt) + max_new_tokens
         if total > self.model_cfg.max_seq_len:
             raise RequestRejected(
@@ -383,19 +552,29 @@ class LLMEngine:
             t0 = time.perf_counter()
             prefill_ms = decode_ms = 0.0
             tokens_out = 0
+            advanced = False
             self._shed_expired()
             for _ in range(self.config.max_prefills_per_step):
-                req = self._admit_one()
-                if req is None:
+                if len(self._prefilling) < \
+                        self.config.max_prefills_per_step:
+                    self._admit_one()
+                if not self._prefilling:
                     break
                 t1 = time.perf_counter()
-                tokens_out += self._prefill(req)
+                # ONE chunk (or one-shot bucket prefill) per slot per
+                # step: a long prompt spreads across steps while decode
+                # below keeps running — the head-of-line fix
+                tokens_out += self._advance_prefill()
+                advanced = True
                 prefill_ms += (time.perf_counter() - t1) * 1e3
             if self._running:
                 t1 = time.perf_counter()
-                tokens_out += self._decode_once()
+                if self.kv_d is not None:
+                    tokens_out += self._spec_decode_once()
+                else:
+                    tokens_out += self._decode_once()
                 decode_ms += (time.perf_counter() - t1) * 1e3
-            did = bool(tokens_out)
+            did = bool(tokens_out) or advanced
             if did:
                 self._step_no += 1
                 with self._lock:
@@ -428,13 +607,17 @@ class LLMEngine:
             req._fail("deadline passed before admission")
             self._emit_request_record(req, "timed_out")
 
-    def _admit_one(self) -> Optional[Request]:
+    def _admit_one(self) -> Optional[_Sequence]:
         """Pop the oldest waiting request whose worst-case page demand
         fits right now (pages reserved up front: a running sequence can
-        never hit OutOfPages mid-decode)."""
+        never hit OutOfPages mid-decode). With the prefix cache on,
+        admission aliases the longest cached full-page prefix into the
+        new page table atomically with the remainder allocation — the
+        sequence then prefills only the uncached suffix."""
         with self._lock:
             if not self._waiting or \
-                    len(self._running) >= self.config.max_running:
+                    len(self._running) + len(self._prefilling) >= \
+                    self.config.max_running:
                 return None
             req = self._waiting[0]
             # queue phase ends at the FIRST admission consideration —
@@ -444,45 +627,182 @@ class LLMEngine:
                 req.first_consider_ts = time.monotonic()
             need = self.kv.pages_for_tokens(
                 len(req.prompt) + req.max_new_tokens)
+            cached = 0
             try:
-                pages = self.kv.alloc(need, req)
+                if self.prefix is not None:
+                    pages, cached = self.prefix.acquire(
+                        req.prompt, req, need)
+                else:
+                    pages = self.kv.alloc(need, req)
             except OutOfPagesError:
                 return None
+            d_pages = None
+            if self.kv_d is not None:
+                try:
+                    d_pages = self.kv_d.alloc(
+                        self.kv_d.pages_for_tokens(
+                            len(req.prompt) + req.max_new_tokens
+                            + self.config.spec_k), req)
+                except OutOfPagesError:
+                    self.kv.free(pages, req)
+                    return None
             req.admit_ts = time.monotonic()
             self._waiting.pop(0)
-        req._pages = pages
-        return req
+            seq = _Sequence(req, pages, pos=0, cached=cached,
+                            d_pages=d_pages)
+            self._prefilling.append(seq)
+        return seq
 
-    def _prefill(self, req: Request) -> int:
-        pages = req._pages
+    # -- prefill (one-shot bucket / chunked / prefix-cache suffix) --------
+
+    def _advance_prefill(self) -> int:
+        """Advance the oldest in-flight prefill by one unit of work:
+        a one-shot bucket prefill when the whole prompt fits (the PR-7
+        fast path, preserved bit-for-bit), otherwise one chunk of the
+        target prompt, then — with speculation on — one chunk of the
+        draft model's own prefill. Returns tokens emitted (1 exactly
+        when target prefill completes: the first token comes from the
+        final chunk's logits, so TTFT lands before the draft finishes
+        warming)."""
+        seq = self._prefilling[0]
+        req = seq.req
+        s = len(req.prompt)
+        emitted = 0
+        t0 = time.perf_counter()
+        if seq.prefilled < s:
+            oneshot = (seq.prefilled == 0
+                       and s <= max(self.config.prefill_buckets)
+                       and (not self.config.prefill_chunk
+                            or s <= self._chunk_size))
+            if oneshot:
+                emitted = self._prefill_oneshot(seq)
+            else:
+                emitted = self._chunk_advance(seq)
+        elif self.kv_d is not None and seq.d_prefilled < s:
+            self._draft_prefill_advance(seq)
+        req.prefill_ms += (time.perf_counter() - t0) * 1e3
+        ready = seq.prefilled >= s and \
+            (self.kv_d is None or seq.d_prefilled >= s)
+        if ready or seq.req.done.is_set():
+            with self._lock:
+                if seq in self._prefilling:
+                    self._prefilling.remove(seq)
+            if not seq.req.done.is_set():
+                with self._lock:
+                    self._running.append(seq)
+        return emitted
+
+    def _emit_first(self, seq: _Sequence, next_logits_row) -> int:
+        """Emit the prompt's next token; on finish, release everything
+        (a one-token request never reaches the running set)."""
+        tok = int(np.argmax(np.asarray(next_logits_row)))
+        seq.req._emit(tok)
+        if self._seq_finished(seq, tok):
+            self._finish(seq)
+        return 1
+
+    def _prefill_oneshot(self, seq: _Sequence) -> int:
+        req = seq.req
         s = len(req.prompt)
         bucket = min(b for b in self.config.prefill_buckets if b >= s)
         attrs: Dict[str, Any] = {"bucket": bucket, "tokens_in": s}
         if req.ctx:
             attrs["req_id"] = req.ctx["req_id"]
             attrs["flow_id"] = f"req:{req.ctx['req_id']}"
-        t0 = time.perf_counter()
         with _tracing.span("llm.prefill", kind="consumer", attrs=attrs):
             toks = np.zeros((1, bucket), np.int32)
             toks[0, :s] = req.prompt
+            self._note_call("prefill", bucket)
             next_logits, k, v = self._prefill_fns[bucket](
                 self.params, toks, np.asarray([s], np.int32))
-            self.kv.write_prefill(pages, np.asarray(k[0]),
+            self.kv.write_prefill(seq.pages, np.asarray(k[0]),
                                   np.asarray(v[0]), s)
-            seq = _Sequence(req, pages, pos=s)
+            seq.prefilled = s
+            seq.pos = s
+            if self.prefix is not None:
+                self.prefix.insert(req.prompt, seq.pages)
             with self._lock:
                 self.counters["prefill_steps"] += 1
-            tok = int(np.argmax(np.asarray(next_logits[0])))
-            req._emit(tok)
-        # prefill phase ends at the first-token emit; the decode phase
-        # (first-token -> last-token) starts there, so the phases tile
-        req.prefill_ms = (time.perf_counter() - t0) * 1e3
-        if self._seq_finished(seq, tok):
-            self._finish(seq)
-        else:
+            return self._emit_first(seq, next_logits[0])
+
+    def _chunk_advance(self, seq: _Sequence) -> int:
+        """One target-model chunk: forward the next `_chunk_size`
+        prompt tokens against the pages filled so far (prefix-cache
+        hits enter here with `prefilled == cached > 0`, so the cached
+        pages are attended but never recomputed)."""
+        req = seq.req
+        s = len(req.prompt)
+        c = self._chunk_size
+        take = min(c, s - seq.prefilled)
+        toks = np.zeros((1, c), np.int32)
+        toks[0, :take] = req.prompt[seq.prefilled:seq.prefilled + take]
+        table = np.zeros((1, self.max_pages_per_seq), np.int32)
+        table[0, :len(seq.pages)] = seq.pages
+        attrs: Dict[str, Any] = {"chunk": c, "start": seq.prefilled,
+                                 "tokens_in": take}
+        if req.ctx:
+            attrs["req_id"] = req.ctx["req_id"]
+            attrs["flow_id"] = f"req:{req.ctx['req_id']}"
+        with _tracing.span("llm.prefill_chunk", kind="consumer",
+                           attrs=attrs):
+            self._note_call("chunk", c)
+            logits, k, v = self._chunk_fn(
+                self.params, toks,
+                np.asarray([seq.prefilled], np.int32),
+                self.kv.k_pages, self.kv.v_pages, table)
+            self.kv.write_prefill(seq.pages, np.asarray(k[0, :take]),
+                                  np.asarray(v[0, :take]), take,
+                                  start=seq.prefilled)
+            seq.prefilled += take
             with self._lock:
-                self._running.append(seq)
-        return 1
+                self.counters["chunk_steps"] += 1
+            if seq.prefilled < s:
+                return 0
+            seq.pos = s
+            if self.prefix is not None:
+                self.prefix.insert(req.prompt, seq.pages)
+            with self._lock:
+                self.counters["prefill_steps"] += 1
+            return self._emit_first(seq, logits[0, take - 1])
+
+    def _draft_prefill_advance(self, seq: _Sequence):
+        """Warm the draft model's private KV for this sequence. The
+        draft never sees the prefix cache (its pages are per-sequence),
+        so it always processes the full prompt — one bucket forward
+        when the prompt fits, else one chunk per step."""
+        req = seq.req
+        s = len(req.prompt)
+        if seq.d_prefilled == 0 and \
+                s <= max(self.config.prefill_buckets):
+            bucket = min(b for b in self.config.prefill_buckets
+                         if b >= s)
+            toks = np.zeros((1, bucket), np.int32)
+            toks[0, :s] = req.prompt
+            self._note_call("draft_prefill", bucket)
+            _, k, v = self._d_prefill_fns[bucket](
+                self.draft_params, toks, np.asarray([s], np.int32))
+            self.kv_d.write_prefill(seq.d_pages, np.asarray(k[0]),
+                                    np.asarray(v[0]), s)
+            seq.d_prefilled = s
+        else:
+            c = self._chunk_size
+            take = min(c, s - seq.d_prefilled)
+            toks = np.zeros((1, c), np.int32)
+            toks[0, :take] = \
+                req.prompt[seq.d_prefilled:seq.d_prefilled + take]
+            table = np.zeros((1, self.max_pages_per_seq_d), np.int32)
+            table[0, :len(seq.d_pages)] = seq.d_pages
+            self._note_call("draft_chunk", c)
+            _, k, v = self._d_chunk_fn(
+                self.draft_params, toks,
+                np.asarray([seq.d_prefilled], np.int32),
+                self.kv_d.k_pages, self.kv_d.v_pages, table)
+            self.kv_d.write_prefill(seq.d_pages,
+                                    np.asarray(k[0, :take]),
+                                    np.asarray(v[0, :take]), take,
+                                    start=seq.d_prefilled)
+            seq.d_prefilled += take
+        seq.d_pos = seq.d_prefilled
 
     def _decode_once(self) -> int:
         with self._lock:
@@ -496,6 +816,7 @@ class LLMEngine:
             tokens[i] = seq.last_token
             positions[i] = seq.pos
             page_table[i, :len(seq.pages)] = seq.pages
+        self._note_call("decode", bb)
         logits, new_k, new_v = self._decode_fns[bb](
             self.params, tokens, positions,
             self.kv.k_pages, self.kv.v_pages, page_table)
@@ -516,6 +837,124 @@ class LLMEngine:
             self._finish(seq)
         return len(runs)
 
+    def _spec_decode_once(self) -> int:
+        """One speculative round over the running set (Leviathan et al.
+        '23, greedy case): the draft proposes K tokens per sequence
+        autoregressively, the target scores all K+1 positions in ONE
+        chunk forward, and the longest proposal prefix that matches the
+        target's own argmaxes is accepted — plus the target's next
+        token after the divergence, so every round emits >= 1 token and
+        the emitted stream is exactly plain greedy's, token for token.
+
+        All lanes run the draft loop in lockstep: `max_gap + K` draft
+        decode dispatches per round, where gap is each lane's catch-up
+        deficit (0 or 1 — a fully-accepted round leaves the draft one
+        committed token behind). Lanes past their own `gap + K` budget
+        idle inside the batch (their lane computes garbage that is
+        neither appended nor read), so the dispatch count varies only
+        host-side — every dispatch is the same (batch-bucket) decode
+        executable and the verify window is always K+1 wide: accept-
+        length variation can not retrace anything.
+        """
+        K = self.config.spec_k
+        with self._lock:
+            runs = list(self._running)
+        n = len(runs)
+        bb = min(b for b in self.config.batch_buckets if b >= n)
+        full = [seq.req.prompt + seq.req.tokens for seq in runs]
+        gaps = [seq.pos - seq.d_pos for seq in runs]
+        cur = [seq.d_pos for seq in runs]
+        budget = [g + K for g in gaps]
+        proposals: List[List[int]] = [[] for _ in range(n)]
+        d_table = np.zeros((bb, self.max_pages_per_seq_d), np.int32)
+        for i, seq in enumerate(runs):
+            d_table[i, :len(seq.d_pages)] = seq.d_pages
+        n_steps = max(budget)
+        for t in range(n_steps):
+            toks = np.zeros(bb, np.int32)
+            poss = np.zeros(bb, np.int32)
+            active = []
+            for i, seq in enumerate(runs):
+                if t >= budget[i]:
+                    continue  # lane idle: feed zeros, discard output
+                active.append(i)
+                idx = cur[i]
+                if idx < len(full[i]):
+                    toks[i] = full[i][idx]  # committed token (catch-up
+                    # or the round's first proposal input)
+                else:
+                    toks[i] = proposals[i][idx - len(full[i])]
+                poss[i] = idx
+            self._note_call("draft_decode", bb)
+            d_logits, d_k, d_v = self._d_decode_fns[bb](
+                self.draft_params, toks, poss,
+                self.kv_d.k_pages, self.kv_d.v_pages, d_table)
+            d_logits = np.asarray(d_logits)
+            d_k = np.asarray(d_k)
+            d_v = np.asarray(d_v)
+            for i in active:
+                self.kv_d.append(runs[i].d_pages, cur[i],
+                                 d_k[i], d_v[i])
+                cur[i] += 1
+                if cur[i] > runs[i].pos:  # past catch-up: a proposal
+                    proposals[i].append(int(np.argmax(d_logits[i])))
+        # verify: target scores [last_committed, d_1..d_K] at positions
+        # pos..pos+K in one window
+        v_toks = np.zeros((bb, K + 1), np.int32)
+        v_start = np.zeros(bb, np.int32)
+        v_table = np.zeros((bb, self.max_pages_per_seq), np.int32)
+        for i, seq in enumerate(runs):
+            v_toks[i, 0] = seq.last_token
+            v_toks[i, 1:] = proposals[i][:K]
+            v_start[i] = seq.pos
+            v_table[i, :len(seq.pages)] = seq.pages
+        self._note_call("verify", bb)
+        logits, new_k, new_v = self._verify_fns[bb](
+            self.params, v_toks, v_start,
+            self.kv.k_pages, self.kv.v_pages, v_table)
+        logits = np.asarray(logits)
+        new_k = np.asarray(new_k)
+        new_v = np.asarray(new_v)
+        tokens_out = 0
+        finished = []
+        for i, seq in enumerate(runs):
+            greedy = [int(np.argmax(logits[i, j])) for j in range(K + 1)]
+            a = 0  # accepted proposals: d_{j+1} must equal g_j
+            while a < K and proposals[i][a] == greedy[a]:
+                a += 1
+            # emit g_0..g_a; stop early on EOS / length (plain greedy
+            # would have stopped at the same token)
+            emitted = 0
+            fin = False
+            for j in range(a + 1):
+                seq.req._emit(greedy[j])
+                emitted += 1
+                if self._seq_finished(seq, greedy[j]):
+                    fin = True
+                    break
+            tokens_out += emitted
+            with self._lock:
+                self.counters["spec_proposed"] += K
+                self.counters["spec_accepted"] += a
+            if fin:
+                finished.append(seq)
+                continue
+            # commit KV: verify rows 0..emitted-1 hold exactly the
+            # committed tokens' K/V ([last, d_1..d_a] == [last,
+            # g_0..g_{a-1}]); the draft cache is correct through
+            # pos + min(a+1, K) (it never saw g_a when a == K)
+            self.kv.write_prefill(seq.pages, new_k[i, :emitted],
+                                  new_v[i, :emitted], emitted,
+                                  start=seq.pos)
+            seq.d_pos = seq.pos + min(a + 1, K)
+            seq.pos += emitted
+        with self._lock:
+            self.counters["decode_steps"] += 1
+            self.counters["spec_rounds"] += 1
+        for seq in finished:
+            self._finish(seq)
+        return tokens_out
+
     def _seq_finished(self, seq: _Sequence, tok: int) -> bool:
         if seq.n_generated >= seq.req.max_new_tokens:
             seq.req.finish_reason = "length"
@@ -527,7 +966,11 @@ class LLMEngine:
         return False
 
     def _finish(self, seq: _Sequence):
+        # refcounted free: pages the prefix cache (or a sibling
+        # sequence) still aliases survive this — only the refcount drops
         self.kv.free(seq.pages, seq.req)
+        if seq.d_pages is not None:
+            self.kv_d.free(seq.d_pages, seq.req)
         with self._lock:
             if seq in self._running:
                 self._running.remove(seq)
@@ -597,7 +1040,8 @@ class LLMEngine:
 
     def has_work(self) -> bool:
         with self._lock:
-            return bool(self._waiting or self._running)
+            return bool(self._waiting or self._prefilling
+                        or self._running)
 
     def run_until_idle(self, timeout: float = 60.0):
         """Drive the engine inline (no pump thread) until drained."""
@@ -624,6 +1068,8 @@ class LLMEngine:
         with self._step_lock:
             pass
         self.kv.assert_quiesced()
+        if self.kv_d is not None:
+            self.kv_d.assert_quiesced()
 
     def shutdown(self) -> int:
         """Stop the pump and drop the KV arena; returns leaked pages
@@ -636,22 +1082,52 @@ class LLMEngine:
             self._emit_request_record(req, "failed")
         _metrics.DEFAULT_REGISTRY.register_callback(
             "serve_llm", lambda: "")
-        return self.kv.close()
+        if self.prefix is not None:
+            # cached prefixes are reusable state, not leaks: release
+            # them so close() reports only true sequence leaks
+            self.prefix.drain()
+        leaked = 0
+        if self.kv_d is not None:
+            leaked += self.kv_d.close()
+        return leaked + self.kv.close()
 
     def metrics(self) -> Dict[str, Any]:
         with self._lock:
             out = dict(self.counters)
             out.update(
                 queue_depth=len(self._waiting),
+                prefilling=len(self._prefilling),
                 running=len(self._running),
                 kv_pages_live=self.kv.live_pages,
+                kv_pages_cached=self.kv.cached_pages,
                 kv_pages_total=self.kv.num_pages,
                 kv_page_utilization=self.kv.utilization(),
                 kv_arena_id=self.kv.arena_id_hex,
                 model=self.model_name,
+                spec_k=self.config.spec_k,
+                compiled_step_calls={
+                    f"{kind}:{bucket}": calls
+                    for (kind, bucket), calls in
+                    sorted(self.bucket_calls.items())},
                 tenants={t: dict(row)
                          for t, row in self.tenant_counters.items()},
             )
+        if self.prefix is not None:
+            ps = self.prefix.stats()
+            out.update(
+                prefix_cache_hit_tokens=ps["hit_tokens"],
+                prefix_cache_miss_tokens=ps["miss_tokens"],
+                prefix_cache_hits=ps["hits"],
+                prefix_cache_misses=ps["misses"],
+                prefix_cache_entries=ps["entries"],
+                prefix_cache_evicted=ps["evicted"],
+            )
+        if out["spec_proposed"]:
+            # mean accepted draft tokens per round (<= K); the bench
+            # artifact records this next to the A/B throughputs
+            out["spec_mean_accept"] = (
+                out["spec_accepted"] / out["spec_rounds"]
+                if out["spec_rounds"] else 0.0)
         return out
 
     def _metrics_text(self) -> str:
@@ -680,6 +1156,41 @@ class LLMEngine:
             "# TYPE serve_llm_decode_ms_total counter",
             f"serve_llm_decode_ms_total {m['decode_ms']:.3f}",
         ]
+        if "prefix_cache_hit_tokens" in m:
+            lines += [
+                "# TYPE serve_llm_prefix_cache_hit_tokens_total counter",
+                f"serve_llm_prefix_cache_hit_tokens_total "
+                f"{int(m['prefix_cache_hit_tokens'])}",
+                "# TYPE serve_llm_prefix_cache_miss_tokens_total counter",
+                f"serve_llm_prefix_cache_miss_tokens_total "
+                f"{int(m['prefix_cache_miss_tokens'])}",
+                "# TYPE serve_llm_prefix_cache_entries gauge",
+                f"serve_llm_prefix_cache_entries "
+                f"{int(m['prefix_cache_entries'])}",
+                "# TYPE serve_llm_kv_pages_cached gauge",
+                f"serve_llm_kv_pages_cached "
+                f"{int(m['kv_pages_cached'])}",
+            ]
+        if m.get("spec_k"):
+            lines += [
+                "# TYPE serve_llm_spec_proposed_total counter",
+                f"serve_llm_spec_proposed_total "
+                f"{int(m['spec_proposed'])}",
+                "# TYPE serve_llm_spec_accepted_total counter",
+                f"serve_llm_spec_accepted_total "
+                f"{int(m['spec_accepted'])}",
+                "# TYPE serve_llm_spec_rounds_total counter",
+                f"serve_llm_spec_rounds_total "
+                f"{int(m['spec_rounds'])}",
+            ]
+        if m.get("compiled_step_calls"):
+            lines.append(
+                "# TYPE serve_llm_compiled_step_calls_total counter")
+            for key, calls in m["compiled_step_calls"].items():
+                kind, bucket = key.rsplit(":", 1)
+                lines.append(
+                    f'serve_llm_compiled_step_calls_total'
+                    f'{{kind="{kind}",bucket="{bucket}"}} {calls}')
         # per-tenant rows: shed decisions + throughput per job label
         for tenant, row in sorted(m.get("tenants", {}).items()):
             for key in ("requests_submitted", "requests_completed",

@@ -9,10 +9,20 @@ page tables (gather indices) — growing a sequence never moves bytes,
 only appends a page id, so decode dispatch is copy-free on the host
 side.
 
-Accounting is strict: every page is either on the free list or owned by
-exactly one sequence, `free()` of a foreign/unallocated page raises, and
-`assert_quiesced()` proves zero live pages — the leak gate the engine
-(and the chaos replica-kill test) hold the plane to.
+Pages are REFCOUNTED: a page can be held by several sequences at once
+(copy-on-write shared-prefix reuse — see `PrefixCache`), and it returns
+to the free list only when its last holder releases it. Accounting is
+strict: every page is either on the free list or held by at least one
+owner, `free()` by a non-holder raises, and `assert_quiesced()` proves
+zero sequence-live pages — the leak gate the engine (and the chaos
+replica-kill test) hold the plane to. Pages held only by the prefix
+cache count as quiesced (they are reusable state, not leaks); draining
+the cache returns them all.
+
+Copy-on-write discipline: only FULL pages are ever shared (a partial
+page's tail is still being appended to), so a shared page is immutable
+by construction — aliasing is a page-table row edit plus a refcount,
+never a byte copy, and no writer ever touches a shared page.
 
 On a dead replica the arena is reclaimed store-side by id
 (`reclaim_arena`): the arena object is sealed at creation so peers on
@@ -24,7 +34,8 @@ a multi-node controller would route this through the owning raylet).
 from __future__ import annotations
 
 import threading
-from typing import Dict, List, Optional
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -85,7 +96,11 @@ class PagedKVCache:
         self.v_pages = self._arena[1]
         # LIFO free list: recently-freed pages are re-used first (warm)
         self._free: List[int] = list(range(num_pages - 1, -1, -1))
-        self._owner: Dict[int, object] = {}
+        # page -> holder list (refcount == len). A holder is a request/
+        # sequence object, or a _PrefixEntry when the prefix cache
+        # pinned the page for reuse.
+        self._holders: Dict[int, List[object]] = {}
+        self._prefix_cache: Optional["PrefixCache"] = None
         self._closed = False
 
     # -- allocation -------------------------------------------------------
@@ -105,42 +120,95 @@ class PagedKVCache:
 
     @property
     def live_pages(self) -> int:
+        """Pages held by at least one sequence (prefix-cache-only pages
+        are reusable state, not live work — see `cached_pages`)."""
         with self._lock:
-            return len(self._owner)
+            return sum(1 for hs in self._holders.values()
+                       if any(not isinstance(h, _PrefixEntry) for h in hs))
+
+    @property
+    def cached_pages(self) -> int:
+        """Pages held ONLY by the prefix cache (reusable on hit,
+        evictable under pressure)."""
+        with self._lock:
+            return sum(1 for hs in self._holders.values()
+                       if all(isinstance(h, _PrefixEntry) for h in hs))
 
     def utilization(self) -> float:
         with self._lock:
-            return len(self._owner) / self.num_pages
+            return len(self._holders) / self.num_pages
+
+    def page_refcount(self, page: int) -> int:
+        with self._lock:
+            return len(self._holders.get(page, ()))
 
     def pages_for_tokens(self, n_tokens: int) -> int:
         return -(-n_tokens // self.block_size)  # ceil div
 
     def alloc(self, n: int, owner) -> List[int]:
         """Take `n` pages for `owner`; raises OutOfPagesError when the
-        arena can't satisfy the request (nothing is partially taken)."""
+        arena can't satisfy the request (nothing is partially taken).
+        On shortfall, cold prefix-cache entries are evicted LRU-first
+        before giving up — cached prefixes never crowd out live work."""
         with self._lock:
-            self._check_open()
-            if n > len(self._free):
-                raise OutOfPagesError(
-                    f"need {n} pages, {len(self._free)} free "
-                    f"of {self.num_pages}")
-            pages = [self._free.pop() for _ in range(n)]
-            for p in pages:
-                self._owner[p] = owner
-            return pages
+            return self._alloc_locked(n, owner)
+
+    def _alloc_locked(self, n: int, owner) -> List[int]:
+        self._check_open()
+        if n > len(self._free) and self._prefix_cache is not None:
+            self._prefix_cache._evict_for_locked(n - len(self._free))
+        if n > len(self._free):
+            raise OutOfPagesError(
+                f"need {n} pages, {len(self._free)} free "
+                f"of {self.num_pages}")
+        pages = [self._free.pop() for _ in range(n)]
+        for p in pages:
+            self._holders[p] = [owner]
+        return pages
+
+    def share(self, pages: List[int], owner) -> None:
+        """Alias already-allocated pages into `owner`'s page table
+        (incref). The pages must be live; the same owner may not hold a
+        page twice (accounting bugs fail loudly)."""
+        with self._lock:
+            self._share_locked(pages, owner)
+
+    def _share_locked(self, pages: List[int], owner) -> None:
+        self._check_open()
+        for p in pages:
+            hs = self._holders.get(p)
+            if hs is None:
+                raise KVCacheError(f"share of free page {p}")
+            if any(h is owner for h in hs):
+                raise KVCacheError(
+                    f"share of page {p} already held by this owner")
+        for p in pages:
+            self._holders[p].append(owner)
 
     def free(self, pages: List[int], owner) -> None:
-        """Return pages to the free list; raises on double-free or a
-        page the owner doesn't hold (accounting bugs fail loudly)."""
+        """Release `owner`'s hold on each page; a page returns to the
+        free list only at refcount zero — a page still aliased by the
+        prefix cache or another running sequence survives the free.
+        Raises on double-free or a page the owner doesn't hold."""
         with self._lock:
-            self._check_open()
-            for p in pages:
-                if self._owner.get(p) is not owner:
-                    raise KVCacheError(
-                        f"free of page {p} not held by owner "
-                        f"(held by {self._owner.get(p)!r})")
-            for p in pages:
-                del self._owner[p]
+            self._free_locked(pages, owner)
+
+    def _free_locked(self, pages: List[int], owner) -> None:
+        self._check_open()
+        for p in pages:
+            hs = self._holders.get(p)
+            if hs is None or not any(h is owner for h in hs):
+                held = "free" if hs is None else f"held by {hs!r}"
+                raise KVCacheError(
+                    f"free of page {p} not held by owner ({held})")
+        for p in pages:
+            hs = self._holders[p]
+            for i, h in enumerate(hs):
+                if h is owner:
+                    del hs[i]
+                    break
+            if not hs:
+                del self._holders[p]
                 self._free.append(p)
 
     # -- data plane -------------------------------------------------------
@@ -151,52 +219,72 @@ class PagedKVCache:
         page = pages[pos // self.block_size]
         off = pos % self.block_size
         # data-plane writes are lock-free by design: the engine's step
-        # thread is the single writer, and a page belongs to exactly
-        # one sequence (the lock guards only the allocator maps)
+        # thread is the single writer, and an appendable (tail) page
+        # belongs to exactly one sequence — shared prefix pages are
+        # always full, so no write ever lands on an aliased page (the
+        # lock guards only the allocator maps)
         # raylint: disable=lock-discipline
         self.k_pages[page, :, off] = k
         # raylint: disable=lock-discipline
         self.v_pages[page, :, off] = v
 
-    def write_prefill(self, pages: List[int], k_seq, v_seq, n: int) -> None:
-        """Bulk-write a prefill's K/V ([seq, n_layer, n_kv_head,
-        head_dim]) for positions [0, n) across the sequence's pages."""
+    def write_prefill(self, pages: List[int], k_seq, v_seq, n: int,
+                      start: int = 0) -> None:
+        """Bulk-write a prefill's K/V ([n, n_layer, n_kv_head,
+        head_dim]) for positions [start, start+n) across the sequence's
+        pages (chunked prefill passes start > 0, which need not be
+        page-aligned)."""
         bs = self.block_size
         # arena page layout is [n_layer, block, kvh, hd]; the prefill
-        # slab is [seq, n_layer, kvh, hd] -> swap to [n_layer, seq, ...]
-        for start in range(0, n, bs):
-            stop = min(start + bs, n)
-            page = pages[start // bs]
+        # slab is [n, n_layer, kvh, hd] -> swap to [n_layer, n, ...]
+        done = 0
+        while done < n:
+            pos = start + done
+            page = pages[pos // bs]
+            off = pos % bs
+            take = min(bs - off, n - done)
             # single-writer data plane, same as append()
             # raylint: disable=lock-discipline
-            self.k_pages[page, :, :stop - start] = \
-                np.swapaxes(k_seq[start:stop], 0, 1)
+            self.k_pages[page, :, off:off + take] = \
+                np.swapaxes(k_seq[done:done + take], 0, 1)
             # raylint: disable=lock-discipline
-            self.v_pages[page, :, :stop - start] = \
-                np.swapaxes(v_seq[start:stop], 0, 1)
+            self.v_pages[page, :, off:off + take] = \
+                np.swapaxes(v_seq[done:done + take], 0, 1)
+            done += take
 
     # -- lifecycle --------------------------------------------------------
 
     def assert_quiesced(self) -> None:
+        """Prove zero sequence-live pages. Pages held only by the
+        prefix cache are quiesced state (drain the cache to release
+        them); any other holder is a leak."""
         with self._lock:
-            if self._owner:
+            live = {p: hs for p, hs in self._holders.items()
+                    if any(not isinstance(h, _PrefixEntry) for h in hs)}
+            if live:
+                owners = sorted({repr(h) for hs in live.values()
+                                 for h in hs
+                                 if not isinstance(h, _PrefixEntry)})
                 raise KVCacheError(
-                    f"KV page leak: {len(self._owner)} live pages at "
-                    f"quiesce (owners: "
-                    f"{sorted(set(map(repr, self._owner.values())))[:4]})")
-            if len(self._free) != self.num_pages:
+                    f"KV page leak: {len(live)} live pages at "
+                    f"quiesce (owners: {owners[:4]})")
+            if len(self._free) + len(self._holders) != self.num_pages:
                 raise KVCacheError(
-                    f"free-list corrupt: {len(self._free)} != "
-                    f"{self.num_pages}")
+                    f"free-list corrupt: {len(self._free)} free + "
+                    f"{len(self._holders)} held != {self.num_pages}")
 
     def close(self) -> int:
-        """Drop the arena. Returns the number of pages still live (0
-        when the engine quiesced cleanly)."""
+        """Drop the arena. Returns the number of pages still
+        sequence-live (0 when the engine quiesced cleanly; prefix-cache
+        holds are not leaks — `PrefixCache.drain()` first for a strict
+        zero-held close)."""
         with self._lock:
             if self._closed:
                 return 0
             self._closed = True
-            leaked = len(self._owner)
+            leaked = sum(1 for hs in self._holders.values()
+                         if any(not isinstance(h, _PrefixEntry)
+                                for h in hs))
             self.k_pages = self.v_pages = None
             self._arena = None
             if self._store is not None and self._arena_id is not None:
@@ -210,6 +298,151 @@ class PagedKVCache:
     def _check_open(self):
         if self._closed:
             raise KVCacheError("KV cache is closed")
+
+
+class _PrefixEntry:
+    """One cached full-page-aligned prompt prefix: the holder token for
+    its pages' prefix-cache refs."""
+
+    __slots__ = ("key", "pages", "hits")
+
+    def __init__(self, key: Tuple[int, ...], pages: List[int]):
+        self.key = key
+        self.pages = pages
+        self.hits = 0
+
+    def __repr__(self):
+        return f"PrefixEntry({len(self.pages)}p, hits={self.hits})"
+
+
+class PrefixCache:
+    """Copy-on-write shared-prefix page cache over a `PagedKVCache`.
+
+    Maps full-page-aligned prompt prefixes (keyed by the exact token
+    tuple — no hash collisions) to the page ids that hold their K/V.
+    Admission (`acquire`) aliases the longest matching cached prefix
+    into the new sequence's page table (incref, zero bytes copied) and
+    allocates only the pages the uncached suffix needs, so prefill
+    runs only past the cached boundary. The last prompt token is never
+    aliased (the engine needs its forward pass for next-token logits),
+    and a partial page is never cached (its tail is still appended to).
+
+    The lookup, the alias (incref), and the remainder allocation happen
+    under ONE lock hold — check-then-alias across a lock release would
+    race eviction (the raylint-pinned TOCTOU; see the fixture pair in
+    tests/test_raylint.py). Eviction is LRU and only triggered by arena
+    pressure: `PagedKVCache._alloc_locked` calls back into
+    `_evict_for_locked` on shortfall, releasing cold entries until the
+    allocation fits — pages another sequence still holds survive their
+    entry's eviction (refcounts, not force-frees).
+    """
+
+    def __init__(self, kv: PagedKVCache):
+        self.kv = kv
+        # ONE lock with the allocator: atomic lookup+alias+alloc
+        self._lock = kv._lock
+        self._entries: "OrderedDict[Tuple[int, ...], _PrefixEntry]" = \
+            OrderedDict()
+        self.counters: Dict[str, int] = {
+            "hits": 0, "misses": 0, "hit_tokens": 0, "miss_tokens": 0,
+            "inserted": 0, "evicted": 0,
+        }
+        kv._prefix_cache = self
+
+    # -- admission --------------------------------------------------------
+
+    def acquire(self, prompt: List[int], owner,
+                total_pages: int) -> Tuple[List[int], int]:
+        """Atomically: find the longest cached full-page prefix of
+        `prompt`, alias its pages to `owner`, and allocate the
+        remaining `total_pages - cached` fresh pages (evicting cold
+        entries on shortfall). Returns (page list, cached token count).
+        Raises OutOfPagesError leaving no partial state."""
+        block = self.kv.block_size
+        with self._lock:
+            # never alias the page holding the last prompt token: at
+            # least one suffix token must run prefill for next-logits
+            kmax = (len(prompt) - 1) // block
+            entry = None
+            k = 0
+            for kk in range(kmax, 0, -1):
+                e = self._entries.get(tuple(prompt[:kk * block]))
+                if e is not None:
+                    entry, k = e, kk
+                    break
+            cached = list(entry.pages) if entry is not None else []
+            # alias under the SAME hold as the lookup: a release here
+            # would let eviction free the entry before the incref lands
+            self.kv._share_locked(cached, owner)
+            try:
+                fresh = self.kv._alloc_locked(total_pages - k, owner)
+            except OutOfPagesError:
+                self.kv._free_locked(cached, owner)
+                raise
+            if entry is not None:
+                entry.hits += 1
+                self._entries.move_to_end(entry.key)
+                self.counters["hits"] += 1
+                self.counters["hit_tokens"] += k * block
+            else:
+                self.counters["misses"] += 1
+            self.counters["miss_tokens"] += len(prompt) - k * block
+            return cached + fresh, k * block
+
+    def insert(self, prompt: List[int], pages: List[int]) -> None:
+        """Register every full-page-aligned prefix of a just-prefilled
+        prompt (each becomes independently hittable/evictable). Only
+        FULL pages are cached — they are immutable from here on (decode
+        appends land in later pages), which is the whole copy-on-write
+        guarantee."""
+        block = self.kv.block_size
+        with self._lock:
+            if self.kv._closed:
+                return
+            kfull = len(prompt) // block
+            for kk in range(1, kfull + 1):
+                key = tuple(prompt[:kk * block])
+                if key in self._entries:
+                    continue
+                e = _PrefixEntry(key, list(pages[:kk]))
+                self.kv._share_locked(e.pages, e)
+                self._entries[key] = e
+                self.counters["inserted"] += 1
+
+    # -- eviction / lifecycle ---------------------------------------------
+
+    def _evict_for_locked(self, shortfall: int) -> None:
+        """Release cold entries LRU-first until `shortfall` pages came
+        free or nothing evictable remains (caller holds kv lock).
+        Releasing an entry frees only pages with no other holder."""
+        freed = 0
+        for key in list(self._entries):
+            if freed >= shortfall:
+                break
+            e = self._entries.pop(key)
+            before = len(self.kv._free)
+            self.kv._free_locked(e.pages, e)
+            freed += len(self.kv._free) - before
+            self.counters["evicted"] += 1
+
+    def drain(self) -> None:
+        """Release every cached prefix (shutdown path: after drain, a
+        quiesced cache closes with zero held pages)."""
+        with self._lock:
+            for key in list(self._entries):
+                e = self._entries.pop(key)
+                self.kv._free_locked(e.pages, e)
+
+    @property
+    def entries(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            out = dict(self.counters)
+            out["entries"] = len(self._entries)
+            return out
 
 
 def reclaim_arena(arena_id_hex: str, store=None) -> bool:

@@ -664,6 +664,97 @@ def test_chaos_llm_replica_kill_midstream():
         ray_tpu.shutdown()
 
 
+def test_chaos_llm_replica_kill_midstream_spec_prefix():
+    """Mid-stream replica kill with SPECULATIVE DECODING and the
+    shared-prefix cache both on, drafting with an independent (smaller)
+    model. The failover replay contract must survive the fast path:
+    greedy speculative decode is bit-identical to plain greedy and the
+    draft inits from the shared seed, so the survivor's resumed stream
+    is the SAME stream even though its prefill rides aliased
+    prefix-cache pages and its decode rides the verify window."""
+    from ray_tpu import serve
+    from ray_tpu.serve.llm import LLMDeployment
+
+    ray_tpu.init(num_cpus=8, num_tpus=0,
+                 object_store_memory=256 * 1024 * 1024)
+    try:
+        class SlowLLM(LLMDeployment):
+            def generate(self, prompt, max_new_tokens=16,
+                         timeout_s=None):
+                for chunk in LLMDeployment.generate(
+                        self, prompt, max_new_tokens, timeout_s):
+                    time.sleep(0.05)
+                    yield chunk
+
+        # small buckets keep warmup (target + draft + verify fns) well
+        # under the controller's 10 s liveness-poll timeout; the
+        # chunked-prefill window lets the 36-token prompt through the
+        # 16-token top bucket
+        app = serve.deployment(name="llm", num_replicas=2)(
+            SlowLLM).bind(
+                seed=0,
+                engine_config={"spec_k": 2, "prefix_cache": 1,
+                               "prefill_chunk": 8, "block_size": 4,
+                               "batch_buckets": (1, 2),
+                               "prefill_buckets": (8, 16)},
+                draft_config={"vocab_size": 512, "max_seq_len": 128,
+                              "n_layer": 1, "n_head": 4,
+                              "n_kv_head": 2, "d_model": 64})
+        handle = serve.run(app)
+        ctrl = ray_tpu.get_actor("SERVE_CONTROLLER")
+        ray_tpu.get(ctrl.reconcile_now.remote(), timeout=60)
+
+        # a 36-token prompt spans 8 full KV pages (block 4): prime
+        # BOTH replicas' prefix caches so wherever the failed-over
+        # stream replays, its prefill aliases cached pages
+        rng = np.random.RandomState(18)
+        prompt = [int(t) for t in rng.randint(1, 500, size=36)]
+        n_tokens = 24
+        for _ in range(4):
+            handle.generate_once.remote(prompt, 4).result(timeout=120)
+
+        gen = handle.generate.options(stream=True).remote(
+            prompt, n_tokens)
+        tokens = [next(gen)["token"] for _ in range(4)]
+
+        info = ray_tpu.get(ctrl.get_replicas.remote("llm"), timeout=30)
+        serving = None
+        for r in info["replicas"]:
+            m = ray_tpu.get(r.get_metrics.remote(), timeout=30)
+            assert m.get("spec_k") == 2.0  # spec plane live on both
+            if m["ongoing"] >= 1 and serving is None:
+                serving = r
+        assert serving is not None
+        ray_tpu.kill(serving)
+
+        for chunk in gen:                  # survivor replays + resumes
+            tokens.append(chunk["token"])
+        assert len(tokens) == n_tokens
+
+        rerun = handle.generate_once.remote(prompt, n_tokens).result(
+            timeout=120)
+        assert tokens == rerun             # failed-over stream lost nothing
+
+        # the survivor really took the fast path: speculative rounds
+        # ran and its prefill aliased the primed prefix pages (the
+        # controller may not have reconciled the death yet, so polls
+        # can still hit the corpse — skip it)
+        info = ray_tpu.get(ctrl.get_replicas.remote("llm"), timeout=30)
+        live = []
+        for r in info["replicas"]:
+            try:
+                live.append(ray_tpu.get(r.get_metrics.remote(),
+                                        timeout=30))
+            except Exception:
+                pass
+        live = [m for m in live if m.get("spec_k")]
+        assert any(m.get("spec_mean_accept", 0) > 0 for m in live)
+        assert any(m.get("prefix_cache_hit_rate", 0) > 0 for m in live)
+    finally:
+        serve.shutdown()
+        ray_tpu.shutdown()
+
+
 # ---------------------------------------------------------------------------
 # timed wall-clock fault schedules (`at=` grammar) + post-mortem replay
 # ---------------------------------------------------------------------------

@@ -975,6 +975,78 @@ class TestRefTableLockDiscipline:
         assert "lock-discipline" not in checks_of(findings), findings
 
 
+class TestPrefixCacheRefcountLockDiscipline:
+    """Pins the serve.llm prefix-cache aliasing contract: the cache
+    lookup and the page incref must happen under ONE hold of the arena
+    lock. A lookup that releases the lock before aliasing races
+    eviction — the LRU can free the matched pages in the gap, and the
+    new sequence increfs (and then reads) pages already handed to
+    another owner. Same TOCTOU shape as the ref-table pair above, on
+    the KV-page refcount table."""
+
+    BAD = """
+        import threading
+
+        class PrefixCache:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._entries = {}
+                self._refs = {}
+
+            def insert(self, key, pages):
+                with self._lock:
+                    self._entries[key] = pages
+                    for p in pages:
+                        self._refs[p] = self._refs.get(p, 0) + 1
+
+            def acquire(self, key):
+                with self._lock:
+                    pages = self._entries.get(key)
+                if pages is None:
+                    return None
+                # raced: eviction between release and here freed pages
+                for p in pages:
+                    self._refs[p] = self._refs.get(p, 0) + 1
+                return pages
+    """
+
+    GOOD = """
+        import threading
+
+        class PrefixCache:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._entries = {}
+                self._refs = {}
+
+            def insert(self, key, pages):
+                with self._lock:
+                    self._entries[key] = pages
+                    for p in pages:
+                        self._refs[p] = self._refs.get(p, 0) + 1
+
+            def acquire(self, key):
+                with self._lock:
+                    pages = self._entries.get(key)
+                    if pages is None:
+                        return None
+                    for p in pages:
+                        self._refs[p] = self._refs.get(p, 0) + 1
+                    return pages
+    """
+
+    def test_check_then_alias_across_release_flagged(self):
+        findings = run(self.BAD)
+        assert any(f.check == "lock-discipline"
+                   and f.detail == "attr:_refs"
+                   and f.scope == "PrefixCache.acquire"
+                   for f in findings), findings
+
+    def test_lookup_and_incref_under_one_hold_clean(self):
+        findings = run(self.GOOD)
+        assert "lock-discipline" not in checks_of(findings), findings
+
+
 # ---------------------------------------------------------------------------
 # suppression + baseline
 # ---------------------------------------------------------------------------
