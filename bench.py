@@ -1357,6 +1357,8 @@ def bench_serve_llm():
     # -- shared-prefix + speculative A/B (ISSUE 18) ----------------------
     ab = _serve_llm_shared_prefix_ab(scale)
     detail["shared_prefix_ab"] = ab["detail"]
+    # -- native-intake sub-phase (ISSUE 19) ------------------------------
+    detail["native_intake"] = _serve_llm_native_intake(scale)
 
     return {
         "serve_llm": detail,
@@ -1528,6 +1530,267 @@ def _serve_llm_shared_prefix_ab(scale: dict) -> dict:
         "detail": out_detail,
         "cache_tokens_per_s": rates["cache"],
         "spec_tokens_per_s": rates["cache_spec"],
+    }
+
+
+def _serve_llm_native_intake(scale: dict) -> dict:
+    """Native-intake sub-phase (ISSUE 19): the serve.llm zero-Python
+    dispatch path in one process — raw token-id request frames enqueued
+    through the native ring (mint + deadline + choice in C), the engine
+    pump draining them batch-at-a-time ahead of step(), token frames
+    flowing back through the client response plane. Gates: recorder
+    attribution must survive the native path (engine records carry the
+    NATIVELY-minted 16-hex trace ids and their phase sums tile e2e to
+    within 5%), the native streams are bit-identical to the same
+    engine's direct submit() path (greedy determinism), and the ring's
+    inflight counters balance to zero at quiesce."""
+    import statistics
+
+    import numpy as np
+
+    from ray_tpu.serve import dispatch as _dispatch
+    from ray_tpu.serve.llm import EngineConfig, LLMEngine
+    from ray_tpu.util import request_recorder as rr
+
+    if _dispatch._load() is None:
+        return {"skipped": "native dispatch library unavailable"}
+
+    n_requests = scale.get("llm_native_requests", 24)
+    max_new = 8
+    rng = np.random.RandomState(11)
+    prompts = [[int(t) for t in rng.randint(3, 500, size=1 + i % 8)]
+               for i in range(8)]
+
+    eng = LLMEngine(
+        model="llama",
+        engine_config=EngineConfig(batch_buckets=(1, 2, 4),
+                                   prefill_buckets=(8, 16)),
+        seed=0)
+    eng.warmup()
+    eng.start()
+    # reference streams: the ordinary Python submit() path on the SAME
+    # engine — greedy decode makes each prompt's stream deterministic
+    expect = [eng.submit(p, max_new).result(timeout=300) for p in prompts]
+
+    seg = f"/rtds.bench{os.getpid():x}"
+    ring = _dispatch.DispatchRing(seg, table_cap=2, slots=256,
+                                  slot_bytes=1024)
+    rec_was = rr.enabled()
+    rr.set_enabled(True)
+    rr.clear()
+    try:
+        cookie = 0x5eed
+        ring.publish(1, [cookie])
+        eng.attach_intake(ring, ring.ring_of(cookie), "llm-native")
+        plane = _dispatch.ClientPlane.get()
+        traces = []
+        native: dict = {}
+        start = time.perf_counter()
+        for i in range(n_requests):
+            payload = _dispatch.encode_llm_request(
+                prompts[i % len(prompts)], max_new, "bench")
+            trace, _rid, _gen = ring.enqueue(payload, client=plane.cookie)
+            mailbox = plane.register(trace)
+            traces.append(trace)
+            toks = []
+            while True:
+                f = mailbox.q.get(timeout=300)
+                if f.tag == _dispatch.TAG_TOKEN:
+                    toks.append(_dispatch._LLM_TOK.unpack(f.payload)[1])
+                elif f.tag == _dispatch.TAG_DONE:
+                    break
+                else:
+                    raise RuntimeError(
+                        f.payload.decode("utf-8", "replace"))
+            plane.unregister(trace)
+            native[i % len(prompts)] = toks
+        elapsed = time.perf_counter() - start
+        eng.quiesce(timeout=60)
+
+        for j, toks in native.items():
+            if toks != expect[j]:
+                raise RuntimeError(
+                    "native intake stream diverged from the Python "
+                    f"submit() path for prompt {j}")
+
+        # recorder attribution: every native request's engine record is
+        # keyed by the natively-minted trace id (16-hex wire format)
+        native_ids = {_dispatch.format_trace(t) for t in traces}
+        recs = [r for r in rr.ring().recent()
+                if r.role == "engine" and r.outcome == "ok"
+                and r.total_ms > 0 and r.req_id in native_ids]
+        if len(recs) < n_requests:
+            raise RuntimeError(
+                f"only {len(recs)}/{n_requests} native requests "
+                "stitched into engine-role records")
+        ratio = statistics.median(
+            r.phase_sum_ms() / r.total_ms for r in recs)
+        if abs(ratio - 1.0) > 0.05:
+            raise RuntimeError(
+                "native-path phase attribution broken: median "
+                f"phase-sum/e2e ratio {ratio:.3f} outside [0.95, 1.05]")
+
+        _ver, rows = ring.snapshot()
+        inflight = sum(row[2] for row in rows)
+        if inflight:
+            raise RuntimeError(
+                f"{inflight} inflight frames leaked at quiesce")
+        s = ring.stats()
+        tokens = sum(len(t) for t in native.values()) * (
+            n_requests // len(prompts))
+        return {
+            "requests": n_requests,
+            "elapsed_s": round(elapsed, 2),
+            "tokens_per_s": round(
+                n_requests * max_new / elapsed, 2),
+            "frames_enqueued": int(s["enqueued"]),
+            "frames_per_drain_batch": round(
+                s["drained"] / max(1, s["drain_batches"]), 2),
+            "recorded_native_requests": len(recs),
+            "phase_sum_over_e2e_p50": round(ratio, 4),
+            "tokens_checked": tokens,
+        }
+    finally:
+        rr.set_enabled(rec_was)
+        eng.shutdown()
+        ring.close(unlink=True)
+
+
+def _dispatch_ring_frames(deployment: str) -> int:
+    """Frames natively enqueued for a deployment's dispatch domain (0
+    when the domain segment does not exist — the Python-path arm)."""
+    from ray_tpu.serve import dispatch as _dispatch
+
+    try:
+        ring = _dispatch.DispatchRing(
+            _dispatch.domain_segment(deployment), create=False)
+    except Exception:  # noqa: BLE001
+        return 0
+    try:
+        return int(ring.stats()["enqueued"])
+    finally:
+        ring.close()
+
+
+def bench_serve_dispatch():
+    """Dispatch plane v2 A/B (ISSUE 19): the same echo deployment and
+    closed-loop clients, once over the native request ring
+    (RAY_TPU_NATIVE_DISPATCH=1: mint + deadline + pow-2 choice on raw
+    frames in C, Python entered once per batch) and once over the
+    Python handle path (flag off — bit-for-bit the pre-PR path, kept as
+    the fallback). Gates: the native arm must actually go native (the
+    domain ring's frame counter advances), a fixed probe set returns
+    bit-identical outputs in both arms, and on a multi-core box the
+    native arm clears >=5x the Python-path request rate at p99 parity.
+    On a 1-core box both arms timeshare one core with the replicas and
+    the controller, so the ring's syscall/pickle wins drown in
+    scheduler churn — the 5x target is noted, not fatal (README 1-core
+    caveat); the full-scale artifact run proves it on real hardware."""
+    import statistics
+
+    import ray_tpu
+    from ray_tpu import serve
+
+    scale = _scale_overrides()
+    ncpu = os.cpu_count() or 1
+    duration = scale.get("dispatch_ab_seconds", 4)
+    n_clients = scale.get("dispatch_ab_clients", min(8, 2 * ncpu))
+    probe_n = 32
+
+    def run_arm(native: bool) -> dict:
+        os.environ["RAY_TPU_NATIVE_DISPATCH"] = "1" if native else "0"
+        ray_tpu.init(num_cpus=max(4, ncpu), num_tpus=0,
+                     object_store_memory=128 * 1024 * 1024)
+        try:
+            @serve.deployment(num_replicas=2, max_ongoing_requests=64)
+            class DispatchEcho:
+                def __call__(self, x):
+                    return x * 2
+
+            handle = serve.run(DispatchEcho.bind())
+            for i in range(64):  # warm: replicas up, rings attached
+                handle.remote(i).result(timeout=60)
+            probe = [handle.remote(i).result(timeout=60)
+                     for i in range(probe_n)]
+            frames0 = _dispatch_ring_frames("DispatchEcho")
+            lat: list = []
+            lat_lock = threading.Lock()
+            stop = time.perf_counter() + duration
+
+            def client():
+                mine = []
+                while time.perf_counter() < stop:
+                    t0 = time.perf_counter()
+                    handle.remote(1).result(timeout=60)
+                    mine.append(time.perf_counter() - t0)
+                with lat_lock:
+                    lat.extend(mine)
+
+            threads = [threading.Thread(target=client, daemon=True)
+                       for _ in range(n_clients)]
+            t_start = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            elapsed = time.perf_counter() - t_start
+            frames = _dispatch_ring_frames("DispatchEcho") - frames0
+            lat.sort()
+            return {
+                "probe": probe,
+                "requests": len(lat),
+                "per_s": len(lat) / elapsed,
+                "p50_ms": 1e3 * statistics.median(lat),
+                "p99_ms": 1e3 * lat[int(0.99 * (len(lat) - 1))],
+                "native_frames": frames,
+            }
+        finally:
+            serve.shutdown()
+            ray_tpu.shutdown()
+            os.environ.pop("RAY_TPU_NATIVE_DISPATCH", None)
+
+    py = run_arm(native=False)
+    nat = run_arm(native=True)
+
+    if nat["probe"] != py["probe"]:
+        raise RuntimeError(
+            "native and Python dispatch arms returned different outputs")
+    if nat["native_frames"] <= 0:
+        raise RuntimeError(
+            "native arm never used the request ring — the 5x claim "
+            "would be vacuous (is the native library building?)")
+    if py["native_frames"] != 0:
+        raise RuntimeError(
+            "Python arm touched the native ring with the flag off")
+
+    speedup = nat["per_s"] / max(1e-9, py["per_s"])
+    p99_parity = nat["p99_ms"] <= 1.25 * py["p99_ms"]
+    detail = {
+        "clients": n_clients,
+        "seconds_per_arm": duration,
+        "native": {k: round(v, 2) for k, v in nat.items()
+                   if k not in ("probe",)},
+        "python": {k: round(v, 2) for k, v in py.items()
+                   if k not in ("probe",)},
+        "speedup": round(speedup, 2),
+        "p99_parity": p99_parity,
+        "five_x_target_met": speedup >= 5.0 and p99_parity,
+    }
+    if not detail["five_x_target_met"]:
+        if ncpu > 2:
+            raise RuntimeError(
+                f"native dispatch {speedup:.2f}x vs Python path "
+                f"(p99 parity={p99_parity}) — below the 5x-at-parity "
+                "acceptance gate")
+        detail["note"] = (
+            f"{ncpu}-core CPU box: arms timeshare one core with the "
+            "replicas, 5x target waived (see README 1-core caveat)")
+    return {
+        "serve_dispatch": detail,
+        # value-keyed: the >15% REGRESSION gate watches both arms, so
+        # neither the native path nor the guarded fallback can rot
+        "serve_dispatch_native_per_s": nat["per_s"],
+        "serve_dispatch_python_per_s": py["per_s"],
     }
 
 
@@ -2147,6 +2410,19 @@ def main():
             suite["serve_llm_error"] = repr(e)[:300]
     else:
         suite["serve_llm"] = {"skipped": "budget"}
+
+    # dispatch plane v2 (ISSUE 19): native request ring vs the Python
+    # handle path, A/B on every run so the fallback arm can't rot
+    if remaining() > 60 or not on_tpu:
+        try:
+            sd = bench_serve_dispatch()
+            for k, v in sd.items():
+                suite[k] = v if isinstance(v, dict) else {
+                    "value": round(v, 2), "vs_baseline": None}
+        except Exception as e:  # noqa: BLE001
+            suite["serve_dispatch_error"] = repr(e)[:300]
+    else:
+        suite["serve_dispatch"] = {"skipped": "budget"}
 
     # elastic-recovery soak (ISSUE 10): cluster-mode fault schedule with
     # MTTR accounting; the full >=10-min SOAK_r*.json artifact run sets

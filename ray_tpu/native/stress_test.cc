@@ -48,6 +48,25 @@ void ss_stats2(int handle, uint64_t* capacity, uint64_t* allocated,
                uint64_t* evicted_objects);
 uint32_t ss_num_shards(int handle);
 int ss_shard_stats(int handle, uint32_t shard, uint64_t* out);
+
+// dispatch plane v2 (request_ring.cc)
+int rr_open(const char* name, uint32_t table_cap, uint32_t slots,
+            uint32_t slot_bytes);
+int rr_detach(int h);
+int rr_unlink(const char* name);
+int rr_publish(int h, uint64_t version, const uint64_t* ids, uint32_t n);
+int rr_mark_dead(int h, uint64_t id);
+int rr_done(int h, uint64_t id, uint32_t gen);
+int64_t rr_enqueue(int h, const uint8_t* payload, uint32_t len,
+                   uint64_t deadline_ns, uint64_t client, uint32_t tag,
+                   uint64_t* trace_out, uint64_t* rid_out,
+                   uint32_t* gen_out);
+int64_t rr_drain(int h, uint32_t ring, uint8_t* out, uint64_t cap,
+                 uint32_t max_frames, uint64_t* nbytes_out);
+int64_t rr_pending(int h, uint32_t ring);
+void rr_stats(int h, uint64_t* out);
+int rr_snapshot(int h, uint64_t* out, uint32_t cap_rows, uint64_t* ver_out);
+uint32_t rr_table_cap(int h);
 }
 
 namespace {
@@ -157,12 +176,173 @@ int run_phase(const char* name, uint32_t num_shards, const char* label) {
   return 0;
 }
 
+// ---------------------------------------------------------------------
+// request-ring phase: producers race rr_enqueue against batch-draining
+// consumers while a chaos thread churns the replica snapshot
+// (publish/mark_dead/stale dones). Pass conditions: no torn frames
+// (payload bytes uniform), drained == successful enqueues (no frame
+// lost or duplicated across version bumps), and the snapshot's
+// in-flight counters balance to zero at quiesce.
+
+struct RRFrameHdr {  // mirrors FrameHdr in request_ring.cc (56 bytes)
+  uint64_t trace, rid, deadline_ns, enq_ns, client;
+  uint32_t gen, tag, len, pad;
+};
+static_assert(sizeof(RRFrameHdr) == 56, "frame header ABI drift");
+
+constexpr int kRRProducers = 6;
+constexpr int kRRItersPerProducer = 4000;
+constexpr uint32_t kRRTableCap = 4;
+constexpr uint32_t kRRSlotBytes = 128;
+
+std::atomic<uint64_t> rr_enq_ok{0};
+std::atomic<uint64_t> rr_enq_rej{0};
+std::atomic<uint64_t> rr_drained{0};
+std::atomic<uint64_t> rr_torn{0};
+std::atomic<bool> rr_producers_done{false};
+std::atomic<bool> rr_chaos_stop{false};
+
+void rr_producer(int h, int t) {
+  uint8_t payload[96];
+  for (int i = 0; i < kRRItersPerProducer; ++i) {
+    std::memset(payload, (uint8_t)((t * 131 + i) & 0xff),
+                sizeof(payload));
+    uint64_t trace = 0, rid = 0;
+    uint32_t gen = 0;
+    int64_t rc = rr_enqueue(h, payload, sizeof(payload), 0, 0, 0,
+                            &trace, &rid, &gen);
+    if (rc >= 0)
+      rr_enq_ok.fetch_add(1);
+    else
+      rr_enq_rej.fetch_add(1);  // FULL/NO_REPLICA under churn: legal
+  }
+}
+
+void rr_consumer(int h, uint32_t ring0, uint32_t nrings) {
+  std::vector<uint8_t> buf(64 * (sizeof(RRFrameHdr) + kRRSlotBytes));
+  uint64_t nbytes = 0;
+  while (true) {
+    bool any = false;
+    for (uint32_t r = ring0; r < ring0 + nrings; ++r) {
+      int64_t n = rr_drain(h, r, buf.data(), buf.size(), 64, &nbytes);
+      if (n <= 0) continue;
+      any = true;
+      uint64_t off = 0;
+      for (int64_t k = 0; k < n; ++k) {
+        RRFrameHdr hd;
+        std::memcpy(&hd, buf.data() + off, sizeof(hd));
+        off += sizeof(hd);
+        const uint8_t* p = buf.data() + off;
+        for (uint32_t b = 1; b < hd.len; ++b) {
+          if (p[b] != p[0]) {
+            rr_torn.fetch_add(1);
+            break;
+          }
+        }
+        off += hd.len;
+        rr_done(h, hd.rid, hd.gen);  // stale after retire: dropped
+        rr_drained.fetch_add(1);
+      }
+    }
+    if (!any) {
+      if (rr_producers_done.load()) {
+        bool empty = true;  // exit only after the final sweep drains dry
+        for (uint32_t r = ring0; r < ring0 + nrings; ++r)
+          if (rr_pending(h, r) > 0) empty = false;
+        if (empty) return;
+      }
+      std::this_thread::yield();
+    }
+  }
+}
+
+void rr_chaos(int h) {
+  const uint64_t ids[8] = {11, 22, 33, 44, 55, 66, 77, 88};
+  uint64_t version = 2;
+  unsigned r = 12345;
+  while (!rr_chaos_stop.load()) {
+    r = r * 1664525u + 1013904223u;
+    uint64_t set[kRRTableCap];
+    uint32_t base = (r >> 8) & 7;  // rotating window: ids stay distinct
+    for (uint32_t k = 0; k < kRRTableCap; ++k)
+      set[k] = ids[(base + k) & 7];
+    rr_publish(h, version++, set, kRRTableCap);
+    r = r * 1664525u + 1013904223u;
+    rr_mark_dead(h, ids[(r >> 16) & 7]);
+    rr_done(h, ids[(r >> 20) & 7], 1);  // stale gen: must be a no-op
+    uint64_t rows[5 * kRRTableCap];
+    uint64_t ver = 0;
+    rr_snapshot(h, rows, kRRTableCap, &ver);
+    uint64_t stats[12];
+    rr_stats(h, stats);
+    std::this_thread::yield();
+  }
+}
+
+int rr_run_phase(const char* name, const char* label) {
+  rr_unlink(name);
+  int h = rr_open(name, kRRTableCap, 256, kRRSlotBytes);
+  if (h < 0) {
+    std::fprintf(stderr, "rr_open(%s) failed\n", label);
+    return 1;
+  }
+  const uint64_t initial[kRRTableCap] = {11, 22, 33, 44};
+  rr_publish(h, 1, initial, kRRTableCap);
+  std::vector<std::thread> threads;
+  threads.emplace_back(rr_chaos, h);
+  threads.emplace_back(rr_consumer, h, 0u, 2u);
+  threads.emplace_back(rr_consumer, h, 2u, 2u);
+  std::vector<std::thread> producers;
+  for (int t = 0; t < kRRProducers; ++t)
+    producers.emplace_back(rr_producer, h, t);
+  for (auto& th : producers) th.join();
+  rr_chaos_stop.store(true);
+  threads[0].join();
+  rr_producers_done.store(true);
+  threads[1].join();
+  threads[2].join();
+  int rc = 0;
+  if (rr_torn.load() != 0) {
+    std::fprintf(stderr, "torn frames (%s): %lu\n", label,
+                 (unsigned long)rr_torn.load());
+    rc = 2;
+  }
+  if (rr_drained.load() != rr_enq_ok.load()) {
+    std::fprintf(stderr, "frame leak (%s): enq_ok=%lu drained=%lu\n",
+                 label, (unsigned long)rr_enq_ok.load(),
+                 (unsigned long)rr_drained.load());
+    rc = 2;
+  }
+  uint64_t rows[5 * kRRTableCap];
+  uint64_t ver = 0;
+  int n = rr_snapshot(h, rows, kRRTableCap, &ver);
+  uint64_t inflight = 0;
+  for (int i = 0; i < n; ++i)
+    if (rows[i * 5 + 3]) inflight += rows[i * 5 + 2];
+  if (inflight != 0) {
+    std::fprintf(stderr, "inflight imbalance (%s): %lu at quiesce\n",
+                 label, (unsigned long)inflight);
+    rc = 2;
+  }
+  rr_detach(h);
+  rr_unlink(name);
+  if (rc == 0)
+    std::printf("stress OK (%s): %d producers x %d iterations, "
+                "%lu drained, %lu shed\n",
+                label, kRRProducers, kRRItersPerProducer,
+                (unsigned long)rr_drained.load(),
+                (unsigned long)rr_enq_rej.load());
+  return rc;
+}
+
 }  // namespace
 
 int main() {
   int rc = run_phase("/ray_tpu_stress", 0, "single-shard");
   if (rc != 0) return rc;
   rc = run_phase("/ray_tpu_stress_sharded", 8, "sharded");
+  if (rc != 0) return rc;
+  rc = rr_run_phase("/ray_tpu_stress_ring", "request-ring");
   if (rc != 0) return rc;
   return 0;
 }

@@ -27,6 +27,7 @@ import time
 from typing import Any, Dict, List, Optional, Tuple
 
 import ray_tpu
+from ray_tpu.serve import dispatch as _dispatch
 from ray_tpu.util import metrics as _metrics
 
 logger = logging.getLogger(__name__)
@@ -57,6 +58,9 @@ class _DeploymentState:
         # for the configured delay (reference autoscaling semantics)
         self.upscale_pending_since: Optional[float] = None
         self.downscale_pending_since: Optional[float] = None
+        # last replica-set version mirrored into the dispatch plane
+        # (native snapshot publish + router wake FIFO)
+        self.dispatch_synced = -1
 
 
 class ServeController:
@@ -87,6 +91,13 @@ class ServeController:
         self._replica_spawned: Dict[int, float] = {}
         self._reclaimed_arenas: List[str] = []
         self._arenas_reclaimed_total = 0
+        # dispatch plane v2: per-deployment native segments (created on
+        # first sync when RAY_TPU_NATIVE_DISPATCH=1), router-wake FIFOs
+        # (posted on EVERY version bump, native or not), and the set of
+        # replica keys already told to attach their drain loops
+        self._rings: Dict[str, Any] = {}
+        self._router_wakes: Dict[str, Any] = {}
+        self._ring_attached: Dict[str, set] = {}
         _metrics.DEFAULT_REGISTRY.register_callback(
             "serve_controller", self._metrics_text)
 
@@ -116,6 +127,7 @@ class ServeController:
             self._deployments[name] = st
         # replica spawn is RPC — always outside the lock
         self._scale_to_target(name, st)
+        self._sync_dispatch(name, st)
 
     def delete_deployment(self, name: str) -> None:
         with self._lock:
@@ -123,6 +135,7 @@ class ServeController:
             victims = list(st.replicas) if st else []
         for r in victims:
             self._kill(r)
+        self._teardown_dispatch(name)
 
     def get_replicas(self, name: str) -> Dict[str, Any]:
         with self._lock:
@@ -169,6 +182,8 @@ class ServeController:
             self._proxies = []
         for v in victims:
             self._kill(v)
+        for name in list(self._rings) + list(self._router_wakes):
+            self._teardown_dispatch(name)
 
     # -- reconciliation ----------------------------------------------------
 
@@ -266,6 +281,7 @@ class ServeController:
                                    if id(r) not in dead_ids]
                     self._autoscale(st, total_load)
                 self._scale_to_target(name, st)
+                self._sync_dispatch(name, st)
             except Exception:
                 pass
 
@@ -330,12 +346,103 @@ class ServeController:
         with self._lock:
             return list(self._reclaimed_arenas)
 
+    # -- dispatch plane v2 -------------------------------------------------
+
+    def _router_wake(self, name: str):
+        with self._lock:
+            w = self._router_wakes.get(name)
+            if w is None:
+                w = _dispatch._Wakeup(_dispatch.router_wake_path(name))
+                self._router_wakes[name] = w
+            return w
+
+    def _ring_for(self, name: str):
+        """The deployment's native segment, created on first use with
+        the controller-owned geometry (handles attach-only)."""
+        with self._lock:
+            ring = self._rings.get(name)
+        if ring is not None:
+            return ring
+        ring = _dispatch.DispatchRing(
+            _dispatch.domain_segment(name), table_cap=16,
+            slots=_dispatch.ring_slots(), slot_bytes=1024)
+        with self._lock:
+            existing = self._rings.setdefault(name, ring)
+        if existing is not ring:
+            ring.close()
+            return existing
+        return ring
+
+    def _sync_dispatch(self, name: str, st: _DeploymentState) -> None:
+        """Mirror a replica-set version bump into the dispatch plane:
+        publish `{version, replica cookies}` into the native segment
+        (seqlock write, lock-free reads) and tell newly-started replicas
+        to attach their drain loops; then post the router-wake FIFO so
+        empty-parked choosers re-read NOW instead of on their next poll
+        slice. The FIFO post happens with or without the native library.
+        Never called with the lock held across an RPC."""
+        with self._lock:
+            if self._deployments.get(name) is not st:
+                return
+            version = st.version
+            if version == st.dispatch_synced:
+                return
+            replicas = list(st.replicas)
+        if _dispatch.native_available():
+            try:
+                ring = self._ring_for(name)
+                cookies = [_dispatch.replica_cookie(r) for r in replicas]
+                # geometry cap: replicas beyond the table serve via the
+                # Python path only (logged once per deployment by size)
+                cookies = cookies[:ring.table_cap]
+                ring.publish(version, cookies)
+                with self._lock:
+                    attached = self._ring_attached.setdefault(name, set())
+                    todo = [
+                        (r, c) for r, c in zip(replicas, cookies)
+                        if _dispatch.replica_key(r) not in attached]
+                    for r, _c in todo:
+                        attached.add(_dispatch.replica_key(r))
+                for r, cookie in todo:  # fire-and-forget attach RPCs
+                    try:
+                        r.attach_dispatch.remote(
+                            _dispatch.domain_segment(name), cookie, name)
+                    except Exception:
+                        pass
+            except Exception:
+                logger.warning("dispatch publish failed for %r", name,
+                               exc_info=True)
+        self._router_wake(name).post()
+        with self._lock:
+            if self._deployments.get(name) is st:
+                st.dispatch_synced = version
+
+    def _teardown_dispatch(self, name: str) -> None:
+        with self._lock:
+            ring = self._rings.pop(name, None)
+            wake = self._router_wakes.pop(name, None)
+            self._ring_attached.pop(name, None)
+        if ring is not None:
+            try:
+                ring.close(unlink=True)
+            except Exception:
+                pass
+        if wake is not None:
+            # wake parked routers one last time (they will observe the
+            # deployment gone), then remove the FIFO
+            try:
+                wake.post()
+                wake.close(unlink=True)
+            except Exception:
+                pass
+
     def _metrics_text(self) -> str:
         with self._lock:
             reclaimed = self._arenas_reclaimed_total
             deployments = len(self._deployments)
             draining = len(self._draining)
-        return "\n".join([
+            rings = dict(self._rings)
+        out = "\n".join([
             "# TYPE serve_llm_arenas_reclaimed_total counter",
             f"serve_llm_arenas_reclaimed_total {reclaimed}",
             "# TYPE serve_controller_deployments gauge",
@@ -343,6 +450,13 @@ class ServeController:
             "# TYPE serve_controller_draining_replicas gauge",
             f"serve_controller_draining_replicas {draining}",
         ]) + "\n"
+        # dispatch plane v2: native-ring counters join the same scrape
+        for name, ring in rings.items():
+            try:
+                out += ring.metrics_text(name)
+            except Exception:
+                pass
+        return out
 
     def _scale_to_target(self, name: str, st: _DeploymentState) -> None:
         """Converge replica count to st.target_replicas. State deltas are

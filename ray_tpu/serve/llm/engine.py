@@ -133,6 +133,10 @@ class Request:
         self.max_new_tokens = max_new_tokens
         self.deadline = deadline
         self.out_q: "queue.Queue" = queue.Queue()
+        # dispatch plane v2: when set, engine-side events ship through
+        # this callable (straight onto the requester's response ring)
+        # instead of accumulating in out_q, which nothing would read
+        self.sink = None
         self.tokens: List[int] = []   # generated tokens, in order
         self.done = threading.Event()
         self.error: Optional[str] = None
@@ -186,18 +190,27 @@ class Request:
             self.first_token_ts = now
         self.last_token_ts = now
         self.tokens.append(token)
-        self.out_q.put(("token", len(self.tokens) - 1, token))
+        if self.sink is not None:
+            self.sink("token", len(self.tokens) - 1, token)
+        else:
+            self.out_q.put(("token", len(self.tokens) - 1, token))
 
     def _finish(self, reason: str):
         self.finish_reason = reason
         self.finish_ts = time.monotonic()
-        self.out_q.put(("done", reason))
+        if self.sink is not None:
+            self.sink("done", reason)
+        else:
+            self.out_q.put(("done", reason))
         self.done.set()
 
     def _fail(self, msg: str):
         self.error = msg
         self.finish_ts = time.monotonic()
-        self.out_q.put(("error", msg))
+        if self.sink is not None:
+            self.sink("error", msg)
+        else:
+            self.out_q.put(("error", msg))
         self.done.set()
 
 
@@ -372,6 +385,9 @@ class LLMEngine:
         self._waiting: List[Request] = []
         self._prefilling: List[_Sequence] = []
         self._running: List[_Sequence] = []
+        # dispatch plane v2: (ring, sub-ring index, deployment) once a
+        # replica attaches its native intake — drained by the pump
+        self._intake = None
         self._lock = threading.Lock()       # guards queues + counters
         self._step_lock = threading.Lock()  # serializes step()
         self._work = threading.Event()
@@ -1013,6 +1029,81 @@ class LLMEngine:
             outcome=outcome, job=req.tenant,
             finish_reason=req.finish_reason or req.error or "")
 
+    # -- native intake (dispatch plane v2) --------------------------------
+
+    def attach_intake(self, ring, idx: int, deployment: str) -> None:
+        """Drain raw request frames from the native dispatch ring inside
+        the pump loop: the batch drain runs on the engine thread right
+        before step(), so the only per-batch Python entry is the decode
+        itself — no pickle, no actor RPC, no per-request task."""
+        self._intake = (ring, idx, deployment)
+        self._work.set()
+
+    def _drain_intake(self) -> bool:
+        it = self._intake
+        if it is None:
+            return False
+        ring, idx, deployment = it
+        frames = ring.drain(idx, max_frames=64)
+        for f in frames:
+            self._admit_frame(ring, f, deployment)
+        return bool(frames)
+
+    def _admit_frame(self, ring, f, deployment: str) -> None:
+        """Admit one natively-dispatched frame: decode the raw prompt,
+        submit under the adopted trace context (recorder attribution
+        stays intact — the natively-minted id IS the request id), and
+        wire a sink that ships token/terminal frames straight onto the
+        requester's response ring. `rr_done` fires on the terminal
+        event with the enqueue's generation, so the shared snapshot's
+        in-flight count balances even across replica churn."""
+        from ray_tpu.serve import dispatch as _dispatch
+
+        def _ship(resp, payload: bytes, tag: int) -> None:
+            if resp is None:
+                return
+            for _ in range(400):  # bounded spin on a wedged reader
+                if resp.enqueue_to(0, payload, trace=f.trace, tag=tag):
+                    return
+                time.sleep(0.002)
+
+        resp = _dispatch.response_ring(f.client)
+        try:
+            prompt, max_new, job = _dispatch.decode_llm_request(f.payload)
+        except Exception:
+            ring.done(f.rid, f.gen)
+            return
+        ctx = _rr.adopt_context(f.trace_id, deployment, job)
+        timeout_s = None
+        if f.deadline_ns:
+            timeout_s = max(0.001, f.deadline_ns / 1e9 - time.monotonic())
+        try:
+            with _rr.serving(ctx):
+                req = self.submit(prompt, max_new,
+                                  request_id=f.trace_id,
+                                  timeout_s=timeout_s, tenant=job)
+        except Exception as e:  # noqa: BLE001 — shipped to caller
+            _ship(resp, f"{type(e).__name__}: {e}".encode()[:256],
+                  _dispatch.TAG_ERROR)
+            ring.done(f.rid, f.gen)
+            return
+
+        def sink(kind: str, *rest) -> None:
+            if kind == "token":
+                _ship(resp, _dispatch._LLM_TOK.pack(rest[0], rest[1]),
+                      _dispatch.TAG_TOKEN)
+                return
+            if kind == "done":
+                _ship(resp, (rest[0] or "stop").encode()[:256],
+                      _dispatch.TAG_DONE)
+            else:
+                _ship(resp, (rest[0] or "error").encode()[:256],
+                      _dispatch.TAG_ERROR)
+            ring.done(f.rid, f.gen)
+
+        # safe after submit: emission happens in step(), on this thread
+        req.sink = sink
+
     # -- pump thread ------------------------------------------------------
 
     def start(self):
@@ -1025,9 +1116,17 @@ class LLMEngine:
 
     def _pump(self):
         while not self._stop.is_set():
-            if not self.step():
+            drained = self._drain_intake()
+            if not self.step() and not drained:
                 self._work.clear()
-                self._work.wait(0.02)
+                it = self._intake
+                if it is not None:
+                    # park on the ring's wakeup FIFO so a native enqueue
+                    # wakes the pump without a poll; local submits still
+                    # set _work, observed at the next bounded slice
+                    it[0].wait(it[1], 0.02)
+                else:
+                    self._work.wait(0.02)
 
     def stop(self):
         self._stop.set()

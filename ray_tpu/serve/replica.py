@@ -7,9 +7,12 @@ supports reconfigure(user_config) and health checks.
 
 from __future__ import annotations
 
+import pickle
 import threading
+import time
 from typing import Any, Dict, Optional
 
+from ray_tpu.serve import dispatch as _dispatch
 from ray_tpu.util import request_recorder as _rr
 from ray_tpu.util import tracing as _tracing
 
@@ -41,6 +44,11 @@ class Replica:
         self._ongoing = 0
         self._total = 0
         self._lock = threading.Lock()
+        # dispatch plane v2 (attach_dispatch): the native request ring
+        # this replica drains, batch at a time
+        self._dispatch_ring = None
+        self._dispatch_stop = False
+        self._dispatch_thread: Optional[threading.Thread] = None
         marker = getattr(func_or_class, "__serve_asgi__", None)
         if marker is not None:
             from ray_tpu.serve.asgi import resolve_app
@@ -302,6 +310,118 @@ class Replica:
         except Exception:  # noqa: BLE001
             pass
         return out
+
+    # -- dispatch plane v2 (native request ring) --------------------------
+
+    def attach_dispatch(self, segment: str, cookie: int,
+                        deployment: str) -> int:
+        """Controller RPC: start draining this replica's sub-ring of the
+        deployment's native dispatch segment. serve.llm deployments hand
+        the ring to the engine's pump (token frames come straight off
+        `step()`); everything else gets a drain thread that re-enters
+        Python once per BATCH of frames. Returns the segment mode this
+        replica serves (MODE_RAW_LLM / MODE_PICKLE)."""
+        if self._dispatch_ring is not None:
+            return self._dispatch_ring.mode()
+        ring = _dispatch.DispatchRing(segment, create=False)
+        idx = ring.ring_of(cookie)
+        if idx < 0:
+            ring.close()
+            raise RuntimeError(
+                f"replica cookie {cookie:#x} not published in {segment}")
+        engine = None if self._is_function else \
+            getattr(self._callable, "engine", None)
+        if engine is not None and hasattr(engine, "attach_intake"):
+            ring.set_mode(_dispatch.MODE_RAW_LLM)
+            engine.attach_intake(ring, idx, deployment)
+        else:
+            ring.set_mode(_dispatch.MODE_PICKLE)
+            self._dispatch_stop = False
+            self._dispatch_thread = threading.Thread(
+                target=self._dispatch_loop, args=(ring, idx, deployment),
+                daemon=True, name="dispatch_drain")
+            self._dispatch_thread.start()
+        self._dispatch_ring = ring
+        return ring.mode()
+
+    def detach_dispatch(self) -> None:
+        self._dispatch_stop = True
+        t, self._dispatch_thread = self._dispatch_thread, None
+        if t is not None:
+            t.join(timeout=2)
+        ring, self._dispatch_ring = self._dispatch_ring, None
+        if ring is not None:
+            ring.close()
+
+    def _dispatch_loop(self, ring, idx: int, deployment: str) -> None:
+        while not self._dispatch_stop:
+            frames = ring.drain(idx, max_frames=64)
+            if not frames:
+                ring.wait(idx, _dispatch._BLOCK_SLICE)
+                continue
+            for f in frames:
+                self._serve_frame(ring, f, deployment)
+
+    def _serve_frame(self, ring, f, deployment: str) -> None:
+        """Execute one natively-dispatched request and ship the result
+        back over the requester's response ring. The snapshot-plane
+        in-flight count is released HERE (`rr_done` with the enqueue's
+        generation — stale completions for a retired table entry are
+        dropped, never mis-billed)."""
+        try:
+            try:
+                method, args, kwargs, job = _dispatch.decode_call(
+                    f.payload)
+            except Exception:
+                return  # torn producer bug; drop, counter keeps the score
+            ctx = _rr.adopt_context(f.trace_id, deployment, job)
+            try:
+                val = self.handle_request(method, args, kwargs, ctx)
+            except Exception as e:  # noqa: BLE001 — shipped to caller
+                self._respond_error(f, e)
+                return
+            try:
+                blob = pickle.dumps(val,
+                                    protocol=pickle.HIGHEST_PROTOCOL)
+            except Exception as e:  # noqa: BLE001
+                self._respond_error(f, e)
+                return
+            self._respond_chunked(f, blob)
+        finally:
+            ring.done(f.rid, f.gen)
+
+    @staticmethod
+    def _respond_chunked(f, blob: bytes) -> None:
+        resp = _dispatch.response_ring(f.client)
+        if resp is None:
+            return  # requester exited: drop the response
+        cap = resp.slot_bytes
+        n = max(1, (len(blob) + cap - 1) // cap)
+        for i in range(n):
+            part = blob[i * cap:(i + 1) * cap]
+            # bounded spin on a full client ring (slow reader); the
+            # chunk index/total ride the client word — the request
+            # frame's cookie already did its routing job
+            for _ in range(400):
+                if resp.enqueue_to(0, part, trace=f.trace,
+                                   client=(i << 32) | n,
+                                   tag=_dispatch.TAG_RESULT):
+                    break
+                time.sleep(0.005)
+            else:
+                return  # reader wedged: stop shipping, stream is lost
+
+    @staticmethod
+    def _respond_error(f, err: BaseException) -> None:
+        resp = _dispatch.response_ring(f.client)
+        if resp is None:
+            return
+        msg = f"{type(err).__name__}: {err}".encode()[:resp.slot_bytes]
+        for _ in range(400):
+            if resp.enqueue_to(0, msg, trace=f.trace,
+                               tag=_dispatch.TAG_ERROR):
+                return
+            time.sleep(0.005)
 
     def reconfigure(self, user_config: Dict) -> None:
         if not self._is_function and hasattr(self._callable, "reconfigure"):

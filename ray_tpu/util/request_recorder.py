@@ -226,6 +226,16 @@ def new_context(deployment: str, job: str = "none") -> dict:
             "job": job, "sampled": _ENABLED and _should_sample()}
 
 
+def adopt_context(req_id: str, deployment: str,
+                  job: str = "none") -> dict:
+    """Wrap an id minted elsewhere (the native dispatch ring mints trace
+    ids in C) into a recorder context. The sampling decision still
+    happens here — native mint is identity-only — so natively-dispatched
+    requests stitch into the same records/timeline as Python-path ones."""
+    return {"req_id": req_id, "deployment": deployment,
+            "job": job, "sampled": _ENABLED and _should_sample()}
+
+
 @contextlib.contextmanager
 def serving(ctx: Optional[dict]) -> Iterator[Optional[dict]]:
     """Replica-side: enter the request's context so downstream code

@@ -32,7 +32,8 @@ import pytest  # noqa: E402
 # control flow mid-test; worker daemons self-install via RAY_TPU_LOCKDEP=1
 # in their inherited environment and raise in-daemon.
 _LOCKDEP_SUITES = ("test_chaos", "test_object_store", "test_rpc_batch",
-                   "test_multitenant", "test_ownership")
+                   "test_multitenant", "test_ownership",
+                   "test_dispatch_ring")
 
 
 @pytest.fixture(autouse=True)

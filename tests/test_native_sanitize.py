@@ -6,11 +6,13 @@ bazel-config equivalent for `src/ray/object_manager/plasma/`. TSAN is
 the native-side counterpart of the Python-side lockdep + raylint gates:
 ASAN catches lifetime bugs, TSAN the data races and lock inversions.
 
-The driver runs two phases and both must print their OK line: the
-single-shard (v1-shaped) store, and an 8-way-sharded store that hammers
+The driver runs three phases and each must print its OK line: the
+single-shard (v1-shaped) store, an 8-way-sharded store that hammers
 the sharded create/seal/evict paths, the lock-free contains/release
 probes, cross-shard eviction sweeps, and the all-region-locks spanning
-allocator.
+allocator — and the dispatch request ring (request_ring.cc), where
+producers race native pow-2 enqueue against batch-draining consumers
+under replica-snapshot churn (publish / mark_dead / stale rr_done).
 """
 
 import os
@@ -40,6 +42,7 @@ def _build_and_stress(target: str, label: str,
         f"{label} stress failed:\n{run.stdout[-1000:]}\n{run.stderr[-3000:]}"
     assert "stress OK (single-shard)" in run.stdout
     assert "stress OK (sharded)" in run.stdout
+    assert "stress OK (request-ring)" in run.stdout
 
 
 def test_shm_store_stress_under_asan():

@@ -867,6 +867,80 @@ class TestSpanLeak:
 
 
 # ---------------------------------------------------------------------------
+# snapshot-read: dispatch-plane snapshot rows are read-time facts
+# ---------------------------------------------------------------------------
+
+class TestSnapshotRead:
+    """Rows from ``ring.snapshot()`` are validated by the seqlock
+    generation check at read time only. The bad twin reuses a row
+    after ``ring.done()`` advanced the table — the slot may have been
+    retired and re-issued, so the stale row sails past the ABA guard.
+    The good twin finishes every use under the single hold."""
+
+    BAD = """
+        def route_and_ack(ring, rid, gen):
+            rows = ring.snapshot()
+            target = rows[0]
+            ring.done(rid, gen)      # version/generation may advance here
+            return send(target)      # stale: validated before done()
+    """
+
+    def test_reuse_after_release_flagged(self):
+        findings = run(self.BAD)
+        assert any(f.check == "snapshot-read"
+                   and f.detail == "snap:target"
+                   and f.scope == "route_and_ack"
+                   for f in findings), findings
+
+    def test_derived_value_carries_the_taint(self):
+        findings = run("""
+            def pick(ring, rid, gen):
+                rows = ring.snapshot()
+                alive = rows[1]
+                best = alive
+                ring.mark_dead(rid)
+                return best
+        """)
+        assert any(f.check == "snapshot-read" and f.detail == "snap:best"
+                   for f in findings), findings
+
+    def test_single_hold_read_clean(self):
+        findings = run("""
+            def route_and_ack(ring, rid, gen):
+                rows = ring.snapshot()
+                target = rows[0]
+                send(target)         # every use lands before the release
+                ring.done(rid, gen)
+        """)
+        assert "snapshot-read" not in checks_of(findings), findings
+
+    def test_fresh_snapshot_after_release_clean(self):
+        findings = run("""
+            def ack_then_route(ring, rid, gen):
+                ring.done(rid, gen)
+                rows = ring.snapshot()   # fresh read after the release
+                return rows[0]
+        """)
+        assert "snapshot-read" not in checks_of(findings), findings
+
+    def test_other_receiver_mutation_clean(self):
+        findings = run("""
+            def route(ring_a, ring_b, rid, gen):
+                rows = ring_a.snapshot()
+                ring_b.done(rid, gen)    # a different table entirely
+                return rows[0]
+        """)
+        assert "snapshot-read" not in checks_of(findings), findings
+
+    def test_inline_suppression(self):
+        src = self.BAD.replace(
+            "return send(target)      # stale: validated before done()",
+            "return send(target)  # raylint: disable=snapshot-read")
+        findings = run(src)
+        assert "snapshot-read" not in checks_of(findings), findings
+
+
+# ---------------------------------------------------------------------------
 # jit-purity over the AOT-cache stagers (compiled_step / fold_steps)
 # ---------------------------------------------------------------------------
 

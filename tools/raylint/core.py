@@ -74,6 +74,19 @@ analysis gates"):
     poisons every span opened after it on that thread). Sanctioned
     forms: ``with span(...)``, or closing in a ``finally:`` block.
 
+``snapshot-read``
+    Pins the dispatch-plane snapshot-read idiom (serve/dispatch.py):
+    rows bound from a ``ring.snapshot()`` read are validated by the
+    generation check *at read time only*. Re-using them — or anything
+    derived from them — after a mutating call on the same receiver
+    (``publish`` / ``mark_dead`` / ``done`` / ``release``) crosses a
+    version or generation bump: the rows can describe replicas whose
+    slot was already retired and re-issued, so a routing decision made
+    from them sails past the ABA guard. Sanctioned forms: finish every
+    use before the mutating call (single-hold read), or re-snapshot
+    after it. Conservative: flags only a straight-line
+    bind → same-receiver mutate → reuse sequence within one function.
+
 Suppression: append ``# raylint: disable=<check>`` (or ``disable=all``)
 to the flagged line, or put it on a comment line directly above.
 """
@@ -88,7 +101,7 @@ from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
 CHECKS = ("lock-discipline", "blocking-under-lock", "jit-purity",
           "seeded-rng", "jit-cache-stability", "metric-in-hot-loop",
-          "span-leak")
+          "span-leak", "snapshot-read")
 
 _LOCKISH_NAME = re.compile(r"lock|mutex|cond", re.IGNORECASE)
 _LOCK_FACTORIES = {
@@ -1212,6 +1225,140 @@ def check_span_leak(ctx: ModuleContext) -> List[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# checker 8: snapshot-read
+# ---------------------------------------------------------------------------
+
+# reads that bind a generation-validated copy of the shared table
+_SNAPSHOT_READS = {"snapshot", "rr_snapshot"}
+# receiver mutators that advance the version/generation the copy was
+# validated against
+_SNAPSHOT_MUTATORS = {"publish", "mark_dead", "done", "release",
+                      "rr_publish", "rr_mark_dead", "rr_done"}
+
+
+def _walk_no_nested(fn: ast.AST):
+    """Every node in `fn`'s body except nested function/lambda scopes
+    (their bodies run at another time — often another thread)."""
+    stack = list(getattr(fn, "body", []))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def check_snapshot_read(ctx: ModuleContext) -> List[Finding]:
+    """Flag snapshot rows reused after the receiver mutated. The
+    dispatch plane's ABA guard is a *read-time* fact: ``snapshot()``
+    returns rows consistent with the version/generation words at the
+    moment of the seqlock read. A later ``publish``/``mark_dead``/
+    ``done`` on the same receiver can retire a row and re-issue its
+    slot — decisions made from the stale copy then target a replica
+    the generation check would reject. Conservative straight-line
+    analysis: bind (or derive) → same-receiver mutate → reuse flags;
+    uses that land before the mutate, or a fresh snapshot taken after
+    it, stay silent."""
+    findings: List[Finding] = []
+    for classname, fn in _iter_func_nodes(ctx.tree):
+        scope = f"{classname}.{fn.name}" if classname else fn.name
+        assigns: List[Tuple[Tuple[int, int], ast.Assign]] = []
+        muts: List[Tuple[Tuple[int, int], str, str, int]] = []
+        uses: List[Tuple[Tuple[int, int], ast.Name]] = []
+        mut_inner: Set[int] = set()   # Name nodes inside a mutator call
+        has_snap = False
+        for node in _walk_no_nested(fn):
+            pos = (getattr(node, "lineno", 0),
+                   getattr(node, "col_offset", 0))
+            if isinstance(node, ast.Assign):
+                assigns.append((pos, node))
+                v = node.value
+                if isinstance(v, ast.Call) and \
+                        isinstance(v.func, ast.Attribute) and \
+                        v.func.attr in _SNAPSHOT_READS:
+                    has_snap = True
+            elif isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in _SNAPSHOT_MUTATORS:
+                recv = dotted(node.func.value)
+                if recv:
+                    muts.append((pos, recv, node.func.attr, node.lineno))
+                    for sub in ast.walk(node):
+                        if isinstance(sub, ast.Name):
+                            mut_inner.add(id(sub))
+            elif isinstance(node, ast.Name) and \
+                    isinstance(node.ctx, ast.Load):
+                uses.append((pos, node))
+        if not has_snap or not muts:
+            continue
+
+        # merge into one source-ordered event stream; at equal position
+        # assigns commit before uses are judged
+        events: List[Tuple[Tuple[int, int], int, object]] = []
+        events += [(pos, 0, node) for pos, node in assigns]
+        events += [(pos, 1, (recv, attr, line))
+                   for pos, recv, attr, line in muts]
+        events += [(pos, 2, node) for pos, node in uses]
+        events.sort(key=lambda e: (e[0], e[1]))
+
+        seq = 0
+        taint: Dict[str, Tuple[str, int]] = {}   # var -> (receiver, seq)
+        released: Dict[str, Tuple[str, int, int]] = {}
+        flagged: Set[str] = set()
+        for _pos, kind, payload in events:
+            seq += 1
+            if kind == 0:
+                node = payload
+                v = node.value
+                recv = None
+                if isinstance(v, ast.Call) and \
+                        isinstance(v.func, ast.Attribute) and \
+                        v.func.attr in _SNAPSHOT_READS:
+                    recv = dotted(v.func.value)
+                src = {taint[n.id][0] for n in ast.walk(v)
+                       if isinstance(n, ast.Name) and n.id in taint}
+                names: List[str] = []
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        names.append(t.id)
+                    elif isinstance(t, (ast.Tuple, ast.List)):
+                        names.extend(e.id for e in t.elts
+                                     if isinstance(e, ast.Name))
+                for nm in names:
+                    if recv:
+                        taint[nm] = (recv, seq)
+                    elif src:
+                        taint[nm] = (sorted(src)[0], seq)
+                    else:
+                        taint.pop(nm, None)   # rebound to unrelated data
+            elif kind == 1:
+                recv, attr, line = payload
+                released[recv] = (attr, seq, line)
+            else:
+                node = payload
+                if id(node) in mut_inner or node.id in flagged:
+                    continue
+                hit = taint.get(node.id)
+                if hit is None:
+                    continue
+                recv, tseq = hit
+                rel = released.get(recv)
+                if rel is not None and rel[1] > tseq:
+                    flagged.add(node.id)
+                    findings.append(Finding(
+                        ctx.relpath, "snapshot-read", scope,
+                        f"snap:{node.id}", node.lineno,
+                        f"`{node.id}` was validated by the "
+                        f"`{recv}.snapshot()` generation check, but "
+                        f"`{recv}.{rel[0]}()` (line {rel[2]}) advanced "
+                        f"the table since — the row may describe a "
+                        f"retired replica; finish every use before the "
+                        f"mutating call or re-snapshot after it"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
 # driver
 # ---------------------------------------------------------------------------
 
@@ -1223,6 +1370,7 @@ _CHECKERS = {
     "jit-cache-stability": check_jit_cache_stability,
     "metric-in-hot-loop": check_metric_in_hot_loop,
     "span-leak": check_span_leak,
+    "snapshot-read": check_snapshot_read,
 }
 
 
