@@ -628,6 +628,41 @@ def bench_dispatch_overhead(pipeline_bubble: dict | None = None):
 
 
 def bench_observability_overhead():
+    """Cost ceiling of the passive observability plane. ISSUE 20 widens
+    the measured configuration: the interleaves below now run with the
+    WHOLE health/alert plane live — a tsdb Sampler scraping at 1s, the
+    SLO AlertEvaluator riding its scrape tick, and a Watchdog sweeping
+    the registered loop probes (the engine pump registers one on
+    start()) — so `observability_dispatch_per_s` /
+    `observability_serve_req_per_s` and the <1% targets price
+    recorder + evaluator + watchdog together, not the recorders alone.
+    """
+    from ray_tpu._private import health as health_mod
+    from ray_tpu.util import slo as slo_mod
+    from ray_tpu.util import tsdb as tsdb_mod
+
+    sampler = tsdb_mod.Sampler(interval_s=1.0)
+    evaluator = slo_mod.AlertEvaluator(sampler.db,
+                                       register_metrics=False)
+    evaluator.attach(sampler)
+    sampler.start()
+    watchdog = health_mod.Watchdog(source="BENCH",
+                                   interval_s=0.5).start()
+    try:
+        out = _bench_observability_measured()
+    finally:
+        sampler.stop()
+        watchdog.stop()
+    out["observability_overhead"].update({
+        "alert_plane_active": True,
+        "alert_evaluations": evaluator.evaluations,
+        "watchdog_checks": watchdog.checks,
+        "alerts_fired_during_bench": evaluator.firing(),
+    })
+    return out
+
+
+def _bench_observability_measured():
     """Cost ceiling of the flight-recorder plane (ISSUE 5): the step
     profiler is ALWAYS ON, so its price on the sub-2 ms dispatch path
     PR 4 bought must stay under 1%. Times the same cached-executable

@@ -2633,6 +2633,17 @@ class CoreWorker:
             self._on_ref_released(oid)
         return {"ok": True}
 
+    async def rpc_dump_stacks(self, req):
+        """All Python thread stacks of this worker/driver process for
+        `ray_tpu stack`. Served from the RPC loop thread, so a task
+        wedging the executor thread still gets its stack reported —
+        which is the whole point of asking."""
+        from ray_tpu._private import health as health_mod
+
+        return {"pid": os.getpid(), "role": "worker",
+                "worker_id": self.worker_id.binary().hex(),
+                "threads": health_mod.dump_stacks()}
+
     async def rpc_exit_worker(self, req):
         logger.info("exit requested: %s", req.get("reason"))
         self._exec_queue.put(None)

@@ -22,6 +22,7 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional
 
 from ray_tpu._private import fault_injection as _fi
+from ray_tpu._private import health as health_mod
 from ray_tpu._private import rpc
 from ray_tpu._private import sharded_table
 from ray_tpu._private import task as task_mod
@@ -98,6 +99,14 @@ class GcsServer:
             ThreadPoolExecutor(1, f"gcs-store-{i}")
             for i in range(ShardedTable.DEFAULT_SHARDS)]
             if self.store else None)
+        # deadman probe over the persist executors: beats land in
+        # _store_put on the shard threads; backlog is the queued writes
+        # across all shards, so a wedged store thread (disk hang) reads
+        # as frozen-counter-with-backlog and gets its stack captured
+        self._store_probe = (health_mod.watch_loop(
+            "gcs_store", backlog_fn=self._store_backlog)
+            if self._store_pools else None)
+        self._watchdog: Optional[health_mod.Watchdog] = None
         if self.store is not None and self.store.tables():
             self._load_from_store()
         elif persist_path:
@@ -213,7 +222,13 @@ class GcsServer:
             sharded_table.shard_index(key, len(self._store_pools))]
         pool.submit(self._store_put, table, key, blob)
 
+    def _store_backlog(self) -> int:
+        return sum(p._work_queue.qsize()
+                   for p in (self._store_pools or []))
+
     def _store_put(self, table, key, blob):
+        if self._store_probe is not None:
+            self._store_probe.beat()
         try:
             self.store.put_blob(table, key, blob)
         except Exception:  # noqa: BLE001 — durability is best-effort
@@ -283,14 +298,20 @@ class GcsServer:
                 + self.actors.metrics_text()
                 + self.task_events.metrics_text()
                 + scheduling_mod.metrics_text()
-                + rpc.metrics_text())
+                + rpc.metrics_text()
+                + health_mod.metrics_text())
 
     async def start(self, metrics_port: int | None = None):
         self.server.register_all(self)
         await self.server.start()
+        self._watchdog = health_mod.Watchdog(source="GCS").start()
         self._bg_tasks = [
             asyncio.ensure_future(self._health_check_loop()),
             asyncio.ensure_future(self._retry_loop()),
+            # event-loop liveness: every handler (and the persist fan-in)
+            # rides this loop — a blocked loop freezes the ticker
+            health_mod.loop_ticker(
+                health_mod.watch_loop("gcs_loop")),
         ]
         if self.persist_path:
             self._bg_tasks.append(
@@ -311,6 +332,8 @@ class GcsServer:
     async def stop(self):
         for t in self._bg_tasks:
             t.cancel()
+        if self._watchdog is not None:
+            self._watchdog.stop()
         if self._metrics_server is not None:
             self._metrics_server.close()
         if self.persist_path:
@@ -517,6 +540,13 @@ class GcsServer:
         """Prometheus text over RPC: lets bench.py and tooling scrape
         the shard/scheduler counters without a metrics port."""
         return {"text": self._metrics_text()}
+
+    async def rpc_dump_stacks(self, req):
+        """All Python thread stacks of the GCS process (+ held-lock info
+        when lockdep is armed) — the head-node contribution to
+        `ray_tpu stack`, the distributed analog of `ray stack`."""
+        return {"pid": os.getpid(), "role": "gcs",
+                "threads": health_mod.dump_stacks()}
 
     async def rpc_get_cluster_load(self, req):
         """Aggregate demand/idleness snapshot for the autoscaler

@@ -141,12 +141,50 @@ def _stack() -> str:
 # per-thread held stack: list of [lock, recursion_count]
 _tls = threading.local()
 
+# Cross-thread view for hang diagnosis (health.dump_stacks): every
+# thread's held list, keyed by ident, registered the first time the
+# thread touches a tracked lock. Reads are best-effort snapshots — the
+# lists mutate concurrently, but each mutation is a single list op, so
+# a reader sees a coherent recent state, which is all a stack dump
+# needs. Guarded by a raw lock (never part of the order graph).
+_all_held: Dict[int, List[List[object]]] = {}
+_all_held_mu = _REAL_LOCK()
+
 
 def _held() -> List[List[object]]:
     h = getattr(_tls, "held", None)
     if h is None:
         h = _tls.held = []
+        with _all_held_mu:
+            _all_held[threading.get_ident()] = h
     return h
+
+
+def held_locks() -> Dict[int, List[str]]:
+    """{thread_ident: [lock names]} of currently-held tracked locks
+    across ALL threads. Dead threads are pruned as a side effect."""
+    import sys
+
+    alive = set(sys._current_frames())
+    with _all_held_mu:
+        dead = [ident for ident in _all_held if ident not in alive]
+        for ident in dead:
+            del _all_held[ident]
+        items = [(ident, list(held)) for ident, held in _all_held.items()]
+    out: Dict[int, List[str]] = {}
+    for ident, held in items:
+        names = []
+        for entry in held:
+            try:
+                lock, count = entry
+                name = lock._ld_name
+            except Exception:  # noqa: BLE001 — entry mutated under us
+                continue
+            names.append(name if count <= 1
+                         else f"{name} (depth {count})")
+        if names:
+            out[ident] = names
+    return out
 
 
 _GRAPH: Optional[_Graph] = None
@@ -227,6 +265,9 @@ class _TrackedLockBase:
         # this off the lock for os.register_at_fork
         self._ld_inner._at_fork_reinit()
         _tls.__dict__.pop("held", None)
+        # child is single-threaded here: parent threads' held lists are
+        # meaningless (and their idents unreachable) — drop them
+        _all_held.clear()
 
     def __repr__(self) -> str:
         return f"<tracked {self._ld_name} of {self._ld_inner!r}>"
@@ -314,8 +355,10 @@ def uninstall() -> None:
     threading.Lock = _REAL_LOCK
     threading.RLock = _REAL_RLOCK
     _GRAPH = None
-    if getattr(_tls, "held", None):
-        _tls.held = []
+    held = getattr(_tls, "held", None)
+    if held:
+        # clear IN PLACE: the cross-thread registry aliases this list
+        del held[:]
 
 
 def cycle_reports() -> List[str]:

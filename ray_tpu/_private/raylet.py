@@ -29,6 +29,7 @@ from typing import Dict, List, Optional
 
 from ray_tpu._private import accelerators
 from ray_tpu._private import fault_injection as _fi
+from ray_tpu._private import health as health_mod
 from ray_tpu._private import rpc as rpc_mod
 from ray_tpu._private import task as task_mod
 from ray_tpu._private.config import Config
@@ -162,6 +163,14 @@ class Raylet:
                 1.0,
                 float(lease.resources.get("CPU", 0.0) or 0.0)
                 + float(lease.resources.get("TPU", 0.0) or 0.0)))
+        # Deadman probe for the dispatch drain. The drain is
+        # event-driven on this loop, so liveness is proven two ways:
+        # every _dispatch() pass beats, and a loop_ticker (started in
+        # start()) beats between events — a blocked event loop freezes
+        # both while the ticker's constant backlog keeps the deadman
+        # armed. A quiet-but-healthy raylet keeps ticking.
+        self._dispatch_probe = health_mod.watch_loop("raylet_dispatch")
+        self._watchdog: Optional[health_mod.Watchdog] = None
         self._lease_seq = itertools.count(1)
         self._bundles: Dict[tuple, Dict[str, float]] = {}  # committed PG bundles
         self._bundle_available: Dict[tuple, Dict[str, float]] = {}
@@ -257,11 +266,14 @@ class Raylet:
         return ("\n".join(lines) + "\n"
                 + self.store.metrics_text()
                 + scheduling_mod.metrics_text()
-                + rpc_mod.metrics_text())
+                + rpc_mod.metrics_text()
+                + health_mod.metrics_text())
 
     async def start(self, metrics_port: int | None = None):
         self.server.register_all(self)
         await self.server.start()
+        self._watchdog = health_mod.Watchdog(source="RAYLET").start()
+        self._bg.append(health_mod.loop_ticker(self._dispatch_probe))
         if metrics_port is not None:
             from ray_tpu.util.metrics import serve_metrics
 
@@ -314,6 +326,8 @@ class Raylet:
     async def stop(self):
         for t in self._bg:
             t.cancel()
+        if self._watchdog is not None:
+            self._watchdog.stop()
         if self._metrics_server is not None:
             self._metrics_server.close()
         for w in self._workers.values():
@@ -1027,6 +1041,8 @@ class Raylet:
         LocalTaskManager::ScheduleAndDispatchTasks, drained through the
         per-job FairDispatchQueue instead of FIFO)."""
         from ray_tpu._private.runtime_env import env_hash as _env_hash
+
+        self._dispatch_probe.beat()
 
         # key -> (shortfall count, runtime_env wire) for leases that hold
         # resources but lack a worker.
@@ -1746,6 +1762,35 @@ class Raylet:
     async def rpc_metrics_text(self, req):
         """Prometheus text over RPC (same rationale as the GCS twin)."""
         return {"text": self._metrics_text()}
+
+    async def rpc_dump_stacks(self, req):
+        """This raylet's Python thread stacks, optionally fanned out to
+        every registered worker on the node (`req['workers']`) — one
+        node's contribution to `ray_tpu stack --all`. Workers answer on
+        their core-worker RPC loop, which lives on its own thread, so a
+        worker whose MAIN thread is wedged still reports the stack that
+        proves it; a worker that can't answer at all contributes an
+        error row instead of stalling the aggregate (bounded timeout)."""
+        out = {"pid": os.getpid(), "role": "raylet",
+               "node_id": self.node_id.binary().hex(),
+               "threads": health_mod.dump_stacks()}
+        if req.get("workers"):
+            timeout = float(req.get("timeout", 5.0))
+            rows = []
+            for w in list(self._workers.values()):
+                if not w.alive:
+                    continue
+                try:
+                    client = await self.clients.get(w.addr)
+                    r = await client.call("dump_stacks", {},
+                                          timeout=timeout)
+                    rows.append(r)
+                except (ConnectionLost, RpcError, OSError,
+                        asyncio.TimeoutError) as e:
+                    rows.append({"pid": w.pid, "role": "worker",
+                                 "error": f"{type(e).__name__}: {e}"})
+            out["workers"] = rows
+        return out
 
     async def rpc_get_store_stats(self, req):
         return self.store.stats()

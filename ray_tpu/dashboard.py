@@ -4,7 +4,8 @@ Reference: `dashboard/` (aiohttp head process with pluggable modules;
 `state_aggregator.py` backing the state API, `dashboard/client/` React
 SPA). Here one aiohttp app serves the same JSON surface —
 /api/nodes, /api/tasks, /api/actors, /api/objects, /api/jobs,
-/api/cluster_load, /api/timeline — plus a self-contained HTML page;
+/api/cluster_load, /api/timeline, /api/alerts — plus a self-contained
+HTML page;
 heavyweight SPA tooling is out of scope.
 """
 
@@ -359,6 +360,14 @@ class Dashboard:
         sampler = tsdb_mod.Sampler().start()
         app.router.add_get("/api/timeseries",
                            j(lambda: sampler.db.snapshot()))
+
+        # SLO alert plane: the evaluator rides the sampler's scrape
+        # tick (Monarch-style pull evaluation — rules never touch a
+        # request path); /api/alerts serves its live snapshot
+        from ray_tpu.util import slo as slo_mod
+
+        evaluator = slo_mod.AlertEvaluator(sampler.db).attach(sampler)
+        app.router.add_get("/api/alerts", j(evaluator.snapshot))
 
         def requests_panel():
             # request-path flight recorder: merged cross-process shards

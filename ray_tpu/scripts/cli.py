@@ -421,6 +421,13 @@ def cmd_top(args):
 
     def render(view: dict) -> str:
         out = []
+        if db.scrape_errors:
+            # a metrics callback somewhere is throwing — the table below
+            # is missing that source's series, say so up front
+            out.append("DEGRADED (source="
+                       + ", ".join(sorted(db.scrape_errors)) + "): "
+                       + " | ".join(db.scrape_errors[s]
+                                    for s in sorted(db.scrape_errors)))
         for dep in view["deployments"]:
             name = dep["deployment"]
             rows = dep["replicas"]
@@ -492,7 +499,159 @@ def cmd_top(args):
             if args.iterations is None or i < args.iterations:
                 time_mod.sleep(args.interval)
     except KeyboardInterrupt:
-        pass
+        print()  # drop the shell prompt below the ^C echo
+    finally:
+        try:
+            ray_tpu.shutdown()
+        except KeyboardInterrupt:
+            pass  # second ^C mid-teardown: exit quietly anyway
+
+
+def cmd_alerts(args):
+    """Evaluate the SLO alert pack against a short live scrape window
+    and print every rule's state (the CLI face of `util.slo`; the
+    dashboard serves the same snapshot at /api/alerts). Scrapes a few
+    ticks so windowed measurements (rates, quantiles) have deltas to
+    work with, then lists recent alert/health transitions from the
+    structured event log."""
+    ray_tpu = _connect(args)
+    from ray_tpu.util import slo as slo_mod
+    from ray_tpu.util import tsdb as tsdb_mod
+
+    try:
+        db = tsdb_mod.TSDB()
+        evaluator = slo_mod.AlertEvaluator(db, register_metrics=False)
+        ticks = max(2, args.scrapes)
+        for i in range(ticks):
+            tsdb_mod.scrape_once(db)
+            evaluator.evaluate()
+            if i + 1 < ticks:
+                time.sleep(args.interval)
+        snap = evaluator.snapshot()
+        if args.json:
+            print(json.dumps(snap, indent=2))
+            return
+        if db.scrape_errors:
+            print("DEGRADED (source="
+                  + ", ".join(sorted(db.scrape_errors)) + ")")
+        for a in snap["alerts"]:
+            mark = {"firing": "!", "pending": "~"}.get(a["state"], " ")
+            fast = ("-" if a["fast_value"] is None
+                    else f"{a['fast_value']:.4g}")
+            slow = ("-" if a["slow_value"] is None
+                    else f"{a['slow_value']:.4g}")
+            print(f" {mark} {a['rule']:24} {a['state']:7} "
+                  f"{a['metric']} {a['op']} {a['threshold']:g}   "
+                  f"fast={fast} slow={slow}")
+        firing = snap["firing"]
+        print(f"{len(firing)} firing"
+              + (": " + ", ".join(firing) if firing else "")
+              + f"   ({len(snap['alerts'])} rules, "
+                f"{snap['evaluations']} evaluations)")
+        if args.history:
+            from ray_tpu.util.events import list_events
+
+            import datetime
+
+            wanted = ("ALERT_FIRING", "ALERT_RESOLVED",
+                      "health.stalled", "health.recovered")
+            evs = [e for e in list_events()
+                   if e.get("label") in wanted][-args.history:]
+            for ev in evs:
+                ts = datetime.datetime.fromtimestamp(
+                    ev["ts"]).strftime("%H:%M:%S")
+                print(f"  {ts} [{ev['severity']:7}] "
+                      f"{ev['label']:15} {ev['message']}")
+    finally:
+        ray_tpu.shutdown()
+
+
+def cmd_stack(args):
+    """Cluster-wide hang diagnosis (reference: `ray stack`): pull the
+    `dump_stacks` RPC from the GCS and every raylet — fanned out to
+    each node's workers with --all — plus this CLI process, and render
+    one annotated report: per-thread stacks, held tracked locks when
+    lockdep is armed, and [STALLED] marks on threads the deadman
+    watchdog has flagged."""
+    ray_tpu = _connect(args)
+    from ray_tpu._private import health as health_mod
+    from ray_tpu._private import worker_api
+
+    try:
+        cw = worker_api._global_state.core_worker
+        nodes = [n for n in ray_tpu.nodes() if n["Alive"]]
+        if args.node:
+            nodes = [n for n in nodes
+                     if n["NodeID"].startswith(args.node)]
+            if not nodes:
+                raise SystemExit(f"no alive node matching {args.node!r}")
+
+        async def collect():
+            reports = []
+            if not args.node:
+                try:
+                    reports.append(await cw.gcs.call(
+                        "dump_stacks", {}, timeout=10.0))
+                except Exception as e:  # noqa: BLE001 — partial report
+                    reports.append({"role": "gcs", "error":
+                                    f"{type(e).__name__}: {e}"})
+            for n in nodes:
+                try:
+                    raylet = await cw._clients.get(n["RayletAddr"])
+                    reports.append(await raylet.call(
+                        "dump_stacks", {"workers": bool(args.all)},
+                        timeout=15.0))
+                except Exception as e:  # noqa: BLE001
+                    reports.append({"role": "raylet",
+                                    "node_id": n["NodeID"], "error":
+                                    f"{type(e).__name__}: {e}"})
+            return reports
+
+        reports = cw._run_sync(collect())
+        # this process too — a hang report that can't see the observer
+        # is one process short of the truth
+        reports.append({"pid": os.getpid(), "role": "cli",
+                        "threads": health_mod.dump_stacks()})
+        flat = []
+        for rep in reports:
+            workers = rep.pop("workers", None) if isinstance(rep, dict) \
+                else None
+            flat.append(rep)
+            flat.extend(workers or [])
+        if args.json:
+            print(json.dumps(flat, indent=2))
+            return
+        stalled = 0
+        for rep in flat:
+            who = rep.get("role", "?")
+            if rep.get("node_id"):
+                who += f" node={rep['node_id'][:12]}"
+            if rep.get("worker_id"):
+                who += f" worker={rep['worker_id'][:12]}"
+            if rep.get("error"):
+                print(f"==== {who} pid={rep.get('pid', '?')} "
+                      f"UNREACHABLE: {rep['error']} ====")
+                continue
+            threads = rep.get("threads", [])
+            print(f"==== {who} pid={rep['pid']} "
+                  f"({len(threads)} threads) ====")
+            for t in threads:
+                marks = ""
+                if t.get("loop"):
+                    marks += f" [loop={t['loop']}]"
+                if t.get("stalled"):
+                    marks += " [STALLED]"
+                    stalled += 1
+                if t.get("held_locks"):
+                    marks += " [holds: " + ", ".join(
+                        t["held_locks"]) + "]"
+                print(f"-- {t['name']} (ident={t['ident']}, "
+                      f"daemon={t['daemon']}){marks}")
+                print("  " + t["stack"].rstrip().replace("\n", "\n  "))
+        procs = len([r for r in flat if not r.get("error")])
+        print(f"[{procs} processes, "
+              f"{sum(len(r.get('threads', [])) for r in flat)} threads"
+              + (f", {stalled} STALLED" if stalled else "") + "]")
     finally:
         ray_tpu.shutdown()
 
@@ -703,6 +862,32 @@ def main(argv=None):
     p.set_defaults(fn=cmd_top)
 
     p = sub.add_parser(
+        "alerts",
+        help="evaluate the SLO alert rules over a live scrape window")
+    p.add_argument("--address")
+    p.add_argument("--scrapes", type=int, default=3,
+                   help="scrape ticks to evaluate over (default 3)")
+    p.add_argument("--interval", type=float, default=2.0,
+                   help="seconds between scrape ticks (default 2)")
+    p.add_argument("--history", type=int, default=10,
+                   help="recent alert/health events to list (0=none)")
+    p.add_argument("--json", action="store_true",
+                   help="raw evaluator snapshot instead of the table")
+    p.set_defaults(fn=cmd_alerts)
+
+    p = sub.add_parser(
+        "stack",
+        help="cluster-wide Python stack dump (hang diagnosis)")
+    p.add_argument("--address")
+    p.add_argument("--node", metavar="N",
+                   help="only the node whose NodeID starts with N")
+    p.add_argument("--all", action="store_true",
+                   help="also fan out to every worker process per node")
+    p.add_argument("--json", action="store_true",
+                   help="raw per-process reports instead of the report")
+    p.set_defaults(fn=cmd_stack)
+
+    p = sub.add_parser(
         "client-server",
         help="serve remote 'client://' drivers against this cluster")
     p.add_argument("--address", required=True)
@@ -741,7 +926,12 @@ def main(argv=None):
     p.set_defaults(fn=cmd_job)
 
     args = parser.parse_args(argv)
-    args.fn(args)
+    try:
+        args.fn(args)
+    except KeyboardInterrupt:
+        # operator ^C is a normal way to leave any live view — exit
+        # with the conventional 130, never a traceback
+        raise SystemExit(130)
 
 
 if __name__ == "__main__":

@@ -19,6 +19,7 @@ from typing import Any, Dict, List, Optional
 
 import ray_tpu
 from ray_tpu._private import fault_injection as _fi
+from ray_tpu._private import health as health_mod
 from ray_tpu.serve import dispatch as _dispatch
 from ray_tpu.util import metrics as _metrics
 from ray_tpu.util import request_recorder as _rr
@@ -500,6 +501,16 @@ class Router:
         # sleep-polling (tokens advisory — a lost one costs one slice)
         self._wake = _dispatch._Wakeup(
             _dispatch.router_wake_path(deployment_name))
+        # deadman probe over the wake loop: beats happen OUTSIDE
+        # self._lock (a watchdog that needs the router's lock could
+        # never fire while it is stuck); backlog = choosers currently
+        # parked, so a quiet router is healthy but a parked chooser
+        # whose beats stop (e.g. _refresh wedged against the
+        # controller) is a captured stall
+        self._parked = 0
+        self._probe = health_mod.watch_loop(
+            f"serve_router_{deployment_name}",
+            backlog_fn=lambda: self._parked)
 
     def _refresh(self, force: bool = False):
         now = time.monotonic()
@@ -523,26 +534,34 @@ class Router:
         self._refresh()
         deadline = time.monotonic() + 30.0
         counted_wait = False
-        while True:
-            with self._lock:
-                keys = list(self._replicas)
-                if keys:
-                    if len(keys) == 1:
-                        key = keys[0]
-                    else:
-                        a, b = self._rng.sample(keys, 2)
-                        key = a if self._inflight.get(a, 0) <= \
-                            self._inflight.get(b, 0) else b
-                    self._inflight[key] = self._inflight.get(key, 0) + 1
-                    return key, self._replicas[key]
-            if time.monotonic() > deadline:
-                raise RuntimeError(
-                    f"no replicas available for {self._name!r}")
-            if not counted_wait:
-                counted_wait = True  # once per empty episode
-                ROUTER_EMPTY_WAITS.inc(tags={"deployment": self._name})
-            self._wake.wait(0.25)
-            self._refresh(force=True)
+        try:
+            while True:
+                with self._lock:
+                    keys = list(self._replicas)
+                    if keys:
+                        if len(keys) == 1:
+                            key = keys[0]
+                        else:
+                            a, b = self._rng.sample(keys, 2)
+                            key = a if self._inflight.get(a, 0) <= \
+                                self._inflight.get(b, 0) else b
+                        self._inflight[key] = \
+                            self._inflight.get(key, 0) + 1
+                        return key, self._replicas[key]
+                if time.monotonic() > deadline:
+                    raise RuntimeError(
+                        f"no replicas available for {self._name!r}")
+                if not counted_wait:
+                    counted_wait = True  # once per empty episode
+                    ROUTER_EMPTY_WAITS.inc(
+                        tags={"deployment": self._name})
+                    self._parked += 1
+                self._probe.beat()
+                self._wake.wait(0.25)
+                self._refresh(force=True)
+        finally:
+            if counted_wait:
+                self._parked -= 1
 
     def done(self, key: str):
         with self._lock:

@@ -1110,12 +1110,24 @@ class LLMEngine:
         if self._thread is not None:
             return
         self._stop.clear()
+        # deadman probe: one beat per pump pass, backlog read lock-free
+        # (bare len() under the GIL — the watchdog must never need the
+        # engine lock, or it could not fire while that lock is stuck)
+        from ray_tpu._private import health as health_mod
+
+        self._pump_probe = health_mod.watch_loop(
+            f"llm_engine_pump_{id(self) & 0xffffff:06x}",
+            backlog_fn=lambda: (len(self._waiting)
+                                + len(self._prefilling)
+                                + len(self._running)))
+        health_mod.ensure_watchdog(source="SERVE_LLM")
         self._thread = threading.Thread(
             target=self._pump, name="llm-engine", daemon=True)
         self._thread.start()
 
     def _pump(self):
         while not self._stop.is_set():
+            self._pump_probe.beat()
             drained = self._drain_intake()
             if not self.step() and not drained:
                 self._work.clear()
@@ -1134,6 +1146,10 @@ class LLMEngine:
         if self._thread is not None:
             self._thread.join(timeout=10)
             self._thread = None
+            from ray_tpu._private import health as health_mod
+
+            health_mod.unwatch_loop(
+                f"llm_engine_pump_{id(self) & 0xffffff:06x}")
 
     # -- lifecycle / introspection ---------------------------------------
 

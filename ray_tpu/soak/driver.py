@@ -415,6 +415,17 @@ class SoakDriver:
             "prefetch_batches": cfg.prefetch_batches,
             "stop_file": stop_file,
         }
+        from ray_tpu._private import health as health_mod
+
+        # deadman over the controller loop: one beat per result round.
+        # Backlog is constant 1 while training is live — a report is
+        # always owed — so a stall anywhere under get_next_results
+        # (e.g. an injected data_stall freezing the gang) shows up as a
+        # frozen counter and gets the driver stack captured.
+        drive_probe = health_mod.watch_loop("soak_driver",
+                                            backlog_fn=lambda: 1)
+        health_mod.ensure_watchdog(source="SOAK")
+
         ckpt_manager = CheckpointManager()
         t_start = time.time()
         t_end = t_start + cfg.budget_s
@@ -457,6 +468,7 @@ class SoakDriver:
                     _soak_train_loop, config=loop_config,
                     datasets={"train": shards}, checkpoint=restore)
                 while True:
+                    drive_probe.beat()
                     results = executor.get_next_results(
                         timeout=cfg.result_timeout_s)
                     if results is None:
@@ -511,6 +523,7 @@ class SoakDriver:
                 executor.shutdown()
                 raise
 
+        health_mod.unwatch_loop("soak_driver")
         return {
             "mode": cfg.mode,
             "seed": cfg.seed,

@@ -41,20 +41,54 @@ def event_dir() -> str:
     return os.environ.get("RAY_TPU_EVENT_DIR", "/tmp/ray_tpu/events")
 
 
-def _writer(source: str):
+def _max_bytes() -> int:
+    """Per-shard size cap (0 = unbounded, the historical behavior)."""
+    try:
+        return int(os.environ.get("RAY_TPU_EVENTS_MAX_BYTES", "0"))
+    except ValueError:
+        return 0
+
+
+def _keep() -> int:
+    """Rotated generations retained per shard (plus the active file)."""
+    try:
+        return max(1, int(os.environ.get("RAY_TPU_EVENTS_KEEP", "3")))
+    except ValueError:
+        return 3
+
+
+def _shard_base(source: str) -> str:
+    return os.path.join(event_dir(),
+                        f"event_{source}_{os.getpid()}")
+
+
+def _writer_locked(source: str):
     f = _files.get(source)
     if f is None:
-        with _lock:
-            f = _files.get(source)
-            if f is None:
-                os.makedirs(event_dir(), exist_ok=True)
-                f = open(
-                    os.path.join(
-                        event_dir(),
-                        f"event_{source}_{os.getpid()}.jsonl"),
-                    "a", buffering=1)
-                _files[source] = f
+        os.makedirs(event_dir(), exist_ok=True)
+        f = open(f"{_shard_base(source)}.jsonl", "a", buffering=1)
+        _files[source] = f
     return f
+
+
+def _rotate_locked(source: str, f) -> None:
+    """Shift `<base>.N.jsonl` generations up (dropping the oldest past
+    keep-last-K) and retire the active shard to `.1`. Rotation happens
+    strictly BETWEEN whole-line writes under the module lock, so no
+    JSON line is ever torn across files. Rotated names keep the
+    `.jsonl` suffix so `list_events()`'s glob still merges them."""
+    f.close()
+    _files.pop(source, None)
+    base = _shard_base(source)
+    keep = _keep()
+    try:
+        for n in range(keep - 1, 0, -1):
+            src = f"{base}.{n}.jsonl"
+            if os.path.exists(src):
+                os.replace(src, f"{base}.{n + 1}.jsonl")
+        os.replace(f"{base}.jsonl", f"{base}.1.jsonl")
+    except OSError:
+        pass  # next report() reopens the active shard either way
 
 
 def report(source: str, severity: str, label: str, message: str,
@@ -73,8 +107,20 @@ def report(source: str, severity: str, label: str, message: str,
         **fields,
     }
     try:
-        _writer(source).write(json.dumps(ev) + "\n")
-    except (OSError, TypeError):
+        line = json.dumps(ev) + "\n"
+    except TypeError:
+        return ev
+    try:
+        # one lock for write + rotation check: a concurrent rotation can
+        # never close a handle mid-write, and each line lands whole in
+        # exactly one generation
+        with _lock:
+            f = _writer_locked(source)
+            f.write(line)
+            limit = _max_bytes()
+            if limit and f.tell() >= limit:
+                _rotate_locked(source, f)
+    except OSError:
         pass
     return ev
 

@@ -34,11 +34,16 @@ import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 # families worth remembering by default: the serving plane, the training
-# flight recorder, and the contention counters the daemons expose.
+# flight recorder, the contention counters the daemons expose, and the
+# SLO/health plane (alert + watchdog rows feed back into their own rules).
 DEFAULT_PREFIXES = (
     "serve_", "train_step_", "scheduler_", "raylet_", "gcs_table_",
     "rpc_", "object_store_", "compile_cache_", "channel_",
-    "compiled_dispatch_",
+    "compiled_dispatch_", "alert", "health_",
+    # the ownership plane's rows are built from a name/kind/value table
+    # in core_worker._metrics_text, invisible to raylint's
+    # exposition-literal scan
+    "ray_tpu_reconstruction",  # raylint: disable=surface-drift
 )
 
 
@@ -103,6 +108,12 @@ class TSDB:
         self._lock = threading.Lock()
         self.dropped_series = 0
         self.scrapes = 0
+        # source -> detail of the newest `# scrape_error` comment seen in
+        # that source's body (cleared when a clean body arrives). The
+        # parser drops comments, so degraded-source detection has to
+        # happen here at ingest — `ray_tpu top` renders these as a
+        # DEGRADED banner instead of silently showing stale numbers.
+        self.scrape_errors: Dict[str, str] = {}
 
     def _key(self, name: str, labels: Dict[str, str],
              source: str) -> tuple:
@@ -115,8 +126,14 @@ class TSDB:
         ts = time.time() if ts is None else ts
         kept = 0
         samples = parse_prometheus_text(text)
+        errors = [line.strip() for line in text.splitlines()
+                  if line.strip().startswith("# scrape_error")]
         with self._lock:
             self.scrapes += 1
+            if errors:
+                self.scrape_errors[source] = errors[-1][1:].strip()
+            else:
+                self.scrape_errors.pop(source, None)
             for name, labels, value in samples:
                 if self.prefixes and not name.startswith(self.prefixes):
                     continue
@@ -181,6 +198,49 @@ class TSDB:
             return None
         return max(0.0, (v1 - v0) / (t1 - t0))
 
+    def increase(self, name: str,
+                 labels: Optional[Dict[str, str]] = None,
+                 source: Optional[str] = None,
+                 window_s: float = 60.0) -> Optional[float]:
+        """Total counter growth over the trailing window, summing
+        per-segment deltas with the same monotonic-reset clamping as
+        `rate()`: a negative step (daemon restart) contributes 0 — the
+        reset reads as a quiet period, not as negative growth."""
+        pts = self.points(name, labels, source)
+        if len(pts) < 2:
+            return None
+        cutoff = pts[-1][0] - window_s
+        window = [p for p in pts if p[0] >= cutoff]
+        if len(window) < 2:
+            window = pts[-2:]
+        return sum(max(0.0, v1 - v0)
+                   for (_, v0), (_, v1) in zip(window, window[1:]))
+
+    def _window_values(self, name, labels, source,
+                       window_s) -> List[float]:
+        pts = self.points(name, labels, source)
+        if not pts:
+            return []
+        cutoff = pts[-1][0] - window_s
+        return [v for t, v in pts if t >= cutoff]
+
+    def avg_over_time(self, name: str,
+                      labels: Optional[Dict[str, str]] = None,
+                      source: Optional[str] = None,
+                      window_s: float = 60.0) -> Optional[float]:
+        """Mean of a gauge's points inside the trailing window (at
+        least the latest point always qualifies)."""
+        vals = self._window_values(name, labels, source, window_s)
+        return sum(vals) / len(vals) if vals else None
+
+    def max_over_time(self, name: str,
+                      labels: Optional[Dict[str, str]] = None,
+                      source: Optional[str] = None,
+                      window_s: float = 60.0) -> Optional[float]:
+        """Max of a gauge's points inside the trailing window."""
+        vals = self._window_values(name, labels, source, window_s)
+        return max(vals) if vals else None
+
     def snapshot(self, max_points: int = 120) -> Dict[str, Any]:
         """JSON-able view for /api/timeseries: every series with its
         trailing points."""
@@ -196,6 +256,7 @@ class TSDB:
                 })
             return {"series": out, "scrapes": self.scrapes,
                     "dropped_series": self.dropped_series,
+                    "scrape_errors": dict(self.scrape_errors),
                     "max_series": self.max_series,
                     "max_points": self.max_points}
 
@@ -230,6 +291,57 @@ def histogram_quantile(db: TSDB, family: str, q: float,
         agg[bound] = agg.get(bound, 0.0) + cum
     ordered = sorted(agg.items())
     total = ordered[-1][1]
+    if total <= 0:
+        return None
+    target = q * total
+    prev_bound, prev_cum = 0.0, 0.0
+    for bound, cum in ordered:
+        if cum >= target:
+            if bound == float("inf"):
+                return prev_bound
+            span = cum - prev_cum
+            frac = ((target - prev_cum) / span) if span > 0 else 1.0
+            return prev_bound + frac * (bound - prev_bound)
+        prev_bound, prev_cum = (bound, cum)
+    return ordered[-1][0]
+
+
+def histogram_quantile_over_time(db: TSDB, family: str, q: float,
+                                 labels: Optional[Dict[str, str]] = None,
+                                 source: Optional[str] = None,
+                                 window_s: float = 60.0
+                                 ) -> Optional[float]:
+    """Quantile of the observations that LANDED inside the trailing
+    window: per-`le` bucket `increase()` over the window, then the same
+    interpolation as `histogram_quantile`. This is what a windowed SLO
+    rule wants — the all-time cumulative quantile can never recover
+    after one bad burst, a windowed one does. Falls back to the
+    cumulative estimate when the window holds fewer than two scrapes
+    (a fresh tsdb)."""
+    per_bound: Dict[float, float] = {}
+    saw_window = False
+    with db._lock:
+        keys = [k for k in db._series
+                if k[0] == f"{family}_bucket"]
+    for (name, litems, src) in keys:
+        if source is not None and src != source:
+            continue
+        ld = dict(litems)
+        le = ld.pop("le", None)
+        if le is None:
+            continue
+        if labels and any(ld.get(k) != v for k, v in labels.items()):
+            continue
+        inc = db.increase(name, dict(litems), src, window_s=window_s)
+        if inc is None:
+            continue
+        saw_window = True
+        bound = float("inf") if le in ("+Inf", "inf") else float(le)
+        per_bound[bound] = per_bound.get(bound, 0.0) + inc
+    if not saw_window:
+        return histogram_quantile(db, family, q, labels, source)
+    ordered = sorted(per_bound.items())
+    total = ordered[-1][1] if ordered else 0.0
     if total <= 0:
         return None
     target = q * total
@@ -321,6 +433,10 @@ class Sampler:
         self.interval_s = max(0.1, interval_s)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # Called after every scrape tick with the db — the SLO alert
+        # evaluator rides this so rule evaluation happens exactly at
+        # scrape cadence, never on any request/dispatch hot path.
+        self.on_scrape = None
 
     def start(self) -> "Sampler":
         if self._thread is None:
@@ -334,6 +450,8 @@ class Sampler:
         while not self._stop.is_set():
             try:
                 scrape_once(self.db)
+                if self.on_scrape is not None:
+                    self.on_scrape(self.db)
             except Exception:  # noqa: BLE001 — sampling must not die
                 pass
             self._stop.wait(self.interval_s)

@@ -33,7 +33,7 @@ import pytest  # noqa: E402
 # in their inherited environment and raise in-daemon.
 _LOCKDEP_SUITES = ("test_chaos", "test_object_store", "test_rpc_batch",
                    "test_multitenant", "test_ownership",
-                   "test_dispatch_ring")
+                   "test_dispatch_ring", "test_slo")
 
 
 @pytest.fixture(autouse=True)

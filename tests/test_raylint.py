@@ -1122,6 +1122,61 @@ class TestPrefixCacheRefcountLockDiscipline:
 
 
 # ---------------------------------------------------------------------------
+# checker 9: watchdog-probe
+# ---------------------------------------------------------------------------
+
+class TestWatchdogProbeDiscipline:
+    """Pins the health-plane deadman invariant: a loop's liveness beat
+    must be lock-free. A `probe.beat()` taken inside the watched loop's
+    lock freezes together with that lock — the exact wedge the watchdog
+    exists to catch (a thread stuck on the loop's mutex) then also
+    silences the liveness signal, and the stall is never reported."""
+
+    BAD = """
+        import threading
+
+        class Dispatcher:
+            def __init__(self, probe):
+                self._lock = threading.Lock()
+                self._queue = []
+                self._probe = probe
+
+            def drain(self):
+                with self._lock:
+                    self._probe.beat()
+                    while self._queue:
+                        self._queue.pop()
+    """
+
+    GOOD = """
+        import threading
+
+        class Dispatcher:
+            def __init__(self, probe):
+                self._lock = threading.Lock()
+                self._queue = []
+                self._probe = probe
+
+            def drain(self):
+                self._probe.beat()
+                with self._lock:
+                    while self._queue:
+                        self._queue.pop()
+    """
+
+    def test_beat_under_watched_lock_flagged(self):
+        findings = run(self.BAD)
+        assert any(f.check == "watchdog-probe"
+                   and f.detail == "beat:self._probe.beat"
+                   and f.scope == "Dispatcher.drain"
+                   for f in findings), findings
+
+    def test_beat_outside_loop_lock_clean(self):
+        findings = run(self.GOOD)
+        assert "watchdog-probe" not in checks_of(findings), findings
+
+
+# ---------------------------------------------------------------------------
 # suppression + baseline
 # ---------------------------------------------------------------------------
 

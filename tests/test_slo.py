@@ -1,0 +1,805 @@
+"""SLO & health plane: burn-rate alerting, deadman watchdogs, hang
+diagnosis.
+
+Three layers under test, bottom-up:
+
+- the tsdb's windowed measurements (`increase`/`avg_over_time`/
+  `max_over_time`/`histogram_quantile_over_time`) with monotonic-reset
+  clamping, plus `# scrape_error` degradation tracking;
+- the alert state machine (`util/slo.py`): multi-window entry, `for_s`
+  pending hold, flap suppression while firing, resolution only when
+  both windows clear — driven over synthetic series whose breach
+  timestamps are known exactly, so assertions are arithmetic;
+- the deadman watchdog (`_private/health.py`): a REAL blocked thread is
+  detected, its stack captured into a `health.stalled` event, and the
+  `health_loop_stalled` gauge feeds the SLO plane's deadman rule. The
+  chaos row composes all of it end to end against a RecoveryLedger
+  outage window and is gated N-of-N by tools/flake_gate.py.
+
+Events-rotation tests pin the `RAY_TPU_EVENTS_MAX_BYTES` keep-last-K
+contract: no JSON line is ever torn across generations and
+`list_events()` merges rotated shards transparently.
+"""
+
+import glob
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from ray_tpu.util import slo as slo_mod
+from ray_tpu.util import tsdb as tsdb_mod
+from ray_tpu.util.events import list_events
+
+
+def _db():
+    # no prefix filter: synthetic series keep whatever name reads best
+    return tsdb_mod.TSDB(prefixes=())
+
+
+def _feed(db, rows, ts, source="test"):
+    """Ingest exposition rows (a str or list of str) at an exact ts."""
+    if isinstance(rows, str):
+        rows = [rows]
+    db.ingest("\n".join(rows) + "\n", source=source, ts=ts)
+
+
+# ---------------------------------------------------------------------------
+# tsdb windowed measurements
+# ---------------------------------------------------------------------------
+
+
+def test_increase_sums_deltas_and_clamps_resets():
+    db = _db()
+    # counter: 0 → 40 → 5 (daemon restart) → 25: growth is 40 + 20
+    for i, v in enumerate((0, 40, 5, 25)):
+        _feed(db, f"requests_total {v}", ts=100.0 + 10 * i)
+    assert db.increase("requests_total", window_s=60.0) == \
+        pytest.approx(60.0)
+    # rate over the same window clamps at 0 across the reset pair
+    assert db.rate("requests_total", window_s=12.0) == \
+        pytest.approx(20 / 10)
+    # single point: no delta to measure
+    db2 = _db()
+    _feed(db2, "requests_total 7", ts=100.0)
+    assert db2.increase("requests_total") is None
+
+
+def test_increase_window_cutoff():
+    db = _db()
+    for i, v in enumerate((0, 100, 110, 120)):
+        _feed(db, f"c_total {v}", ts=100.0 + 30 * i)
+    # window spans only the last two intervals (cutoff at last-60)
+    assert db.increase("c_total", window_s=60.0) == pytest.approx(20.0)
+
+
+def test_avg_and_max_over_time():
+    db = _db()
+    for i, v in enumerate((1.0, 3.0, 5.0, 11.0)):
+        _feed(db, f"queue_depth {v}", ts=100.0 + 10 * i)
+    # trailing 20 s window holds the last three points
+    assert db.avg_over_time("queue_depth", window_s=20.0) == \
+        pytest.approx((3 + 5 + 11) / 3)
+    assert db.max_over_time("queue_depth", window_s=20.0) == \
+        pytest.approx(11.0)
+    # the whole history
+    assert db.avg_over_time("queue_depth", window_s=1000.0) == \
+        pytest.approx(5.0)
+    assert db.avg_over_time("missing_series") is None
+    assert db.max_over_time("missing_series") is None
+
+
+def test_histogram_quantile_over_time_is_windowed():
+    """Cumulative buckets remember every bad observation forever; the
+    windowed quantile sees only what landed inside the window. An early
+    burst of slow requests must stop dominating once the window has
+    rolled past it."""
+    db = _db()
+
+    def rows(le_counts):
+        return [f'lat_ms_bucket{{le="{le}"}} {c}'
+                for le, c in le_counts]
+
+    # scrape 1: 100 observations, all slow (≤ +Inf only)
+    _feed(db, rows([("10", 0), ("100", 0), ("+Inf", 100)]), ts=100.0)
+    # scrapes 2..3: 100 more observations, all fast (≤ 10)
+    _feed(db, rows([("10", 50), ("100", 50), ("+Inf", 150)]), ts=160.0)
+    _feed(db, rows([("10", 100), ("100", 100), ("+Inf", 200)]), ts=220.0)
+
+    # cumulative p90 (rank 180 of 200) sits in the slow +Inf bucket
+    cumulative = tsdb_mod.histogram_quantile(db, "lat_ms", 0.9)
+    assert cumulative == pytest.approx(100.0)
+    # windowed over the last 70 s: only fast observations landed there
+    windowed = tsdb_mod.histogram_quantile_over_time(
+        db, "lat_ms", 0.9, window_s=70.0)
+    assert windowed is not None and windowed <= 10.0
+
+
+def test_histogram_quantile_over_time_falls_back_cumulative():
+    db = _db()
+    _feed(db, ['lat_ms_bucket{le="10"} 3', 'lat_ms_bucket{le="+Inf"} 4'],
+          ts=100.0)
+    # one scrape: no window increase yet — cumulative estimate instead
+    got = tsdb_mod.histogram_quantile_over_time(db, "lat_ms", 0.5)
+    assert got == tsdb_mod.histogram_quantile(db, "lat_ms", 0.5)
+    assert tsdb_mod.histogram_quantile_over_time(db, "nope", 0.9) is None
+
+
+def test_scrape_error_tracked_and_cleared():
+    db = _db()
+    db.ingest('ok_metric 1\n# scrape_error source="engine" '
+              'error="TypeError"\n', source="local")
+    assert "local" in db.scrape_errors
+    assert "engine" in db.scrape_errors["local"]
+    assert db.snapshot()["scrape_errors"]["local"]
+    # a clean scrape from the same source clears the degradation
+    db.ingest("ok_metric 2\n", source="local")
+    assert db.scrape_errors == {}
+
+
+def test_registry_callback_failure_renders_scrape_error():
+    """A throwing metrics callback degrades to a `# scrape_error`
+    comment (the DEGRADED banner's trigger) instead of poisoning the
+    whole exposition body."""
+    from ray_tpu.util.metrics import _Registry
+
+    reg = _Registry()
+    reg.register_callback("boom", lambda: 1 / 0)
+    text = reg.prometheus_text()
+    assert '# scrape_error source="boom"' in text
+    db = tsdb_mod.TSDB()
+    db.ingest(text, source="local")
+    assert "boom" in db.scrape_errors["local"]
+
+
+# ---------------------------------------------------------------------------
+# alert state machine
+# ---------------------------------------------------------------------------
+
+
+def _gauge_rule(**kw):
+    kw.setdefault("fast_window_s", 10.0)
+    kw.setdefault("slow_window_s", 40.0)
+    return slo_mod.Rule(kw.pop("name", "test-queue"),
+                        kw.pop("metric", "queue_depth"),
+                        kw.pop("threshold", 5.0), **kw)
+
+
+def _evaluator(db, rules, tmp_path, monkeypatch, source="SLO_TEST"):
+    monkeypatch.setenv("RAY_TPU_EVENT_DIR", str(tmp_path / "events"))
+    return slo_mod.AlertEvaluator(db, rules=rules,
+                                  register_metrics=False,
+                                  event_source=source)
+
+
+def test_alert_pending_hold_then_firing_then_resolved(tmp_path,
+                                                      monkeypatch):
+    db = _db()
+    ev = _evaluator(db, [_gauge_rule(for_s=10.0)], tmp_path, monkeypatch)
+
+    # clean series: stays ok
+    for i in range(9):
+        _feed(db, "queue_depth 1", ts=100.0 + 5 * i)
+        ev.evaluate(now=100.0 + 5 * i)
+    [a] = ev.snapshot()["alerts"]
+    assert a["state"] == "ok" and ev.snapshot()["transitions"] == {}
+
+    # breach both windows → pending (for_s not yet served)
+    for i in range(9, 18):
+        _feed(db, "queue_depth 50", ts=100.0 + 5 * i)
+    ev.evaluate(now=145.0)
+    [a] = ev.snapshot()["alerts"]
+    assert a["state"] == "pending" and a["firing_since"] is None
+
+    # hold served → firing, with a structured ALERT_FIRING event
+    ev.evaluate(now=156.0)
+    [a] = ev.snapshot()["alerts"]
+    assert a["state"] == "firing" and a["firing_since"] == 156.0
+    fired = list_events(source="SLO_TEST", label="ALERT_FIRING")
+    assert len(fired) == 1 and fired[0]["rule"] == "test-queue"
+
+    # both windows clear → resolved (back to ok), ALERT_RESOLVED event
+    for i in range(18, 30):
+        _feed(db, "queue_depth 0", ts=100.0 + 5 * i)
+    ev.evaluate(now=250.0)
+    [a] = ev.snapshot()["alerts"]
+    assert a["state"] == "ok" and a["resolved_ts"] == 250.0
+    assert list_events(source="SLO_TEST", label="ALERT_RESOLVED")
+    assert ev.snapshot()["transitions"] == {
+        "test-queue:pending": 1, "test-queue:firing": 1,
+        "test-queue:resolved": 1}
+
+
+def test_alert_pending_retracts_without_firing(tmp_path, monkeypatch):
+    """A blip shorter than for_s never fires — pending walks back to ok
+    and no event is emitted."""
+    db = _db()
+    ev = _evaluator(db, [_gauge_rule(for_s=30.0)], tmp_path, monkeypatch,
+                    source="SLO_BLIP")
+    for i in range(12):
+        _feed(db, "queue_depth 50", ts=100.0 + 5 * i)
+    ev.evaluate(now=155.0)
+    assert ev.snapshot()["alerts"][0]["state"] == "pending"
+    for i in range(12, 24):
+        _feed(db, "queue_depth 0", ts=100.0 + 5 * i)
+    ev.evaluate(now=215.0)
+    assert ev.snapshot()["alerts"][0]["state"] == "ok"
+    assert list_events(source="SLO_BLIP", label="ALERT_FIRING") == []
+
+
+def test_flap_suppression_fast_dip_keeps_firing(tmp_path, monkeypatch):
+    """Multi-window resolution: once firing, a clear FAST window with a
+    still-breaching slow window keeps the alert up (SRE Workbook ch.5 —
+    the slow window is the flap suppressor)."""
+    db = _db()
+    rule = _gauge_rule(for_s=0.0)
+    ev = _evaluator(db, [rule], tmp_path, monkeypatch, source="SLO_FLAP")
+    for i in range(10):
+        _feed(db, "queue_depth 50", ts=100.0 + 5 * i)
+    ev.evaluate(now=145.0)
+    assert ev.firing() == ["test-queue"]
+
+    # a dip long enough to clear the fast(10s) window while the slow
+    # (40s) window is still dominated by the breach plateau
+    for ts in (150.0, 155.0, 160.0):
+        _feed(db, "queue_depth 0", ts=ts)
+    [a] = ev.evaluate(now=160.0)
+    assert a["state"] == "firing"
+    assert a["fast_value"] < rule.threshold < a["slow_value"]
+
+    # plateau rolls out of the slow window too → resolved
+    for i in range(13, 22):
+        _feed(db, "queue_depth 0", ts=100.0 + 5 * i)
+    [a] = ev.evaluate(now=205.0)
+    assert a["state"] == "ok" and a["resolved_ts"] == 205.0
+    # exactly one firing/resolved pair despite the dip
+    t = ev.snapshot()["transitions"]
+    assert t["test-queue:firing"] == 1 and t["test-queue:resolved"] == 1
+
+
+def test_no_false_positives_on_clean_series(tmp_path, monkeypatch):
+    """The default serve rule pack over realistic healthy series: many
+    evaluations, zero transitions, zero events. Absent series never
+    breach either."""
+    db = tsdb_mod.TSDB()
+    ev = _evaluator(db, None, tmp_path, monkeypatch, source="SLO_CLEAN")
+    for i in range(40):
+        ts = 100.0 + 2 * i
+        _feed(db, [
+            f'serve_ttft_ms_bucket{{le="50"}} {10 * i}',
+            f'serve_ttft_ms_bucket{{le="+Inf"}} {10 * i}',
+            f'serve_tpot_ms_bucket{{le="10"}} {40 * i}',
+            f'serve_tpot_ms_bucket{{le="+Inf"}} {40 * i}',
+            "serve_llm_waiting_seqs 2",
+            "serve_llm_kv_page_utilization 0.41",
+            f'object_store_job_quota_rejects{{job="j"}} 0',
+            "ray_tpu_reconstruction_failures_total 0",
+            'health_loop_stalled{loop="pump"} 0',
+        ], ts=ts, source="local")
+        ev.evaluate(now=ts)
+    snap = ev.snapshot()
+    assert snap["evaluations"] == 40
+    assert snap["firing"] == []
+    assert all(a["state"] == "ok" for a in snap["alerts"])
+    assert snap["transitions"] == {}
+    assert list_events(source="SLO_CLEAN") == []
+
+
+def test_burn_rate_rule(tmp_path, monkeypatch):
+    """burn_rate = (err_increase/total_increase)/budget: burning 14×
+    the 1% budget breaches a 10× threshold; burning 0.5× doesn't."""
+    db = _db()
+    rule = slo_mod.Rule(
+        "err-budget", "errors_total", 10.0, kind="burn_rate",
+        total_metric="requests_total", budget=0.01,
+        fast_window_s=30.0, slow_window_s=30.0)
+    ev = _evaluator(db, [rule], tmp_path, monkeypatch, source="SLO_BURN")
+    # 14 errors / 100 requests in-window → ratio 0.14 → burn 14 > 10
+    _feed(db, ["errors_total 0", "requests_total 0"], ts=100.0)
+    _feed(db, ["errors_total 14", "requests_total 100"], ts=110.0)
+    [a] = ev.evaluate(now=110.0)
+    assert a["fast_value"] == pytest.approx(14.0)
+    assert a["state"] == "firing"
+
+    db2 = _db()
+    ev2 = _evaluator(db2, [rule], tmp_path, monkeypatch,
+                     source="SLO_BURN2")
+    _feed(db2, ["errors_total 0", "requests_total 0"], ts=100.0)
+    _feed(db2, ["errors_total 1", "requests_total 200"], ts=110.0)
+    [a] = ev2.evaluate(now=110.0)
+    assert a["fast_value"] == pytest.approx(0.5)
+    assert a["state"] == "ok"
+
+
+def test_alert_metrics_text_rows(tmp_path, monkeypatch):
+    db = _db()
+    ev = _evaluator(db, [_gauge_rule(for_s=0.0)], tmp_path, monkeypatch,
+                    source="SLO_ROWS")
+    for i in range(10):
+        _feed(db, "queue_depth 50", ts=100.0 + 5 * i)
+    ev.evaluate(now=145.0)
+    text = ev.metrics_text()
+    assert 'alerts_firing{rule="test-queue"} 1' in text
+    assert 'alert_transitions_total{rule="test-queue",to="firing"} 1' \
+        in text
+    # the rows round-trip through the tsdb's default prefix filter
+    db2 = tsdb_mod.TSDB()
+    db2.ingest(text, source="local")
+    assert db2.latest("alerts_firing", {"rule": "test-queue"}) == 1.0
+
+
+def test_rule_validation():
+    with pytest.raises(ValueError, match="unknown rule kind"):
+        slo_mod.Rule("r", "m", 1.0, kind="percentile")
+    with pytest.raises(ValueError, match="unknown rule op"):
+        slo_mod.Rule("r", "m", 1.0, op=">=")
+    with pytest.raises(ValueError, match="total_metric"):
+        slo_mod.Rule("r", "m", 1.0, kind="burn_rate")
+
+
+# ---------------------------------------------------------------------------
+# events rotation
+# ---------------------------------------------------------------------------
+
+
+def test_events_rotation_keeps_k_whole_generations(tmp_path,
+                                                   monkeypatch):
+    from ray_tpu.util import events
+
+    monkeypatch.setenv("RAY_TPU_EVENT_DIR", str(tmp_path))
+    monkeypatch.setenv("RAY_TPU_EVENTS_MAX_BYTES", "2048")
+    monkeypatch.setenv("RAY_TPU_EVENTS_KEEP", "3")
+    n = 200
+    for i in range(n):
+        events.report("ROT", "INFO", "TICK", f"event {i:04d}", seq=i,
+                      pad="x" * 64)
+    shards = sorted(glob.glob(str(tmp_path / "event_ROT_*.jsonl")))
+    # the cap forced rotation; at most keep(3) rotated + 1 active file
+    assert 2 <= len(shards) <= 4
+    for fn in shards:
+        assert os.path.getsize(fn) <= 2048 + 512  # cap + one line slack
+        with open(fn) as f:
+            for line in f:
+                ev = json.loads(line)  # every line is whole JSON
+                assert ev["label"] == "TICK"
+    # list_events merges the generations, oldest first; the newest
+    # keep-K generations survive in order with no torn/duplicated seq
+    merged = list_events(source="ROT")
+    seqs = [e["seq"] for e in merged]
+    assert seqs == list(range(n - len(seqs), n))
+    assert len(merged) >= 20  # at least ~2 generations survived
+
+
+def test_events_rotation_concurrent_writers_never_tear(tmp_path,
+                                                       monkeypatch):
+    """8 threads × 100 events through a 1 KiB cap: rotation happens
+    constantly, yet every surviving line parses — the write+rotate
+    critical section admits no torn JSON."""
+    from ray_tpu.util import events
+
+    monkeypatch.setenv("RAY_TPU_EVENT_DIR", str(tmp_path))
+    monkeypatch.setenv("RAY_TPU_EVENTS_MAX_BYTES", "1024")
+
+    def spam(k):
+        for i in range(100):
+            events.report("TORN", "INFO", "SPAM", f"w{k} e{i}",
+                          w=k, i=i, pad="y" * 32)
+
+    threads = [threading.Thread(target=spam, args=(k,))
+               for k in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for fn in glob.glob(str(tmp_path / "event_TORN_*.jsonl")):
+        with open(fn) as f:
+            for line in f:
+                assert json.loads(line)["label"] == "SPAM"
+
+
+def test_events_unbounded_without_cap(tmp_path, monkeypatch):
+    from ray_tpu.util import events
+
+    monkeypatch.setenv("RAY_TPU_EVENT_DIR", str(tmp_path))
+    monkeypatch.delenv("RAY_TPU_EVENTS_MAX_BYTES", raising=False)
+    for i in range(50):
+        events.report("NOCAP", "INFO", "TICK", "m", seq=i)
+    shards = glob.glob(str(tmp_path / "event_NOCAP_*.jsonl"))
+    assert len(shards) == 1  # no rotation without the cap
+    assert [e["seq"] for e in list_events(source="NOCAP")] == \
+        list(range(50))
+
+
+# ---------------------------------------------------------------------------
+# deadman watchdog
+# ---------------------------------------------------------------------------
+
+
+def _quiesce_singleton_watchdog():
+    """Earlier tests (or an engine) may have started the process-wide
+    watchdog; park it so synchronous check_once() assertions can't race
+    its sweep, and drop any stalled flags stray probes may still carry
+    (they would feed the deadman gauge this suite asserts on)."""
+    from ray_tpu._private import health
+
+    with health._lock:
+        wd, health._watchdog_singleton = health._watchdog_singleton, None
+    if wd is not None:
+        wd.stop()
+    for p in health.probes():
+        p.stalled = False
+
+
+def test_watchdog_detects_stall_and_recovery(tmp_path, monkeypatch):
+    """A REAL thread blocks with work pending: the deadman flags it,
+    captures the culprit stack (naming the blocking call), emits
+    `health.stalled`, and emits `health.recovered` at the next beat."""
+    from ray_tpu._private import health
+
+    monkeypatch.setenv("RAY_TPU_EVENT_DIR", str(tmp_path))
+    _quiesce_singleton_watchdog()
+    gate = threading.Event()
+    gate.set()
+    stop = threading.Event()
+    probe = health.watch_loop("wd_test_loop", backlog_fn=lambda: 3)
+
+    def loop():
+        while not stop.is_set():
+            probe.beat()
+            gate.wait()          # the injected wedge parks here
+            time.sleep(0.005)
+
+    t = threading.Thread(target=loop, name="wd-test-loop", daemon=True)
+    t.start()
+    wd = health.Watchdog(source="WD_TEST", stall_s=0.3, interval_s=0.05)
+    try:
+        wd.check_once()                       # baseline sighting
+        time.sleep(0.1)
+        assert "wd_test_loop" not in wd.check_once()  # beating: fine
+        gate.clear()                          # wedge the loop
+        deadline = time.time() + 10.0
+        while not probe.stalled and time.time() < deadline:
+            time.sleep(0.05)
+            wd.check_once()
+        assert probe.stalled
+        assert probe.stalled and probe.stalls_total == 1
+        [ev] = list_events(source="WD_TEST", label="health.stalled")
+        assert ev["loop"] == "wd_test_loop" and ev["backlog"] == 3.0
+        assert ev["frozen_s"] >= 0.3
+        assert "gate.wait()" in ev["stack"]   # the culprit line itself
+        # the gauge the deadman alert rule watches
+        assert 'health_loop_stalled{loop="wd_test_loop"} 1' \
+            in health.metrics_text()
+
+        gate.set()                            # un-wedge
+        deadline = time.time() + 10.0
+        while probe.stalled and time.time() < deadline:
+            time.sleep(0.05)
+            wd.check_once()
+        assert not probe.stalled
+        [rec] = list_events(source="WD_TEST", label="health.recovered")
+        assert rec["loop"] == "wd_test_loop" and rec["stalled_s"] > 0
+    finally:
+        stop.set()
+        gate.set()
+        t.join(timeout=5)
+        health.unwatch_loop("wd_test_loop")
+
+
+def test_watchdog_idle_loop_is_not_stalled():
+    """Frozen counter + EMPTY backlog = a legitimately quiet loop."""
+    from ray_tpu._private import health
+
+    _quiesce_singleton_watchdog()
+    probe = health.watch_loop("idle_loop", backlog_fn=lambda: 0)
+    probe.beat()
+    wd = health.Watchdog(source="WD_IDLE", stall_s=0.1)
+    try:
+        wd.check_once(now=1000.0)
+        wd.check_once(now=2000.0)   # frozen forever, but idle
+        assert not probe.stalled
+        # no backlog_fn at all behaves the same
+        probe2 = health.watch_loop("idle_loop2")
+        probe2.beat()
+        wd.check_once(now=2000.0)
+        wd.check_once(now=3000.0)
+        assert not probe2.stalled
+    finally:
+        health.unwatch_loop("idle_loop")
+        health.unwatch_loop("idle_loop2")
+
+
+def test_watchdog_synthetic_clock():
+    """check_once(now=) drives the deadman rule without real waiting:
+    the stall threshold is a pure monotonic-time comparison."""
+    from ray_tpu._private import health
+
+    _quiesce_singleton_watchdog()
+    probe = health.watch_loop("clock_loop", backlog_fn=lambda: 1)
+    probe.beat()
+    wd = health.Watchdog(source="WD_CLOCK", stall_s=5.0)
+    try:
+        wd.check_once(now=100.0)
+        wd.check_once(now=104.9)                 # under stall_s
+        assert not probe.stalled
+        assert "clock_loop" in wd.check_once(now=105.1)
+        assert "clock_loop" not in wd.check_once(now=200.0)  # once only
+        assert probe.stalls_total == 1
+        probe.beat()                             # progress resumes
+        wd.check_once(now=201.0)
+        assert not probe.stalled
+    finally:
+        health.unwatch_loop("clock_loop")
+
+
+def test_dump_stacks_annotates_probes_and_locks():
+    """dump_stacks() reports every thread with a formatted stack; the
+    thread driving a probe is annotated with its loop name, and — with
+    lockdep armed (this suite runs under the conftest gate) — a thread
+    parked holding a tracked lock shows it in held_locks."""
+    from ray_tpu._private import health, lockdep
+
+    _quiesce_singleton_watchdog()
+    probe = health.watch_loop("dump_loop")
+    probe.beat()   # binds this thread's ident
+    lk = threading.Lock()
+    holding = threading.Event()
+    release = threading.Event()
+
+    def holder():
+        with lk:
+            holding.set()
+            release.wait()
+
+    t = threading.Thread(target=holder, name="lock-holder", daemon=True)
+    t.start()
+    assert holding.wait(timeout=10)
+    try:
+        threads = health.dump_stacks()
+        by_ident = {e["ident"]: e for e in threads}
+        me = by_ident[threading.get_ident()]
+        assert me["loop"] == "dump_loop"
+        assert "dump_stacks" in me["stack"] or "test_dump" in me["stack"]
+        holder_entry = by_ident[t.ident]
+        assert holder_entry["name"] == "lock-holder"
+        assert "release.wait()" in holder_entry["stack"]
+        if lockdep.enabled():   # conftest arms it for this suite
+            assert any("Lock@" in n for n in
+                       holder_entry.get("held_locks", [])), holder_entry
+    finally:
+        release.set()
+        t.join(timeout=5)
+        health.unwatch_loop("dump_loop")
+
+
+# ---------------------------------------------------------------------------
+# the chaos row: data stall → stalled event + alert bracketing the
+# RecoveryLedger outage window
+# ---------------------------------------------------------------------------
+
+
+def test_chaos_data_stall_alert_brackets_outage(tmp_path, monkeypatch):
+    """End-to-end chaos proof, compressed: a driver-shaped loop steps at
+    ~50 Hz recording StepStats-shaped completions; an injected data
+    stall blocks its feed. The deadman watchdog flags the frozen loop
+    (capturing the wedged stack), the `health_loop_stalled` gauge rides
+    a scrape into the tsdb, and the SLO deadman rule fires — then
+    resolves once stepping resumes. The firing timestamp must land
+    inside the RecoveryLedger's computed outage window for the same
+    fault, and resolution must follow recovery:
+    fault_ts <= firing_ts <= recovered_ts <= resolved_ts.
+
+    Determinism-gated 5-of-5 by:
+    python tools/flake_gate.py -n 5 \
+        tests/test_slo.py::test_chaos_data_stall_alert_brackets_outage
+    """
+    from ray_tpu._private import health
+    from ray_tpu.soak.ledger import RecoveryLedger
+
+    monkeypatch.setenv("RAY_TPU_EVENT_DIR", str(tmp_path / "events"))
+    _quiesce_singleton_watchdog()
+
+    records = []
+    stall_gate = threading.Event()
+    stall_gate.set()
+    stop = threading.Event()
+    probe = health.watch_loop("soak_driver_chaos", backlog_fn=lambda: 1)
+    step = [0]
+
+    def drive():
+        while not stop.is_set():
+            probe.beat()
+            stall_gate.wait()        # the data plane: stall parks here
+            time.sleep(0.02)
+            records.append({"step": step[0], "ts": time.time(),
+                            "total_ms": 20.0})
+            step[0] += 1
+
+    db = tsdb_mod.TSDB()
+    evaluator = slo_mod.AlertEvaluator(
+        db, rules=[slo_mod.deadman_rule(fast_window_s=0.5,
+                                        slow_window_s=0.5)],
+        register_metrics=False, event_source="SLO_CHAOS")
+    wd = health.Watchdog(source="HEALTH_CHAOS", stall_s=0.4,
+                         interval_s=0.05)
+
+    def tick():
+        # one observability beat: watchdog sweep → scrape → evaluate
+        wd.check_once()
+        db.ingest(health.metrics_text(), source="local")
+        evaluator.evaluate()
+
+    t = threading.Thread(target=drive, name="soak-drive-chaos",
+                         daemon=True)
+    t.start()
+    try:
+        # healthy warmup: the pre-fault rate window the ledger needs,
+        # and the zero-false-positive bar for a clean run
+        end = time.time() + 1.2
+        while time.time() < end:
+            tick()
+            time.sleep(0.04)
+        assert evaluator.firing() == []
+        assert not probe.stalled
+
+        fault_ts = time.time()
+        stall_gate.clear()                       # ← data_stall fires
+        firing_ts = None
+        deadline = time.time() + 15.0
+        while firing_ts is None and time.time() < deadline:
+            tick()
+            if evaluator.firing():
+                firing_ts = time.time()
+            time.sleep(0.04)
+        assert firing_ts is not None, "deadman alert never fired"
+        [sev] = list_events(source="HEALTH_CHAOS",
+                            label="health.stalled")
+        assert sev["loop"] == "soak_driver_chaos"
+        assert "stall_gate.wait()" in sev["stack"]   # captured culprit
+
+        time.sleep(0.2)                          # hold the outage open
+        recovered_ts = time.time()
+        stall_gate.set()                         # ← stall ends
+        resolved_ts = None
+        deadline = time.time() + 15.0
+        while resolved_ts is None and time.time() < deadline:
+            tick()
+            snap = evaluator.snapshot()["alerts"][0]
+            if snap["state"] == "ok" and snap["resolved_ts"]:
+                resolved_ts = snap["resolved_ts"]
+            time.sleep(0.04)
+        assert resolved_ts is not None, "alert never resolved"
+        assert list_events(source="HEALTH_CHAOS",
+                           label="health.recovered")
+    finally:
+        stop.set()
+        stall_gate.set()
+        t.join(timeout=10)
+        health.unwatch_loop("soak_driver_chaos")
+
+    # the ledger's view of the same outage, from the step record ring
+    led = RecoveryLedger(rate_threshold=0.9, rate_window=4)
+    led.add_fault("data_stall@train", fault_ts)
+    [m] = led.compute_mttr(records)
+    assert m["degraded"] and m["recovered"]
+    outage_end = fault_ts + m["mttr_s"]
+    # the alert bracketed the ledger's outage window
+    assert fault_ts <= firing_ts <= recovered_ts
+    assert firing_ts <= outage_end
+    assert resolved_ts >= recovered_ts
+    # exactly one firing/resolved pair — no flapping across recovery
+    trans = evaluator.snapshot()["transitions"]
+    assert trans["loop-stalled:firing"] == 1
+    assert trans["loop-stalled:resolved"] == 1
+
+
+# ---------------------------------------------------------------------------
+# clean closed-loop serve run: zero alerts, pump probe registered
+# ---------------------------------------------------------------------------
+
+
+def test_clean_serve_run_fires_zero_alerts(tmp_path, monkeypatch):
+    """A healthy closed-loop LLM engine driven under the full alert
+    plane (default serve rules + deadman, scraping the live registry):
+    zero transitions, zero events — the acceptance bar that the rule
+    pack is quiet on a clean system. Also pins that the engine pump
+    registers its loop probe on start() and retires it on stop()."""
+    from ray_tpu._private import health
+    from ray_tpu.serve.llm import EngineConfig, LLMEngine
+    from ray_tpu.util import request_recorder as rr
+
+    monkeypatch.setenv("RAY_TPU_EVENT_DIR", str(tmp_path / "events"))
+    rr.clear()
+    db = tsdb_mod.TSDB()
+    evaluator = slo_mod.AlertEvaluator(db, register_metrics=False,
+                                       event_source="SLO_SERVE")
+    eng = LLMEngine(model="llama",
+                    engine_config=EngineConfig(batch_buckets=(1, 2),
+                                               prefill_buckets=(8,)),
+                    seed=0)
+    eng.warmup()
+    eng.start()
+    try:
+        assert any(p.name.startswith("llm_engine_pump_")
+                   for p in health.probes())
+        end = time.time() + 1.5
+        while time.time() < end:
+            req = eng.submit([3, 4, 5], 4)
+            req.result(timeout=60)
+            tsdb_mod.scrape_local(db)
+            evaluator.evaluate()
+        eng.quiesce(timeout=60)
+    finally:
+        assert eng.shutdown() == 0
+    snap = evaluator.snapshot()
+    assert snap["firing"] == []
+    assert snap["transitions"] == {}
+    assert all(a["state"] == "ok" for a in snap["alerts"])
+    assert list_events(source="SLO_SERVE") == []
+    # stop() retired the pump probe
+    assert not any(p.name.startswith("llm_engine_pump_")
+                   for p in health.probes())
+
+
+# ---------------------------------------------------------------------------
+# operator CLI against a live cluster
+# ---------------------------------------------------------------------------
+
+
+def test_cli_stack_and_alerts_against_live_cluster(tmp_path):
+    """`ray_tpu stack` aggregates the dump_stacks RPC across a live
+    cluster — even a one-node cluster yields ≥3 distinct processes
+    (gcs, raylet, cli) — and `ray_tpu alerts` evaluates the default
+    rule pack over live scrapes: a healthy idle cluster reports
+    0 firing. Isolated CLI state file, same idiom as
+    test_observability."""
+    env = dict(os.environ)
+    env.pop("RAY_TPU_ADDRESS", None)
+    env["RAY_TPU_CLI_STATE_FILE"] = str(tmp_path / "cli_node.json")
+
+    out = subprocess.run(
+        [sys.executable, "-m", "ray_tpu", "start", "--head",
+         "--port", "0", "--resources", '{"CPU": 2.0}'],
+        capture_output=True, text=True, env=env, timeout=300)
+    assert out.returncode == 0, out.stderr
+    with open(env["RAY_TPU_CLI_STATE_FILE"]) as f:
+        gcs_addr = json.load(f)["gcs_addr"]
+    try:
+        stack = subprocess.run(
+            [sys.executable, "-m", "ray_tpu", "stack", "--json",
+             "--address", gcs_addr],
+            capture_output=True, text=True, env=env, timeout=300)
+        assert stack.returncode == 0, stack.stderr
+        reports = [r for r in json.loads(stack.stdout)
+                   if "error" not in r]
+        assert len({r["pid"] for r in reports}) >= 3
+        assert {"gcs", "raylet", "cli"} <= {r["role"] for r in reports}
+        # every process report carries real formatted thread stacks
+        for r in reports:
+            assert r["threads"] and all(t["stack"] for t in r["threads"])
+
+        text = subprocess.run(
+            [sys.executable, "-m", "ray_tpu", "stack",
+             "--address", gcs_addr],
+            capture_output=True, text=True, env=env, timeout=300)
+        assert text.returncode == 0, text.stderr
+        assert "==== gcs" in text.stdout
+        assert "==== raylet" in text.stdout
+        assert "processes," in text.stdout  # summary line
+
+        alerts = subprocess.run(
+            [sys.executable, "-m", "ray_tpu", "alerts",
+             "--scrapes", "2", "--interval", "0.2",
+             "--address", gcs_addr],
+            capture_output=True, text=True, env=env, timeout=300)
+        assert alerts.returncode == 0, alerts.stderr
+        assert "0 firing" in alerts.stdout
+        assert "DEGRADED" not in alerts.stdout
+    finally:
+        subprocess.run([sys.executable, "-m", "ray_tpu", "stop"],
+                       capture_output=True, text=True, env=env,
+                       timeout=60)

@@ -37,7 +37,7 @@ if [ "$fast" -eq 0 ]; then
         tests/test_chaos.py tests/test_object_store.py \
         tests/test_rpc_batch.py tests/test_multitenant.py \
         tests/test_ownership.py tests/test_serve_llm_spec.py \
-        tests/test_dispatch_ring.py
+        tests/test_dispatch_ring.py tests/test_slo.py
 fi
 
 echo

@@ -101,7 +101,7 @@ from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
 CHECKS = ("lock-discipline", "blocking-under-lock", "jit-purity",
           "seeded-rng", "jit-cache-stability", "metric-in-hot-loop",
-          "span-leak", "snapshot-read")
+          "span-leak", "snapshot-read", "watchdog-probe")
 
 _LOCKISH_NAME = re.compile(r"lock|mutex|cond", re.IGNORECASE)
 _LOCK_FACTORIES = {
@@ -1359,6 +1359,42 @@ def check_snapshot_read(ctx: ModuleContext) -> List[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# checker 9: watchdog-probe
+# ---------------------------------------------------------------------------
+
+def check_watchdog_probe(ctx: ModuleContext) -> List[Finding]:
+    """Flag health-probe ``beat()`` calls taken under a tracked lock.
+
+    The deadman watchdog (`ray_tpu/_private/health.py`) decides a loop
+    is stalled when its beat counter freezes while work is pending. The
+    whole scheme rests on one invariant: the beat is lock-free — a
+    beat taken inside ``with self._lock`` freezes together with the
+    lock, so the exact wedge the watchdog exists to catch (a thread
+    stuck acquiring the loop's lock) also silences its own liveness
+    signal. Any attribute call named ``beat`` inside a lexically held
+    lock region is flagged; move the beat before the lock."""
+    findings: List[Finding] = []
+    for classname, fn in _iter_func_nodes(ctx.tree):
+        scope = f"{classname}.{fn.name}" if classname else fn.name
+        lock_test = ctx.lock_test_for_class(classname)
+        for node, held, _nested in _scan_held(fn.body, (), False,
+                                              lock_test):
+            if not held or not isinstance(node, ast.Call):
+                continue
+            name = dotted(node.func) or ""
+            if not name.endswith(".beat"):
+                continue
+            findings.append(Finding(
+                ctx.relpath, "watchdog-probe", scope,
+                f"beat:{name}", node.lineno,
+                f"`{name}()` beats while holding "
+                f"{', '.join(held)} — a probe beaten under the "
+                f"watched loop's lock freezes with it and can never "
+                f"witness the stall; beat outside the lock"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
 # driver
 # ---------------------------------------------------------------------------
 
@@ -1371,6 +1407,7 @@ _CHECKERS = {
     "metric-in-hot-loop": check_metric_in_hot_loop,
     "span-leak": check_span_leak,
     "snapshot-read": check_snapshot_read,
+    "watchdog-probe": check_watchdog_probe,
 }
 
 
